@@ -1,0 +1,4 @@
+from pytorch_distributed_rnn_tpu.models.motion import MotionModel
+from pytorch_distributed_rnn_tpu.models.toy import ToyModel
+
+__all__ = ["MotionModel", "ToyModel"]

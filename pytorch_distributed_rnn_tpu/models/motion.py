@@ -1,0 +1,47 @@
+"""Motion sequence classifier: stacked RNN + last-timestep projection.
+
+Capability parity with the reference ``MotionModel``
+(``/root/reference/src/motion/model.py:4-17``): a stacked LSTM (default
+2 x 32) over (B, 128, 9) windows followed by a Linear head applied to the
+last timestep's hidden state; logits out (CrossEntropy applies softmax).
+TPU-native differences: pure-functional params pytree, ``lax.scan`` cells
+with batched input projections, optional GRU cell and optional Pallas fused
+recurrent step.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+
+from pytorch_distributed_rnn_tpu.ops.initializers import linear_init
+from pytorch_distributed_rnn_tpu.ops.rnn import init_stacked_rnn, stacked_rnn
+
+
+@dataclass(frozen=True)
+class MotionModel:
+    """Functional model: ``params = model.init(key)``,
+    ``logits = model.apply(params, x)``."""
+
+    input_dim: int = 9
+    hidden_dim: int = 32
+    layer_dim: int = 2
+    output_dim: int = 6
+    cell: str = "lstm"
+    unroll: int = 1
+
+    def init(self, key: jax.Array):
+        rnn_key, fc_key = jax.random.split(key)
+        return {
+            "rnn": init_stacked_rnn(
+                rnn_key, self.input_dim, self.hidden_dim, self.layer_dim, self.cell
+            ),
+            "fc": linear_init(fc_key, self.hidden_dim, self.output_dim),
+        }
+
+    def apply(self, params, x: jax.Array) -> jax.Array:
+        """x: (B, T, input_dim) -> logits (B, output_dim)."""
+        outputs, _ = stacked_rnn(params["rnn"], x, self.cell, unroll=self.unroll)
+        last = outputs[:, -1, :]
+        return last @ params["fc"]["weight"].T + params["fc"]["bias"]

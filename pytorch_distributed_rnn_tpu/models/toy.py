@@ -1,0 +1,33 @@
+"""Toy MLP used by the distributed smoke-test examples.
+
+Capability parity with the reference ``ToyModel``
+(``/root/reference/src/example/example_ddp.py:11-19``): Linear(10,10) ->
+ReLU -> Linear(10,5), trained with MSE + SGD in the examples, used to check
+that every rank ends with identical parameters.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+
+from pytorch_distributed_rnn_tpu.ops.initializers import linear_init
+
+
+@dataclass(frozen=True)
+class ToyModel:
+    in_dim: int = 10
+    hidden_dim: int = 10
+    out_dim: int = 5
+
+    def init(self, key: jax.Array):
+        k1, k2 = jax.random.split(key)
+        return {
+            "net1": linear_init(k1, self.in_dim, self.hidden_dim),
+            "net2": linear_init(k2, self.hidden_dim, self.out_dim),
+        }
+
+    def apply(self, params, x: jax.Array) -> jax.Array:
+        h = jax.nn.relu(x @ params["net1"]["weight"].T + params["net1"]["bias"])
+        return h @ params["net2"]["weight"].T + params["net2"]["bias"]

@@ -1,0 +1,28 @@
+from pytorch_distributed_rnn_tpu.ops.initializers import (
+    lstm_uniform,
+    linear_init,
+    uniform_bound,
+)
+from pytorch_distributed_rnn_tpu.ops.losses import cross_entropy_loss, mse_loss
+from pytorch_distributed_rnn_tpu.ops.rnn import (
+    init_gru_layer,
+    init_lstm_layer,
+    init_stacked_rnn,
+    gru_layer,
+    lstm_layer,
+    stacked_rnn,
+)
+
+__all__ = [
+    "lstm_uniform",
+    "linear_init",
+    "uniform_bound",
+    "cross_entropy_loss",
+    "mse_loss",
+    "init_gru_layer",
+    "init_lstm_layer",
+    "init_stacked_rnn",
+    "gru_layer",
+    "lstm_layer",
+    "stacked_rnn",
+]

@@ -1,0 +1,42 @@
+"""Torch-parity initializers, expressed with JAX PRNG.
+
+The reference relies on PyTorch's default initializers (it never overrides
+them): ``nn.LSTM`` draws every weight and bias from U(-k, k) with
+k = 1/sqrt(hidden_size); ``nn.Linear`` uses kaiming-uniform(a=sqrt(5)) for the
+weight -- which reduces to U(-1/sqrt(fan_in), 1/sqrt(fan_in)) -- and
+U(-1/sqrt(fan_in), 1/sqrt(fan_in)) for the bias.  Matching the *distribution*
+(not the bitstream) keeps loss curves comparable with the reference models
+(``/root/reference/src/motion/model.py:9-16``,
+``/root/reference/src/example/example_ddp.py:11-19``).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def uniform_bound(key: jax.Array, shape, bound: float, dtype=jnp.float32):
+    """Sample U(-bound, bound)."""
+    return jax.random.uniform(key, shape, dtype=dtype, minval=-bound, maxval=bound)
+
+
+def lstm_uniform(key: jax.Array, shape, hidden_size: int, dtype=jnp.float32):
+    """torch.nn.LSTM / nn.GRU default: U(-1/sqrt(H), 1/sqrt(H)) for all tensors."""
+    return uniform_bound(key, shape, 1.0 / math.sqrt(hidden_size), dtype=dtype)
+
+
+def linear_init(key: jax.Array, in_features: int, out_features: int, dtype=jnp.float32):
+    """torch.nn.Linear default init.
+
+    Returns ``{"weight": (out, in), "bias": (out,)}`` -- torch layout, so a
+    forward pass is ``x @ weight.T + bias``.
+    """
+    wkey, bkey = jax.random.split(key)
+    bound = 1.0 / math.sqrt(in_features)
+    return {
+        "weight": uniform_bound(wkey, (out_features, in_features), bound, dtype),
+        "bias": uniform_bound(bkey, (out_features,), bound, dtype),
+    }

@@ -1,0 +1,192 @@
+"""RNN layers as ``lax.scan`` with MXU-batched input projections.
+
+TPU-first design, deliberately NOT a translation of the reference's
+``nn.LSTM`` call (``/root/reference/src/motion/model.py:9-16``):
+
+- The input projection for *all* timesteps is computed up front as one large
+  ``(B*T, in) x (in, 4H)`` matmul that XLA tiles onto the MXU.  The
+  sequential part of the scan then only carries the ``(B, H) x (H, 4H)``
+  recurrent matmul plus fused elementwise gate math - the minimum serial work
+  an LSTM admits.
+- ``lax.scan`` keeps the loop inside one XLA computation: traced once,
+  unrolled/tiled by the compiler, no per-step Python dispatch.
+- Weight layout and gate ordering follow torch (``w_ih: (4H, in)`` with gate
+  order i,f,g,o; GRU r,z,n) so numerics are directly comparable with the
+  reference models; tests check parity against torch CPU.
+
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from pytorch_distributed_rnn_tpu.ops.initializers import lstm_uniform
+
+
+# ---------------------------------------------------------------------------
+# Parameter construction
+# ---------------------------------------------------------------------------
+
+def init_lstm_layer(key, input_size: int, hidden_size: int, dtype=jnp.float32):
+    """One LSTM layer's params, torch layout: w_ih (4H, in), w_hh (4H, H),
+    b_ih (4H,), b_hh (4H,). All U(-1/sqrt(H), 1/sqrt(H)) like torch."""
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    h = hidden_size
+    return {
+        "w_ih": lstm_uniform(k1, (4 * h, input_size), h, dtype),
+        "w_hh": lstm_uniform(k2, (4 * h, h), h, dtype),
+        "b_ih": lstm_uniform(k3, (4 * h,), h, dtype),
+        "b_hh": lstm_uniform(k4, (4 * h,), h, dtype),
+    }
+
+
+def init_gru_layer(key, input_size: int, hidden_size: int, dtype=jnp.float32):
+    """One GRU layer's params, torch layout with gate order r,z,n."""
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    h = hidden_size
+    return {
+        "w_ih": lstm_uniform(k1, (3 * h, input_size), h, dtype),
+        "w_hh": lstm_uniform(k2, (3 * h, h), h, dtype),
+        "b_ih": lstm_uniform(k3, (3 * h,), h, dtype),
+        "b_hh": lstm_uniform(k4, (3 * h,), h, dtype),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Single layers
+# ---------------------------------------------------------------------------
+
+def lstm_layer(params, x, h0=None, c0=None, *, unroll: int = 1):
+    """Run one LSTM layer over ``x`` of shape (B, T, in).
+
+    Returns ``(outputs (B, T, H), (h_T, c_T))``.  The initial carry defaults
+    to zeros, matching torch's ``nn.LSTM`` when no hidden state is passed.
+    """
+    batch, _, _ = x.shape
+    hidden = params["w_hh"].shape[1]
+    dtype = x.dtype
+
+    # One big MXU matmul for every timestep's input projection.  Both bias
+    # vectors fold in here because they are added to the same pre-activation.
+    x_proj = (
+        jnp.einsum("bti,gi->btg", x, params["w_ih"])
+        + params["b_ih"]
+        + params["b_hh"]
+    )
+
+    w_hh_t = params["w_hh"].T  # (H, 4H)
+
+    if h0 is None:
+        h0 = jnp.zeros((batch, hidden), dtype)
+    if c0 is None:
+        c0 = jnp.zeros((batch, hidden), dtype)
+
+    def step(carry, xp_t):
+        h, c = carry
+        gates = xp_t + h @ w_hh_t
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c = f * c + i * g
+        h = o * jnp.tanh(c)
+        return (h, c), h
+
+    # scan over time: move T to the leading axis.
+    (h_t, c_t), outputs = lax.scan(
+        step, (h0, c0), jnp.swapaxes(x_proj, 0, 1), unroll=unroll
+    )
+    return jnp.swapaxes(outputs, 0, 1), (h_t, c_t)
+
+
+def gru_layer(params, x, h0=None, *, unroll: int = 1):
+    """Run one GRU layer over ``x`` of shape (B, T, in).
+
+    torch GRU semantics: ``n = tanh(x_n + b_in + r * (h @ w_hn.T + b_hn))``,
+    ``h' = (1 - z) * n + z * h`` - note the hidden-side bias sits *inside*
+    the ``r`` product, so it cannot be folded into the input projection.
+    """
+    batch, _, _ = x.shape
+    hidden = params["w_hh"].shape[1]
+    dtype = x.dtype
+
+    x_proj = jnp.einsum("bti,gi->btg", x, params["w_ih"]) + params["b_ih"]
+    w_hh_t = params["w_hh"].T  # (H, 3H)
+    b_hh = params["b_hh"]
+
+    if h0 is None:
+        h0 = jnp.zeros((batch, hidden), dtype)
+
+    def step(h, xp_t):
+        h_proj = h @ w_hh_t + b_hh
+        xr, xz, xn = jnp.split(xp_t, 3, axis=-1)
+        hr, hz, hn = jnp.split(h_proj, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        h = (1.0 - z) * n + z * h
+        return h, h
+
+    h_t, outputs = lax.scan(step, h0, jnp.swapaxes(x_proj, 0, 1), unroll=unroll)
+    return jnp.swapaxes(outputs, 0, 1), h_t
+
+
+# ---------------------------------------------------------------------------
+# Stacks
+# ---------------------------------------------------------------------------
+
+def init_stacked_rnn(
+    key,
+    input_size: int,
+    hidden_size: int,
+    num_layers: int,
+    cell: str = "lstm",
+    dtype=jnp.float32,
+):
+    """Params for a stacked RNN: list of per-layer dicts (layer 0 consumes
+    ``input_size``, the rest consume ``hidden_size``)."""
+    init_fn = {"lstm": init_lstm_layer, "gru": init_gru_layer}[cell]
+    keys = jax.random.split(key, num_layers)
+    return [
+        init_fn(keys[i], input_size if i == 0 else hidden_size, hidden_size, dtype)
+        for i in range(num_layers)
+    ]
+
+
+def stacked_rnn(
+    layers,
+    x,
+    cell: str = "lstm",
+    *,
+    dropout: float = 0.0,
+    dropout_key=None,
+    unroll: int = 1,
+):
+    """Apply a stack of RNN layers; dropout between layers (not after the
+    last), matching torch's stacked ``nn.LSTM(dropout=...)`` placement.
+
+    ``dropout_key=None`` selects eval/deterministic mode (the analogue of
+    torch's ``model.eval()``): dropout is skipped even when ``dropout > 0``.
+    Pass a PRNG key to enable train-mode dropout.
+
+    Returns (outputs (B, T, H), list of per-layer final carries).
+    """
+    finals = []
+    out = x
+    for idx, layer in enumerate(layers):
+        if cell == "lstm":
+            out, final = lstm_layer(layer, out, unroll=unroll)
+        elif cell == "gru":
+            out, final = gru_layer(layer, out, unroll=unroll)
+        else:
+            raise ValueError(f"unknown cell {cell!r}")
+        finals.append(final)
+        if dropout > 0.0 and dropout_key is not None and idx < len(layers) - 1:
+            dropout_key, sub = jax.random.split(dropout_key)
+            keep = 1.0 - dropout
+            mask = jax.random.bernoulli(sub, keep, out.shape)
+            out = jnp.where(mask, out / keep, 0.0)
+    return out, finals

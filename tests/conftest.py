@@ -1,0 +1,26 @@
+"""Test configuration: run everything on an 8-device virtual CPU mesh.
+
+This is the no-hardware fake cluster analogous to the reference's
+docker-compose master/slave pair (``/root/reference/docker-compose.yaml:3-27``)
+- multi-device on one machine stands in for multi-chip/multi-host.
+"""
+
+import os
+
+# Must be set before jax initializes its backends.  Force CPU even when the
+# ambient environment points at a TPU (JAX_PLATFORMS=axon): the test suite is
+# the no-hardware path.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# sitecustomize may have imported jax already (registering the TPU plugin),
+# freezing JAX_PLATFORMS before we could set it - override via config, which
+# takes effect as long as no backend has been initialized yet.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
