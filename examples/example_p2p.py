@@ -1,0 +1,36 @@
+#!/usr/bin/env python
+"""Point-to-point primitive smoke test.
+
+Capability parity with ``/root/reference/src/example/example_distributed.py``:
+rank 0's tensor (value 1.0) reaches every other rank; each rank prints
+``Rank  i  has data  1.0``.  TPU-native transport: ``lax.ppermute`` ring
+relay (XLA CollectivePermute over ICI) instead of MPI send/recv.
+"""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from pytorch_distributed_rnn_tpu.utils import apply_platform_overrides
+
+apply_platform_overrides()
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_rnn_tpu.parallel import make_mesh, ring_relay_from_root
+
+
+def run(mesh):
+    world = mesh.shape["dp"]
+    # rank 0 holds 1.0, everyone else 0.0 (the "tensor += 1 on rank 0")
+    values = jnp.where(jnp.arange(world)[:, None] == 0, 1.0, 0.0)
+    received = ring_relay_from_root(values, mesh)
+    for rank in range(world):
+        print("Rank ", rank, " has data ", float(received[rank, 0]))
+    assert bool(jnp.all(received == 1.0))
+    return received
+
+
+if __name__ == "__main__":
+    run(make_mesh())

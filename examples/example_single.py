@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""Single-process smoke test: one forward/backward/update, print params.
+
+Capability parity with ``/root/reference/src/example/example_single.py``:
+a lone Linear(10,10), MSE loss, SGD step, parameters printed at the end.
+"""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from pytorch_distributed_rnn_tpu.utils import apply_platform_overrides
+
+apply_platform_overrides()
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from pytorch_distributed_rnn_tpu.ops import linear_init, mse_loss
+
+
+def run(rank=0):
+    key = jax.random.PRNGKey(0)
+    pkey, xkey, ykey = jax.random.split(key, 3)
+    params = linear_init(pkey, 10, 10)
+    x = jax.random.normal(xkey, (20, 10))
+    labels = jax.random.normal(ykey, (20, 10))
+
+    def loss_fn(p):
+        pred = x @ p["weight"].T + p["bias"]
+        return mse_loss(pred, labels)
+
+    grads = jax.grad(loss_fn)(params)
+    params = optax.apply_updates(
+        params, jax.tree.map(lambda g: -0.001 * g, grads)
+    )
+    print(jax.tree.map(lambda p: p, params))
+
+
+if __name__ == "__main__":
+    run(0)

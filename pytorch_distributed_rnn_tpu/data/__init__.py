@@ -1,0 +1,17 @@
+from pytorch_distributed_rnn_tpu.data.dataset import MotionDataset
+from pytorch_distributed_rnn_tpu.data.loader import DataLoader
+from pytorch_distributed_rnn_tpu.data.processor import MotionDataProcessor
+from pytorch_distributed_rnn_tpu.data.sampler import DistributedSampler
+from pytorch_distributed_rnn_tpu.data.synthetic import (
+    generate_har_arrays,
+    write_synthetic_har_dataset,
+)
+
+__all__ = [
+    "MotionDataset",
+    "DataLoader",
+    "MotionDataProcessor",
+    "DistributedSampler",
+    "generate_har_arrays",
+    "write_synthetic_har_dataset",
+]

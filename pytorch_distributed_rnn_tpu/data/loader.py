@@ -1,0 +1,41 @@
+"""Minimal batch loader feeding numpy batches to jitted steps.
+
+The reference uses ``torch.utils.data.DataLoader`` with a sampler and an
+optional final partial batch (``/root/reference/src/motion/trainer/base.py:
+46-61``).  On TPU the equivalent is simple array slicing: batches are dense
+numpy slices handed to jit-compiled steps (XLA requires static shapes, so a
+partial final batch triggers exactly one extra compilation, cached across
+epochs).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pytorch_distributed_rnn_tpu.data.sampler import DistributedSampler
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, sampler=None, drop_last=False):
+        self.dataset = dataset
+        self.batch_size = batch_size if batch_size is not None else len(dataset)
+        self.sampler = sampler
+        self.drop_last = drop_last
+
+    def __iter__(self):
+        if self.sampler is not None:
+            indices = np.asarray(self.sampler.indices())
+        else:
+            indices = np.arange(len(self.dataset))
+        for start in range(0, len(indices), self.batch_size):
+            batch_idx = indices[start : start + self.batch_size]
+            if self.drop_last and len(batch_idx) < self.batch_size:
+                return
+            features, labels = self.dataset[batch_idx]
+            yield features, labels
+
+    def __len__(self):
+        n = len(self.sampler) if self.sampler is not None else len(self.dataset)
+        if self.drop_last:
+            return n // self.batch_size
+        return -(-n // self.batch_size)

@@ -1,0 +1,31 @@
+from pytorch_distributed_rnn_tpu.parallel.mesh import (
+    batch_sharding,
+    make_mesh,
+    replicated_sharding,
+)
+from pytorch_distributed_rnn_tpu.parallel.collectives import (
+    allgather_tree,
+    broadcast_from,
+    pmean_tree,
+    psum_tree,
+)
+from pytorch_distributed_rnn_tpu.parallel.dp import (
+    broadcast_params,
+    distributed_optimizer,
+    make_spmd_train_step,
+)
+from pytorch_distributed_rnn_tpu.parallel.p2p import ring_relay_from_root
+
+__all__ = [
+    "make_mesh",
+    "batch_sharding",
+    "replicated_sharding",
+    "allgather_tree",
+    "broadcast_from",
+    "pmean_tree",
+    "psum_tree",
+    "make_spmd_train_step",
+    "broadcast_params",
+    "distributed_optimizer",
+    "ring_relay_from_root",
+]

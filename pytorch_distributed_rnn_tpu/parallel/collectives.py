@@ -1,0 +1,40 @@
+"""Collective primitives over mesh axes, for use inside ``shard_map``.
+
+These are the XLA-native replacements for the reference's MPI/Horovod
+primitives (broadcast / allreduce / allgather - see
+``/root/reference/src/motion/trainer/ddp.py:18-19``,
+``example_horovod.py:42``): ``psum``/``pmean`` lower to XLA AllReduce over
+ICI/DCN, ``broadcast_from`` lowers to a masked AllReduce, ``all_gather`` to
+XLA AllGather.  They operate on whole parameter pytrees.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def psum_tree(tree, axis: str):
+    return jax.tree.map(lambda x: lax.psum(x, axis), tree)
+
+
+def pmean_tree(tree, axis: str):
+    return jax.tree.map(lambda x: lax.pmean(x, axis), tree)
+
+
+def broadcast_from(tree, axis: str, root: int = 0):
+    """Every shard receives ``root``'s values (hvd.broadcast_parameters
+    analogue).  Implemented as mask + psum: a single XLA AllReduce."""
+    idx = lax.axis_index(axis)
+
+    def _bcast(x):
+        mask = (idx == root).astype(x.dtype)
+        return lax.psum(x * mask, axis)
+
+    return jax.tree.map(_bcast, tree)
+
+
+def allgather_tree(tree, axis: str):
+    """Gather per-shard values along a new leading axis (rank order)."""
+    return jax.tree.map(lambda x: lax.all_gather(x, axis), tree)

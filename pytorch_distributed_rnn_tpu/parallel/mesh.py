@@ -1,0 +1,53 @@
+"""Device-mesh construction and common shardings.
+
+The reference's notion of "rank/world" comes from MPI process launch
+(``mpirun -np N``, ``/root/reference/fabfile.py:218-223``).  The TPU-native
+analogue is a ``jax.sharding.Mesh`` over the chips visible to this
+controller: one "rank" = one mesh position along the data-parallel axis, and
+rendezvous/collectives ride ICI/DCN through XLA instead of MPI over
+Ethernet.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(axes: dict[str, int] | None = None, devices=None) -> Mesh:
+    """Build a mesh.  ``axes`` maps axis names to sizes, e.g.
+    ``{"dp": 4, "tp": 2}``; a size of -1 means "all remaining devices".
+    Default: one ``dp`` axis over every visible device.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if axes is None:
+        axes = {"dp": len(devices)}
+
+    sizes = list(axes.values())
+    n_known = math.prod(s for s in sizes if s != -1)
+    if any(s == -1 for s in sizes):
+        if sum(s == -1 for s in sizes) > 1:
+            raise ValueError("at most one axis may have size -1")
+        remainder = len(devices) // n_known
+        sizes = [remainder if s == -1 else s for s in sizes]
+    total = math.prod(sizes)
+    if total > len(devices):
+        raise ValueError(
+            f"mesh {dict(zip(axes, sizes))} needs {total} devices, "
+            f"have {len(devices)}"
+        )
+    mesh_devices = np.array(devices[:total]).reshape(sizes)
+    return Mesh(mesh_devices, tuple(axes.keys()))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, axis: str = "dp") -> NamedSharding:
+    """Shard the leading (batch) dimension along ``axis``."""
+    return NamedSharding(mesh, P(axis))

@@ -1,0 +1,3 @@
+from pytorch_distributed_rnn_tpu.utils.platform import apply_platform_overrides
+
+__all__ = ["apply_platform_overrides"]

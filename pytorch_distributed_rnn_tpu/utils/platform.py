@@ -1,0 +1,35 @@
+"""Platform selection that works when jax was pre-imported at startup.
+
+Some environments (including this one) register a TPU PJRT plugin from
+``sitecustomize`` at interpreter start, which imports jax and freezes
+``JAX_PLATFORMS`` before user code runs - worse, exporting
+``JAX_PLATFORMS=cpu`` in the shell can hang the plugin's registration.  The
+reliable override is ``jax.config.update("jax_platforms", ...)`` before the
+first backend use.  This helper reads our own env vars and applies that:
+
+- ``PDRNN_PLATFORM=cpu`` forces the CPU backend.
+- ``PDRNN_NUM_CPU_DEVICES=8`` requests N virtual CPU devices (only honored
+  if XLA_FLAGS was not already forcing a count; must run before backend
+  init).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_platform_overrides():
+    platform = os.environ.get("PDRNN_PLATFORM")
+    n_cpu = os.environ.get("PDRNN_NUM_CPU_DEVICES")
+    if n_cpu and "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""
+    ):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n_cpu}"
+        ).strip()
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    return jax

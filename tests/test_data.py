@@ -1,0 +1,163 @@
+"""Data layer: processor shapes, x96 truncation, cache, sampler sharding.
+
+Mirrors the behaviors pinned in the reference
+(``/root/reference/src/motion/processor.py``, ``dataset.py``,
+``trainer/distributed.py:35-49``).
+"""
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_rnn_tpu.data import (
+    DataLoader,
+    DistributedSampler,
+    MotionDataset,
+    write_synthetic_har_dataset,
+)
+from pytorch_distributed_rnn_tpu.data.processor import MotionDataProcessor
+
+
+@pytest.fixture(scope="module")
+def har_dir(tmp_path_factory):
+    path = tmp_path_factory.mktemp("har")
+    # 250 train samples: after 5% validation split -> 238 -> truncates to 192
+    write_synthetic_har_dataset(path, num_train=250, num_test=40, seq_length=32)
+    return path
+
+
+class TestProcessor:
+    def test_shapes_and_truncation(self, har_dir):
+        proc = MotionDataProcessor(seed=0)
+        (X_tr, y_tr), (X_va, y_va), (X_te, y_te) = proc.process_data(har_dir)
+        assert X_tr.shape[1:] == (32, 9) and X_tr.dtype == np.float32
+        assert len(X_tr) % 96 == 0  # x96 truncation (processor.py:63-66)
+        assert len(X_va) == int(250 * 0.05)
+        assert len(X_te) == 40
+        assert y_tr.min() >= 0 and y_tr.max() <= 5  # 0-based labels
+        assert y_tr.shape == (len(X_tr), 1) and y_tr.dtype == np.int64
+
+    def test_split_deterministic_with_seed(self, har_dir):
+        a = MotionDataProcessor(seed=7).process_data(har_dir)
+        b = MotionDataProcessor(seed=7).process_data(har_dir)
+        np.testing.assert_array_equal(a[0][0], b[0][0])
+        c = MotionDataProcessor(seed=8).process_data(har_dir)
+        assert not np.array_equal(a[0][0], c[0][0])
+
+
+class TestDatasetCache:
+    def test_load_preprocesses_then_caches(self, har_dir, tmp_path):
+        out = tmp_path / "cache"
+        train, valid, test = MotionDataset.load(har_dir, output_path=out, seed=1)
+        assert (out / "X_train.npy").exists() and (out / "y_test.npy").exists()
+        assert train.seq_length == 32 and train.num_features == 9
+        assert len(MotionDataset.LABELS) == 6
+
+        # second load from the cache dir returns identical data
+        train2, valid2, test2 = MotionDataset.load(out)
+        np.testing.assert_array_equal(train.features, train2.features)
+        np.testing.assert_array_equal(valid.labels, valid2.labels)
+
+    def test_partial_cache_triggers_preprocessing(self, har_dir, tmp_path):
+        out = tmp_path / "cache"
+        MotionDataset.load(har_dir, output_path=out, seed=1)
+        (out / "X_validation.npy").unlink()
+        # incomplete cache in base_path -> must preprocess raw data again;
+        # har_dir has the raw files, out does not, so loading from out alone
+        # would fail if it tried; loading from har_dir+out must regenerate.
+        train, valid, test = MotionDataset.load(har_dir, output_path=out, seed=1)
+        assert (out / "X_validation.npy").exists()
+
+
+class TestDistributedSampler:
+    def test_shards_are_disjoint_and_cover(self):
+        n, world = 100, 4
+        shards = [
+            DistributedSampler(n, world, rank, seed=3).indices() for rank in range(world)
+        ]
+        assert all(len(s) == 25 for s in shards)
+        union = np.concatenate(shards)
+        assert set(union.tolist()) == set(range(n))
+
+    def test_padding_wraps(self):
+        n, world = 10, 4  # ceil -> 3 each, total 12, padding 2
+        shards = [
+            DistributedSampler(n, world, rank, shuffle=False).indices()
+            for rank in range(world)
+        ]
+        assert all(len(s) == 3 for s in shards)
+        flat = sorted(np.concatenate(shards).tolist())
+        assert flat == sorted(list(range(10)) + [0, 1])
+
+    def test_matches_torch_distributed_sampler_structure(self):
+        """Same num_samples/total_size math and rank-strided layout as
+        torch.utils.data.DistributedSampler."""
+        import torch
+        from torch.utils.data import DistributedSampler as TorchSampler
+
+        class _Sized(torch.utils.data.Dataset):
+            def __len__(self):
+                return 37
+
+            def __getitem__(self, i):
+                return i
+
+        for world in (1, 2, 4, 8):
+            for rank in range(world):
+                torch_s = TorchSampler(_Sized(), world, rank, shuffle=False)
+                ours = DistributedSampler(37, world, rank, shuffle=False)
+                assert len(ours) == len(torch_s)
+                np.testing.assert_array_equal(ours.indices(), list(iter(torch_s)))
+
+    def test_set_epoch_reshuffles_deterministically(self):
+        s = DistributedSampler(50, 2, 0, seed=5)
+        e0 = s.indices()
+        s.set_epoch(1)
+        e1 = s.indices()
+        assert not np.array_equal(e0, e1)
+        s.set_epoch(0)
+        np.testing.assert_array_equal(s.indices(), e0)
+
+    def test_all_ranks_agree_on_permutation(self):
+        perms = []
+        for rank in range(4):
+            s = DistributedSampler(48, 4, rank, seed=9)
+            s.set_epoch(3)
+            perms.append(s.indices())
+        union = sorted(np.concatenate(perms).tolist())
+        assert union == list(range(48))
+
+    def test_invalid_rank_raises(self):
+        with pytest.raises(ValueError):
+            DistributedSampler(10, 2, 2)
+
+
+class TestDataLoader:
+    def test_batching_with_partial_final(self, har_dir):
+        train, _, _ = MotionDataset.load(har_dir)
+        loader = DataLoader(train, batch_size=100)
+        batches = list(loader)
+        assert len(batches) == len(loader)
+        sizes = [len(b[0]) for b in batches]
+        assert sizes[:-1] == [100] * (len(sizes) - 1)
+        assert sum(sizes) == len(train)
+
+    def test_drop_last(self):
+        X, y = np.arange(10).reshape(10, 1, 1).astype(np.float32), np.zeros((10, 1))
+        ds = MotionDataset(X[:, :, None].squeeze(-1), y)
+        loader = DataLoader(ds, batch_size=4, drop_last=True)
+        assert [len(b[0]) for b in loader] == [4, 4]
+
+    def test_sampler_integration(self):
+        X, y = np.random.randn(24, 4, 9).astype(np.float32), np.zeros((24, 1))
+        ds = MotionDataset(X, y)
+        seen = []
+        for rank in range(2):
+            loader = DataLoader(
+                ds, batch_size=6, sampler=DistributedSampler(24, 2, rank, seed=1)
+            )
+            for feats, _ in loader:
+                assert feats.shape == (6, 4, 9)
+                seen.append(feats)
+        # both ranks together covered all 24 samples exactly once
+        all_feats = np.concatenate(seen)
+        assert all_feats.shape[0] == 24
