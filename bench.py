@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Headline benchmark: motion-LSTM training throughput (seq/sec).
+
+Reproduces the reference's benchmark workload (BASELINE.md: UCI HAR motion
+LSTM 2x32 + FC, 6912 train sequences of 128 steps x 9 features, 1 epoch,
+Adam lr 0.0025, seed 123456789, no validation - sweep definition
+``/root/reference/fabfile.py:48-66``) on whatever accelerator is attached,
+and prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": "seq/s", "vs_baseline": N}
+
+``vs_baseline`` is measured against the reference re-run on this container
+class's x86 CPU: 1931 seq/s at batch 1440 (BASELINE.md "Re-run baseline").
+
+The timed region matches the reference's methodology (wall-clock around the
+epoch loop, ``base.py:93-96``) but excludes one-time XLA compilation: a
+warm-up epoch runs first (the reference's eager PyTorch has no compile
+phase, so including ours would compare compilers, not training).
+"""
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+from pytorch_distributed_rnn_tpu.utils import apply_platform_overrides
+
+apply_platform_overrides()
+
+import numpy as np
+
+BASELINE_SEQ_PER_SEC = 1931.0  # reference local trainer, bs=1440, this host class
+NUM_SEQUENCES = 6912
+SEQ_LEN = 128
+NUM_FEATURES = 9
+BATCH_SIZE = 1440
+SEED = 123456789
+
+
+def main():
+    from pytorch_distributed_rnn_tpu.data import MotionDataset
+    from pytorch_distributed_rnn_tpu.data.synthetic import generate_har_arrays
+    from pytorch_distributed_rnn_tpu.models import MotionModel
+    from pytorch_distributed_rnn_tpu.training import Trainer
+
+    X, y = generate_har_arrays(NUM_SEQUENCES, SEQ_LEN, NUM_FEATURES, seed=0)
+    train_set = MotionDataset(X, y)
+
+    model = MotionModel(input_dim=NUM_FEATURES, hidden_dim=32, layer_dim=2,
+                        output_dim=6)
+    trainer = Trainer(
+        model, train_set, batch_size=BATCH_SIZE, learning_rate=0.0025, seed=SEED
+    )
+
+    trainer.train(epochs=1)  # warm-up: compile both batch shapes
+
+    epochs = 3
+    start = time.perf_counter()
+    trainer.train(epochs=epochs)
+    duration = time.perf_counter() - start
+
+    seq_per_sec = epochs * NUM_SEQUENCES / duration
+    print(
+        json.dumps(
+            {
+                "metric": "motion-LSTM train throughput (bs=1440, 1 chip)",
+                "value": round(seq_per_sec, 1),
+                "unit": "seq/s",
+                "vs_baseline": round(seq_per_sec / BASELINE_SEQ_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -46,16 +46,25 @@ class DistributedSampler:
 
     def indices(self) -> np.ndarray:
         """This rank's sample indices for the current epoch."""
+        return self.global_indices()[self.rank]
+
+    def global_indices(self) -> np.ndarray:
+        """All ranks' shards as one (num_replicas, num_samples) matrix
+        (row r == the ``indices()`` a rank-r sampler would produce).  Used
+        by the single-controller SPMD trainers to assemble rank-major
+        global batches."""
         if self.shuffle:
             rng = np.random.RandomState(self.seed + self.epoch)
             order = rng.permutation(self.dataset_size)
         else:
             order = np.arange(self.dataset_size)
-        # pad by wrapping so total divides evenly (torch semantics)
         padding = self.total_size - self.dataset_size
         if padding > 0:
-            order = np.concatenate([order, order[:padding]])
-        return order[self.rank :: self.num_replicas]
+            # torch semantics: repeat the permutation as often as needed
+            # (covers datasets smaller than the replica count)
+            reps = -(-padding // len(order))
+            order = np.concatenate([order, np.tile(order, reps)[:padding]])
+        return order.reshape(self.num_samples, self.num_replicas).T
 
     def __iter__(self):
         return iter(self.indices())

@@ -1,0 +1,35 @@
+"""Parameter-server strategy: coordinator-owned params, remote updates.
+
+Capability parity target: the reference's RPC parameter server
+(``/root/reference/src/motion/param_server/__init__.py:11-37`` CLI surface:
+``parameter-server --world-size --rank --master-address --master-port``).
+The TPU-native design replaces torch RPC + distributed autograd with the
+framework's native C++ TCP transport (``runtime/``): the master process
+owns parameters and Adam state; workers compute local gradients and push
+them / pull fresh params.
+
+Implementation lands with the runtime milestone; the CLI surface is
+registered now so the subcommand set matches the reference.
+"""
+
+from __future__ import annotations
+
+
+def add_sub_command(sub_parser):
+    parser = sub_parser.add_parser("parameter-server")
+    parser.add_argument("--world-size", type=int, default=2)
+    parser.add_argument("--rank", type=int, default=None)
+    parser.add_argument("--master-address", type=str, default="localhost")
+    parser.add_argument("--master-port", type=str, default="29500")
+    parser.set_defaults(func=execute)
+
+
+def execute(args):
+    try:
+        from pytorch_distributed_rnn_tpu.param_server.runner import run
+    except ImportError as exc:
+        raise SystemExit(
+            "the parameter-server strategy is not implemented yet "
+            "(it lands with the native runtime milestone)"
+        ) from exc
+    return run(args)

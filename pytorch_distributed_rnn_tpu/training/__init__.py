@@ -1,0 +1,95 @@
+"""Trainer registry: strategy selection by CLI subcommand.
+
+Mirrors the reference's inversion (``/root/reference/src/motion/trainer/
+__init__.py:10-18``): subcommands map to Trainer classes; everything else -
+dataset loading, model construction, training, history dump - is shared.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+from pytorch_distributed_rnn_tpu.data import MotionDataset
+from pytorch_distributed_rnn_tpu.models import MotionModel
+from pytorch_distributed_rnn_tpu.training.base import Trainer
+from pytorch_distributed_rnn_tpu.training.distributed import (
+    DDPTrainer,
+    HorovodTrainer,
+    SpmdTrainer,
+)
+
+__all__ = [
+    "Trainer",
+    "SpmdTrainer",
+    "DDPTrainer",
+    "HorovodTrainer",
+    "add_sub_commands",
+    "train",
+]
+
+
+def add_sub_commands(sub_parser):
+    for name, cls in (
+        ("local", Trainer),
+        ("distributed", DDPTrainer),
+        ("horovod", HorovodTrainer),
+    ):
+        parser = sub_parser.add_parser(name)
+        parser.set_defaults(func=lambda args, cls=cls: train(args, cls))
+
+
+def train(args, trainer_class):
+    # basicConfig (not just setLevel): module-level loggers like the
+    # dataset's need a root handler installed or their records vanish into
+    # logging.lastResort at WARNING.
+    logging.basicConfig(level=args.log)
+    logging.getLogger().setLevel(args.log)
+
+    training_set, validation_set, test_set = MotionDataset.load(
+        args.dataset_path,
+        output_path=args.output_path,
+        validation_fraction=args.validation_fraction,
+        seed=args.seed,
+    )
+
+    logging.info(f"Training set of size {len(training_set)}")
+    if args.no_validation:
+        validation_set = None
+        test_set = None
+    else:
+        logging.info(f"Validation set of size {len(validation_set)}")
+        logging.info(f"Test set of size {len(test_set)}")
+
+    model = MotionModel(
+        input_dim=training_set.num_features,
+        hidden_dim=args.hidden_units,
+        layer_dim=args.stacked_layer,
+        output_dim=len(MotionDataset.LABELS),
+        cell=getattr(args, "cell", "lstm"),
+    )
+
+    trainer = trainer_class(
+        model=model,
+        training_set=training_set,
+        validation_set=validation_set,
+        test_set=test_set,
+        batch_size=args.batch_size,
+        learning_rate=args.learning_rate,
+        checkpoint_dir=args.checkpoint_directory,
+        seed=args.seed,
+    )
+
+    if getattr(args, "resume", None):
+        meta = trainer.resume_from(args.resume)
+        logging.info(f"Resumed from {args.resume} at epoch {meta['epoch']}")
+
+    logging.info(f"Training model for {args.epochs} epochs...")
+    _, train_history, validation_history = trainer.train(epochs=args.epochs)
+    history = {
+        "train_history": train_history,
+        "validation_history": validation_history,
+    }
+    with open("history.json", "w") as file:
+        json.dump(history, file)
+    return trainer

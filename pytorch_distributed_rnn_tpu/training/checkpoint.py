@@ -1,0 +1,70 @@
+"""Checkpoint save/load for params + optimizer state pytrees.
+
+Capability parity with the reference's ``_save_checkpoint``
+(``/root/reference/src/motion/trainer/base.py:164-177``): a checkpoint
+bundles ``{epoch, model_state, optimizer_state, loss}``, written as
+``best-model.ckpt`` on a new best validation loss or
+``checkpoint-epoch-N.ckpt`` otherwise.
+
+New capability the reference lacks (its checkpoints are write-only,
+SURVEY §5): ``load_checkpoint`` restores params/optimizer state into
+templates so training can RESUME.
+
+Format: one binary file - a JSON header line with metadata and section
+lengths, followed by two flax-msgpack sections (model state, optimizer
+state).  Portable and pickle-free.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import jax
+import numpy as np
+from flax import serialization
+
+
+def _to_host(tree):
+    return jax.tree.map(np.asarray, tree)
+
+
+def save_checkpoint(
+    checkpoint_dir, epoch: int, params, opt_state, loss: float, best: bool = False
+) -> Path:
+    """Write a checkpoint; returns the path."""
+    checkpoint_dir = Path(checkpoint_dir)
+    checkpoint_dir.mkdir(parents=True, exist_ok=True)
+    name = "best-model.ckpt" if best else f"checkpoint-epoch-{epoch + 1}.ckpt"
+    path = checkpoint_dir / name
+
+    model_bytes = serialization.to_bytes(_to_host(params))
+    opt_bytes = serialization.to_bytes(_to_host(opt_state))
+    header = json.dumps(
+        {
+            "epoch": epoch + 1,
+            "loss": float(loss),
+            "model_len": len(model_bytes),
+            "opt_len": len(opt_bytes),
+        }
+    ).encode()
+    with open(path, "wb") as f:
+        f.write(header + b"\n")
+        f.write(model_bytes)
+        f.write(opt_bytes)
+    return path
+
+
+def load_checkpoint(path, params_template, opt_state_template):
+    """Restore ``(params, opt_state, meta)`` from ``path``.
+
+    Templates supply the pytree structure (the trainer's freshly
+    initialized params/optimizer state).
+    """
+    with open(path, "rb") as f:
+        header = json.loads(f.readline().decode())
+        model_bytes = f.read(header["model_len"])
+        opt_bytes = f.read(header["opt_len"])
+    params = serialization.from_bytes(params_template, model_bytes)
+    opt_state = serialization.from_bytes(opt_state_template, opt_bytes)
+    return params, opt_state, {"epoch": header["epoch"], "loss": header["loss"]}

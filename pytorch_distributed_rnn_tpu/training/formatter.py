@@ -1,0 +1,59 @@
+"""Log-message formatter with the reference's machine-readable contracts.
+
+The perf line format is a hard compatibility requirement: the reference's
+evaluation notebooks regex-parse
+``'{rank}: Memory Usage: {m}, Training Duration: {d}'`` out of captured
+stderr (``/root/reference/src/motion/trainer/formatter.py:27``,
+``evaluation/Experiments.ipynb`` cell 2), and the launcher archives that
+stderr into results JSONs.  The other message shapes mirror
+``formatter.py:6-24`` so human-readable logs stay comparable.
+"""
+
+from __future__ import annotations
+
+
+def _pct(current, overall) -> float:
+    return 100.0 * (current / overall)
+
+
+class TrainingMessageFormatter:
+    def __init__(self, num_epochs: int, rank: int = 0):
+        self.num_epochs = num_epochs
+        self.rank = rank
+
+    def epoch_start_message(self, epoch: int) -> str:
+        return f"Rank: {self.rank:02d}   Start Epoch {epoch}"
+
+    def train_progress_message(
+        self, batch_idx, batches, training_examples, correct, loss
+    ) -> str:
+        batch_idx += 1
+        return (
+            f"Rank: {self.rank:02d}   "
+            f"Train Batch: {batch_idx}/{batches} ({_pct(batch_idx, batches):.0f}%)\t"
+            f"Loss: {loss:.6f}\t"
+            f"Acc: {correct}/{training_examples} "
+            f"({_pct(float(correct), training_examples):.0f}%)"
+        )
+
+    def evaluation_message(
+        self, accuracy, examples, epoch, eval_loss, total_correct
+    ) -> str:
+        metrics = (
+            f"Loss: {eval_loss:.4f}\t "
+            f"Accuracy: {total_correct}/{examples} ({100.0 * accuracy:.0f}%)\n"
+        )
+        if epoch is None:
+            prefix = "Test Evaluation:\t"
+        else:
+            epoch += 1
+            prefix = (
+                f"Evaluation Epoch: {epoch}/{self.num_epochs} "
+                f"({_pct(epoch, self.num_epochs):.0f}%)\t"
+            )
+        return prefix + metrics
+
+    def performance_message(self, memory, duration) -> str:
+        # Parsed downstream as r'(\d+): Memory Usage: (\d+\.\d+), Training
+        # Duration: (\d+\.\d+)' - keep byte-compatible.
+        return f"{self.rank}: Memory Usage: {memory}, Training Duration: {duration}"

@@ -1,0 +1,66 @@
+"""Peak-memory and wall-clock measurement around the training loop.
+
+Capability parity with the reference's use of
+``memory_profiler.memory_usage((train_inner, ...))`` + ``time.perf_counter``
+(``/root/reference/src/motion/trainer/base.py:93-96``): run a callable,
+sample peak RSS while it runs, return (result, peak_mb, seconds).
+
+TPU-native differences: no external dependency - a sampler thread reads
+``/proc/self/status`` VmRSS directly - and, when the backend exposes it,
+device HBM peaks from ``device.memory_stats()`` are collected too (RSS alone
+says nothing about accelerator footprint).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+def _rss_mb() -> float:
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return float(line.split()[1]) / 1024.0  # kB -> MiB
+    except OSError:
+        pass
+    return 0.0
+
+
+def device_memory_peaks_mb() -> dict:
+    """Per-device peak HBM in MiB, where the PJRT backend reports it."""
+    import jax
+
+    peaks = {}
+    for device in jax.local_devices():
+        try:
+            stats = device.memory_stats()
+        except Exception:
+            continue
+        if stats and "peak_bytes_in_use" in stats:
+            peaks[str(device)] = stats["peak_bytes_in_use"] / (1024.0 * 1024.0)
+    return peaks
+
+
+def measure_memory_and_time(fn, interval: float = 0.1):
+    """Run ``fn()``; return ``(result, peak_rss_mb, duration_seconds)``."""
+    peak = [_rss_mb()]
+    stop = threading.Event()
+
+    def sample():
+        while not stop.is_set():
+            peak[0] = max(peak[0], _rss_mb())
+            stop.wait(interval)
+
+    sampler = threading.Thread(target=sample, daemon=True)
+    start = time.perf_counter()
+    sampler.start()
+    try:
+        result = fn()
+    finally:
+        stop.set()
+        sampler.join(timeout=2.0)
+    duration = time.perf_counter() - start
+    peak[0] = max(peak[0], _rss_mb())
+    return result, peak[0], duration

@@ -130,6 +130,13 @@ class TestDistributedSampler:
         with pytest.raises(ValueError):
             DistributedSampler(10, 2, 2)
 
+    def test_dataset_smaller_than_world(self):
+        # padding > dataset_size: permutation must repeat (torch semantics)
+        shards = [DistributedSampler(3, 8, r, shuffle=False).indices() for r in range(8)]
+        assert all(len(s) == 1 for s in shards)
+        flat = np.concatenate(shards)
+        assert set(flat.tolist()) == {0, 1, 2}
+
 
 class TestDataLoader:
     def test_batching_with_partial_final(self, har_dir):
