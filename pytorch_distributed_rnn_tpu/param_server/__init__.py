@@ -8,8 +8,11 @@ framework's native C++ TCP transport (``runtime/``): the master process
 owns parameters and Adam state; workers compute local gradients and push
 them / pull fresh params.
 
-Implementation lands with the runtime milestone; the CLI surface is
-registered now so the subcommand set matches the reference.
+Run the whole world on one machine (fake-cluster pattern) by omitting
+``--rank``:
+
+  python -m pytorch_distributed_rnn_tpu.main --epochs 2 parameter-server \
+      --world-size 3
 """
 
 from __future__ import annotations
@@ -19,17 +22,19 @@ def add_sub_command(sub_parser):
     parser = sub_parser.add_parser("parameter-server")
     parser.add_argument("--world-size", type=int, default=2)
     parser.add_argument("--rank", type=int, default=None)
-    parser.add_argument("--master-address", type=str, default="localhost")
+    parser.add_argument("--master-address", type=str, default="127.0.0.1")
     parser.add_argument("--master-port", type=str, default="29500")
+    parser.add_argument(
+        "--ps-mode",
+        choices=["async", "sync"],
+        default="async",
+        help="async: apply each worker's gradient on arrival (reference-"
+        "style); sync: average one gradient per worker per step",
+    )
     parser.set_defaults(func=execute)
 
 
 def execute(args):
-    try:
-        from pytorch_distributed_rnn_tpu.param_server.runner import run
-    except ImportError as exc:
-        raise SystemExit(
-            "the parameter-server strategy is not implemented yet "
-            "(it lands with the native runtime milestone)"
-        ) from exc
+    from pytorch_distributed_rnn_tpu.param_server.runner import run
+
     return run(args)

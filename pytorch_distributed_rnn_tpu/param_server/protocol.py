@@ -1,0 +1,54 @@
+"""Wire protocol for the parameter-server strategy.
+
+Tiny fixed-header messages over the native TCP transport
+(``runtime.Communicator``).  The reference used torch RPC with pickled
+tensors and distributed autograd (``/root/reference/src/motion/
+param_server/util.py:23-25``); here the state that crosses the wire is
+explicit: flat float32 parameter/gradient vectors plus a scalar header.
+
+Messages (worker -> master):
+  PULL    - request current flat params
+  PUSH    - gradient vector; master replies with fresh params
+  DONE    - worker finished all epochs
+
+Master replies to PULL/PUSH with the current flat parameter vector.  Loss
+stays local to the worker (shipping it per batch would force a host sync
+on the worker's device loss scalar for a value the master never needs).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+OP_PULL = 1
+OP_PUSH = 2
+OP_DONE = 3
+
+_HEADER_DTYPE = np.float32
+_HEADER_LEN = 1  # [opcode]
+
+
+def send_request(comm, opcode: int, grads: np.ndarray = None):
+    header = np.array([float(opcode)], dtype=_HEADER_DTYPE)
+    comm.send(0, header)
+    if opcode == OP_PUSH:
+        comm.send(0, grads.astype(np.float32, copy=False))
+
+
+def recv_request(comm, worker: int, num_params: int):
+    """Master side: receive one request from ``worker``.
+    Returns (opcode, grads-or-None)."""
+    header = comm.recv(worker, (_HEADER_LEN,), np.float32)
+    opcode = int(header[0])
+    grads = None
+    if opcode == OP_PUSH:
+        grads = comm.recv(worker, (num_params,), np.float32)
+    return opcode, grads
+
+
+def send_params(comm, worker: int, flat_params: np.ndarray):
+    comm.send(worker, flat_params.astype(np.float32, copy=False))
+
+
+def recv_params(comm, num_params: int) -> np.ndarray:
+    return comm.recv(0, (num_params,), np.float32)

@@ -1,0 +1,174 @@
+"""Parameter-server launcher: master + worker process orchestration.
+
+Capability parity with ``/root/reference/src/motion/param_server/
+__init__.py:40-73``: sets the MASTER_ADDR/MASTER_PORT-style rendezvous,
+runs rank 0 as the parameter-server master and ranks >0 as one worker
+process each.  Like the reference, a single invocation launches the role
+for ITS rank (one process per node); additionally, omitting ``--rank``
+spawns the whole world locally via multiprocessing - the single-machine
+fake-cluster pattern (SURVEY §4.2).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import multiprocessing as mp
+
+import jax
+import numpy as np
+import optax
+from jax.flatten_util import ravel_pytree
+
+from pytorch_distributed_rnn_tpu.runtime import Communicator
+
+log = logging.getLogger(__name__)
+
+
+def _build_model_and_flat_params(args, num_features: int, seed):
+    from pytorch_distributed_rnn_tpu.data import MotionDataset
+    from pytorch_distributed_rnn_tpu.models import MotionModel
+
+    model = MotionModel(
+        input_dim=num_features,
+        hidden_dim=args.hidden_units,
+        layer_dim=args.stacked_layer,
+        output_dim=len(MotionDataset.LABELS),
+        cell=getattr(args, "cell", "lstm"),
+    )
+    params = model.init(jax.random.PRNGKey(seed if seed is not None else 0))
+    flat, unravel = ravel_pytree(params)
+    return model, np.asarray(flat, np.float32), unravel
+
+
+def _load_datasets(args):
+    from pytorch_distributed_rnn_tpu.data import MotionDataset
+
+    return MotionDataset.load(
+        args.dataset_path,
+        output_path=args.output_path,
+        validation_fraction=args.validation_fraction,
+        seed=args.seed,
+    )
+
+
+def run_master(args):
+    from pytorch_distributed_rnn_tpu.param_server.master import (
+        ParameterServerMaster,
+    )
+
+    logging.basicConfig(level=args.log)
+    training_set, _, _ = _load_datasets(args)
+    _, flat, unravel = _build_model_and_flat_params(
+        args, training_set.num_features, args.seed
+    )
+
+    optimizer = optax.adam(args.learning_rate)
+    opt_state = optimizer.init(unravel(flat))
+
+    @jax.jit
+    def _update(flat_params, opt_state, flat_grads):
+        params = unravel(flat_params)
+        grads = unravel(flat_grads)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        new_flat, _ = ravel_pytree(new_params)
+        return new_flat, opt_state
+
+    state = {"flat": flat, "opt": opt_state}
+
+    def apply_update(flat_grads):
+        new_flat, new_opt = _update(state["flat"], state["opt"], flat_grads)
+        state["flat"] = np.asarray(new_flat, np.float32)
+        state["opt"] = new_opt
+        return state["flat"]
+
+    comm = Communicator(
+        args.master_address, int(args.master_port), 0, args.world_size
+    )
+    try:
+        master = ParameterServerMaster(
+            comm, flat, apply_update, sync_mode=(args.ps_mode == "sync")
+        )
+        final = master.serve()
+    finally:
+        comm.close()
+    return final
+
+
+def run_worker(args, rank: int):
+    from pytorch_distributed_rnn_tpu.param_server.worker import (
+        ParameterServerWorkerTrainer,
+    )
+
+    logging.basicConfig(level=args.log)
+    # rendezvous BEFORE loading data: the master preprocesses first and
+    # writes the cache, so workers (released only once the master's side of
+    # the rendezvous exists) read the warm cache instead of racing to
+    # preprocess the same files
+    comm = Communicator(
+        args.master_address, int(args.master_port), rank, args.world_size
+    )
+    training_set, _, _ = _load_datasets(args)
+    model, _, _ = _build_model_and_flat_params(
+        args, training_set.num_features, args.seed
+    )
+    try:
+        trainer = ParameterServerWorkerTrainer(
+            comm,
+            model,
+            training_set,
+            batch_size=args.batch_size,
+            learning_rate=args.learning_rate,
+            worker_rank=rank,
+            num_workers=args.world_size - 1,
+            seed=args.seed,
+        )
+        _, train_history, _ = trainer.train(epochs=args.epochs)
+        trainer.finish()
+    finally:
+        comm.close()
+
+    if rank == 1:
+        with open("history.json", "w") as file:
+            json.dump(
+                {"train_history": train_history, "validation_history": []}, file
+            )
+    return train_history
+
+
+def _spawn_entry(args, rank):
+    # force CPU in spawned children: each child would otherwise race to
+    # claim the single local accelerator
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+    if rank == 0:
+        run_master(args)
+    else:
+        run_worker(args, rank)
+
+
+def run(args):
+    if args.world_size < 2:
+        raise SystemExit("parameter-server needs --world-size >= 2")
+    if args.rank is not None:
+        # one role per invocation (multi-node layout)
+        if args.rank == 0:
+            return run_master(args)
+        return run_worker(args, args.rank)
+
+    # local mode: spawn the whole world (fake-cluster pattern)
+    ctx = mp.get_context("spawn")
+    procs = [
+        ctx.Process(target=_spawn_entry, args=(args, rank))
+        for rank in range(args.world_size)
+    ]
+    for p in procs:
+        p.start()
+    for p in procs:
+        p.join()
+    failed = [p.exitcode for p in procs if p.exitcode != 0]
+    if failed:
+        raise SystemExit(f"parameter-server processes failed: {failed}")
+    return 0

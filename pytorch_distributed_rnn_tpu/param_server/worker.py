@@ -1,0 +1,101 @@
+"""Parameter-server worker: local data + gradients, remote parameters.
+
+Capability parity with the reference worker
+(``/root/reference/src/motion/param_server/worker.py:18-94``): the worker
+trainer keeps the data pipeline and loss computation; parameters and the
+optimizer live on the master.  Where the reference routed every forward
+through an RPC to the master and span the backward graph across both
+processes via distributed autograd, the TPU-native worker computes forward
+AND backward locally as one jitted XLA program (the accelerator is on the
+worker - shipping activations over RPC per batch would starve it), then
+pushes the flat gradient and receives fresh parameters.  Evaluation and
+checkpointing are disabled on workers like the reference
+(``worker.py:67-75``).
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import numpy as np
+from jax.flatten_util import ravel_pytree
+
+from pytorch_distributed_rnn_tpu.data.sampler import DistributedSampler
+from pytorch_distributed_rnn_tpu.param_server import protocol
+from pytorch_distributed_rnn_tpu.training.base import Trainer
+from pytorch_distributed_rnn_tpu.training.formatter import TrainingMessageFormatter
+
+log = logging.getLogger(__name__)
+
+
+class ParameterServerWorkerTrainer(Trainer):
+    """Trainer whose optimizer step happens on the master."""
+
+    def __init__(
+        self,
+        comm,
+        model,
+        training_set,
+        batch_size: int,
+        learning_rate: float,
+        worker_rank: int,
+        num_workers: int,
+        seed: int | None = None,
+    ):
+        sampler = DistributedSampler(
+            len(training_set),
+            num_replicas=num_workers,
+            rank=worker_rank - 1,
+            seed=seed or 0,
+        )
+        super().__init__(
+            model=model,
+            training_set=training_set,
+            # global-batch semantics: each worker loads its share
+            batch_size=max(1, batch_size // num_workers),
+            learning_rate=learning_rate,
+            validation_set=None,  # eval disabled on PS workers (reference parity)
+            test_set=None,
+            checkpoint_dir=None,  # checkpointing disabled on PS workers
+            sampler=sampler,
+            seed=seed,
+        )
+        self.comm = comm
+        self.worker_rank = worker_rank
+        self.num_workers = num_workers
+        flat, self._unravel = ravel_pytree(self.params)
+        self.num_params = int(flat.size)
+
+        # initial pull: adopt the master's authoritative parameters
+        # (hvd.broadcast_parameters / DDP-wrap analogue for the PS world)
+        protocol.send_request(self.comm, protocol.OP_PULL)
+        self._adopt(protocol.recv_params(self.comm, self.num_params))
+
+    def _adopt(self, flat_params: np.ndarray):
+        assert flat_params.size == self.num_params, "parameter size mismatch"
+        self.params = self._unravel(jax.numpy.asarray(flat_params))
+
+    def _get_formatter(self, epochs):
+        return TrainingMessageFormatter(epochs, self.worker_rank)
+
+    def _build_train_step(self):
+        """Local fused forward+backward; the update is remote."""
+        grad_fn = jax.jit(
+            jax.value_and_grad(self._loss_and_metrics, has_aux=True)
+        )
+
+        def step(params, opt_state, batch):
+            (loss, metrics), grads = grad_fn(params, batch)
+            flat_grads, _ = ravel_pytree(grads)
+            protocol.send_request(
+                self.comm, protocol.OP_PUSH, grads=np.asarray(flat_grads)
+            )
+            new_flat = protocol.recv_params(self.comm, self.num_params)
+            self._adopt(new_flat)
+            return self.params, opt_state, loss, metrics
+
+        return step
+
+    def finish(self):
+        protocol.send_request(self.comm, protocol.OP_DONE)

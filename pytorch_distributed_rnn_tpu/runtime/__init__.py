@@ -1,0 +1,7 @@
+from pytorch_distributed_rnn_tpu.runtime.native import (
+    Communicator,
+    build_native_library,
+    init_from_env,
+)
+
+__all__ = ["Communicator", "build_native_library", "init_from_env"]

@@ -1,0 +1,387 @@
+// Native TCP collective/communicator library: the framework's host-side
+// transport layer.
+//
+// Role (capability parity with the reference's native layer, SURVEY.md
+// §2.8): the reference leans on source-built OpenMPI + torch c10d
+// ProcessGroupMPI for broadcast/allreduce/send-recv between processes, and
+// on torch RPC over TCP for its parameter server.  On-TPU collectives in
+// this framework ride XLA (psum/ppermute over ICI); THIS library is the
+// CPU/host-side analogue of Gloo/MPI - it lets every distributed test,
+// multi-process launch, and the parameter-server strategy run on plain
+// sockets with no accelerator or MPI install, and doubles as the wire
+// transport for coordinator RPC.
+//
+// Design:
+//  - rendezvous: rank 0 listens on (addr, port); every other rank dials in
+//    and identifies itself; rank 0 then shares each rank's listen port so
+//    all pairs connect full-mesh (send/recv between arbitrary ranks).
+//  - ring allreduce (reduce-scatter + allgather over the rank ring), the
+//    same algorithm family Horovod's engine uses; binomial-free broadcast
+//    from an arbitrary root; allgather; barrier via tiny token exchange.
+//  - fault injection built in (netem analogue, reference fabfile.py:130-191):
+//    per-communicator delay (ms) before every send and a simulated
+//    loss probability that imposes a retransmit-timeout penalty - TCP
+//    never actually drops, so loss manifests as latency, matching how the
+//    reference's tc-netem loss shows up as slowdown.
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in this image).
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <random>
+#include <string>
+#include <sys/socket.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+constexpr int kMaxRetries = 300;      // rendezvous connect retries (x100ms)
+constexpr double kRtoPenaltyMs = 200; // simulated retransmit timeout
+
+struct Comm {
+  int rank = 0;
+  int world = 1;
+  std::vector<int> peer_fd;  // peer_fd[r] = socket to rank r (-1 for self)
+  int listen_fd = -1;
+  double delay_ms = 0.0;
+  double loss_prob = 0.0;
+  std::mt19937 rng{12345};
+  std::string error;
+};
+
+void set_sockopts(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+bool send_all(Comm* c, int fd, const void* buf, size_t n) {
+  if (c->delay_ms > 0 || c->loss_prob > 0) {
+    double penalty = c->delay_ms;
+    if (c->loss_prob > 0) {
+      std::uniform_real_distribution<double> u(0.0, 1.0);
+      // a "lost" packet costs one RTO; repeated losses compound
+      while (u(c->rng) < c->loss_prob) penalty += kRtoPenaltyMs;
+    }
+    if (penalty > 0)
+      std::this_thread::sleep_for(
+          std::chrono::microseconds(static_cast<int64_t>(penalty * 1000)));
+  }
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t sent = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (sent <= 0) {
+      if (sent < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    p += sent;
+    n -= static_cast<size_t>(sent);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t got = ::recv(fd, p, n, 0);
+    if (got <= 0) {
+      if (got < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += got;
+    n -= static_cast<size_t>(got);
+  }
+  return true;
+}
+
+int make_listener(uint16_t* port_inout) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(*port_inout);
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(fd, 64) != 0) {
+    close(fd);
+    return -1;
+  }
+  socklen_t len = sizeof(addr);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  *port_inout = ntohs(addr.sin_port);
+  return fd;
+}
+
+bool resolve(const char* host, sockaddr_in* out) {
+  // numeric fast path, then DNS (so hostnames like "localhost"/"node0" work)
+  if (inet_pton(AF_INET, host, &out->sin_addr) == 1) return true;
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  if (getaddrinfo(host, nullptr, &hints, &res) != 0 || res == nullptr)
+    return false;
+  out->sin_addr = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+  freeaddrinfo(res);
+  return true;
+}
+
+int dial(const char* host, uint16_t port) {
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  if (!resolve(host, &addr)) return -1;
+  for (int attempt = 0; attempt < kMaxRetries; ++attempt) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+      set_sockopts(fd);
+      return fd;
+    }
+    close(fd);
+    usleep(100 * 1000);
+  }
+  return -1;
+}
+
+}  // namespace
+
+extern "C" {
+
+void pdrnn_destroy(Comm* c);
+
+// Rendezvous and build the full mesh.  Returns an opaque handle or null.
+Comm* pdrnn_init(const char* master_addr, int master_port, int rank,
+                 int world) {
+  auto* c = new Comm();
+  c->rank = rank;
+  c->world = world;
+  c->peer_fd.assign(world, -1);
+  if (world == 1) return c;
+
+  if (rank == 0) {
+    uint16_t port = static_cast<uint16_t>(master_port);
+    c->listen_fd = make_listener(&port);
+    if (c->listen_fd < 0) {
+      pdrnn_destroy(c);
+      return nullptr;
+    }
+    // collect every worker's (rank, listen_port)
+    std::vector<uint16_t> ports(world, 0);
+    for (int i = 1; i < world; ++i) {
+      int fd = accept(c->listen_fd, nullptr, nullptr);
+      if (fd < 0) {
+        pdrnn_destroy(c);
+        return nullptr;
+      }
+      set_sockopts(fd);
+      int32_t peer_rank;
+      uint16_t peer_port;
+      if (!recv_all(fd, &peer_rank, 4) || !recv_all(fd, &peer_port, 2)) {
+        pdrnn_destroy(c);
+        return nullptr;
+      }
+      c->peer_fd[peer_rank] = fd;
+      ports[peer_rank] = peer_port;
+    }
+    // share the port table with everyone
+    for (int r = 1; r < world; ++r)
+      if (!send_all(c, c->peer_fd[r], ports.data(), ports.size() * 2)) {
+        pdrnn_destroy(c);
+        return nullptr;
+      }
+  } else {
+    // listen for higher ranks first so the port is in the table
+    uint16_t my_port = 0;
+    c->listen_fd = make_listener(&my_port);
+    if (c->listen_fd < 0) {
+      pdrnn_destroy(c);
+      return nullptr;
+    }
+    int fd = dial(master_addr, static_cast<uint16_t>(master_port));
+    if (fd < 0) {
+      pdrnn_destroy(c);
+      return nullptr;
+    }
+    int32_t r32 = rank;
+    if (!send_all(c, fd, &r32, 4) || !send_all(c, fd, &my_port, 2)) {
+      pdrnn_destroy(c);
+      return nullptr;
+    }
+    c->peer_fd[0] = fd;
+    std::vector<uint16_t> ports(world, 0);
+    if (!recv_all(fd, ports.data(), ports.size() * 2)) {
+      pdrnn_destroy(c);
+      return nullptr;
+    }
+    // full mesh among workers: lower rank dials higher rank's listener.
+    // NOTE: workers all share master_addr here (single-host layout); for
+    // true multi-host the port table would carry addresses too.
+    for (int r = 1; r < rank; ++r) {
+      int pfd = dial(master_addr, ports[r]);
+      if (pfd < 0) {
+        pdrnn_destroy(c);
+        return nullptr;
+      }
+      int32_t me = rank;
+      if (!send_all(c, pfd, &me, 4)) {
+        pdrnn_destroy(c);
+        return nullptr;
+      }
+      c->peer_fd[r] = pfd;
+    }
+    for (int r = rank + 1; r < world; ++r) {
+      int pfd = accept(c->listen_fd, nullptr, nullptr);
+      if (pfd < 0) {
+        pdrnn_destroy(c);
+        return nullptr;
+      }
+      set_sockopts(pfd);
+      int32_t peer_rank;
+      if (!recv_all(pfd, &peer_rank, 4)) {
+        pdrnn_destroy(c);
+        return nullptr;
+      }
+      c->peer_fd[peer_rank] = pfd;
+    }
+  }
+  return c;
+}
+
+int pdrnn_rank(Comm* c) { return c->rank; }
+int pdrnn_world(Comm* c) { return c->world; }
+
+void pdrnn_set_fault(Comm* c, double delay_ms, double loss_prob) {
+  c->delay_ms = delay_ms;
+  c->loss_prob = loss_prob;
+}
+
+int pdrnn_send(Comm* c, int dst, const void* data, int64_t nbytes) {
+  if (dst == c->rank || dst < 0 || dst >= c->world) return -1;
+  return send_all(c, c->peer_fd[dst], data, static_cast<size_t>(nbytes)) ? 0
+                                                                         : -1;
+}
+
+int pdrnn_recv(Comm* c, int src, void* data, int64_t nbytes) {
+  if (src == c->rank || src < 0 || src >= c->world) return -1;
+  return recv_all(c->peer_fd[src], data, static_cast<size_t>(nbytes)) ? 0 : -1;
+}
+
+int pdrnn_broadcast(Comm* c, int root, void* data, int64_t nbytes) {
+  if (c->world == 1) return 0;
+  if (c->rank == root) {
+    for (int r = 0; r < c->world; ++r)
+      if (r != root && pdrnn_send(c, r, data, nbytes) != 0) return -1;
+    return 0;
+  }
+  return pdrnn_recv(c, root, data, nbytes);
+}
+
+// Ring allreduce over float32: reduce-scatter then allgather.
+// op: 0 = sum, 1 = mean.
+int pdrnn_allreduce_f32(Comm* c, float* data, int64_t count, int op) {
+  const int world = c->world;
+  if (world == 1) return 0;
+  const int next = (c->rank + 1) % world;
+  const int prev = (c->rank - 1 + world) % world;
+
+  // chunk boundaries (world chunks, last chunks may be smaller)
+  std::vector<int64_t> begin(world + 1);
+  const int64_t base = count / world, rem = count % world;
+  begin[0] = 0;
+  for (int i = 0; i < world; ++i)
+    begin[i + 1] = begin[i] + base + (i < rem ? 1 : 0);
+  auto chunk_len = [&](int i) { return begin[i + 1] - begin[i]; };
+
+  std::vector<float> inbox(base + 1);
+
+  // reduce-scatter: after step s, rank r owns the fully-reduced chunk
+  // (r+1) mod world ... progressing so rank r ends owning chunk (r+1).
+  for (int step = 0; step < world - 1; ++step) {
+    const int send_idx = (c->rank - step + world) % world;
+    const int recv_idx = (c->rank - step - 1 + world) % world;
+    bool ok_send = false;
+    std::thread sender([&] {
+      ok_send = send_all(c, c->peer_fd[next], data + begin[send_idx],
+                         chunk_len(send_idx) * sizeof(float));
+    });
+    bool ok_recv = recv_all(c->peer_fd[prev], inbox.data(),
+                            chunk_len(recv_idx) * sizeof(float));
+    sender.join();
+    if (!ok_send || !ok_recv) return -1;
+    float* dst = data + begin[recv_idx];
+    const int64_t n = chunk_len(recv_idx);
+    for (int64_t i = 0; i < n; ++i) dst[i] += inbox[i];
+  }
+
+  // allgather: circulate the reduced chunks
+  for (int step = 0; step < world - 1; ++step) {
+    const int send_idx = (c->rank + 1 - step + world) % world;
+    const int recv_idx = (c->rank - step + world) % world;
+    bool ok_send = false;
+    std::thread sender([&] {
+      ok_send = send_all(c, c->peer_fd[next], data + begin[send_idx],
+                         chunk_len(send_idx) * sizeof(float));
+    });
+    bool ok_recv = recv_all(c->peer_fd[prev], data + begin[recv_idx],
+                            chunk_len(recv_idx) * sizeof(float));
+    sender.join();
+    if (!ok_send || !ok_recv) return -1;
+  }
+
+  if (op == 1) {
+    const float inv = 1.0f / static_cast<float>(world);
+    for (int64_t i = 0; i < count; ++i) data[i] *= inv;
+  }
+  return 0;
+}
+
+int pdrnn_allgather(Comm* c, const void* input, int64_t nbytes, void* output) {
+  // output must hold world * nbytes; rank r's contribution lands at slot r.
+  char* out = static_cast<char*>(output);
+  std::memcpy(out + c->rank * nbytes, input, static_cast<size_t>(nbytes));
+  if (c->world == 1) return 0;
+  const int next = (c->rank + 1) % c->world;
+  const int prev = (c->rank - 1 + c->world) % c->world;
+  for (int step = 0; step < c->world - 1; ++step) {
+    const int send_idx = (c->rank - step + c->world) % c->world;
+    const int recv_idx = (c->rank - step - 1 + c->world) % c->world;
+    bool ok_send = false;
+    std::thread sender([&] {
+      ok_send = send_all(c, c->peer_fd[next], out + send_idx * nbytes,
+                         static_cast<size_t>(nbytes));
+    });
+    bool ok_recv = recv_all(c->peer_fd[prev], out + recv_idx * nbytes,
+                            static_cast<size_t>(nbytes));
+    sender.join();
+    if (!ok_send || !ok_recv) return -1;
+  }
+  return 0;
+}
+
+int pdrnn_barrier(Comm* c) {
+  uint8_t token = 0;
+  std::vector<uint8_t> all(static_cast<size_t>(c->world));
+  return pdrnn_allgather(c, &token, 1, all.data());
+}
+
+void pdrnn_destroy(Comm* c) {
+  if (!c) return;
+  for (int fd : c->peer_fd)
+    if (fd >= 0) close(fd);
+  if (c->listen_fd >= 0) close(c->listen_fd);
+  delete c;
+}
+
+}  // extern "C"

@@ -1,0 +1,217 @@
+"""ctypes bindings to the native TCP collectives library.
+
+Builds ``libpdrnn_collectives.so`` from ``csrc/collectives.cpp`` on first
+use (g++, no external deps) and exposes a ``Communicator`` with numpy-array
+collectives: send/recv, broadcast, ring allreduce, allgather, barrier, plus
+netem-analogue fault injection (delay/loss).
+
+This is the framework's Gloo/MPI analogue (SURVEY.md §2.8): rendezvous uses
+``MASTER_ADDR``/``MASTER_PORT``-style coordinates exactly like the
+reference's torch RPC path (``/root/reference/src/motion/param_server/
+__init__.py:41-42``), and the primitive set mirrors what the reference
+exercises over MPI/Horovod (broadcast, allreduce, send/recv - SURVEY §5
+"Distributed communication backend").
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+_CSRC = Path(__file__).parent / "csrc" / "collectives.cpp"
+_LIB_PATH = Path(__file__).parent / "csrc" / "libpdrnn_collectives.so"
+
+_lib = None
+
+
+def build_native_library(force: bool = False) -> Path:
+    """Compile the .so if missing or stale; returns its path."""
+    if (
+        not force
+        and _LIB_PATH.exists()
+        and _LIB_PATH.stat().st_mtime >= _CSRC.stat().st_mtime
+    ):
+        return _LIB_PATH
+    # compile to a process-unique temp path then rename: rename is atomic,
+    # so concurrently-spawned ranks never dlopen a half-written .so
+    tmp_path = _LIB_PATH.with_suffix(f".so.tmp.{os.getpid()}")
+    cmd = [
+        "g++",
+        "-O2",
+        "-shared",
+        "-fPIC",
+        "-std=c++17",
+        "-pthread",
+        str(_CSRC),
+        "-o",
+        str(tmp_path),
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp_path, _LIB_PATH)
+    return _LIB_PATH
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL(str(build_native_library()))
+    lib.pdrnn_init.restype = ctypes.c_void_p
+    lib.pdrnn_init.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int,
+    ]
+    lib.pdrnn_rank.argtypes = [ctypes.c_void_p]
+    lib.pdrnn_world.argtypes = [ctypes.c_void_p]
+    lib.pdrnn_set_fault.argtypes = [ctypes.c_void_p, ctypes.c_double, ctypes.c_double]
+    lib.pdrnn_send.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+    ]
+    lib.pdrnn_recv.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+    ]
+    lib.pdrnn_broadcast.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+    ]
+    lib.pdrnn_allreduce_f32.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_int,
+    ]
+    lib.pdrnn_allgather.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_void_p,
+    ]
+    lib.pdrnn_barrier.argtypes = [ctypes.c_void_p]
+    lib.pdrnn_destroy.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+class Communicator:
+    """Rank-addressed collectives over TCP (host-side transport)."""
+
+    def __init__(
+        self,
+        master_addr: str = "127.0.0.1",
+        master_port: int = 29500,
+        rank: int = 0,
+        world_size: int = 1,
+    ):
+        lib = _load()
+        self._lib = lib
+        self._handle = lib.pdrnn_init(
+            master_addr.encode(), master_port, rank, world_size
+        )
+        if not self._handle:
+            raise RuntimeError(
+                f"rendezvous failed (rank {rank}/{world_size} via "
+                f"{master_addr}:{master_port})"
+            )
+        self.rank = rank
+        self.world_size = world_size
+
+    # -- fault injection (netem analogue) -----------------------------------
+
+    def set_fault(self, delay_ms: float = 0.0, loss_prob: float = 0.0):
+        self._lib.pdrnn_set_fault(self._handle, delay_ms, loss_prob)
+
+    # -- primitives ----------------------------------------------------------
+
+    def _check(self, status: int, op: str):
+        if status != 0:
+            raise RuntimeError(f"{op} failed (rank {self.rank})")
+
+    def send(self, dst: int, array: np.ndarray):
+        array = np.ascontiguousarray(array)
+        self._check(
+            self._lib.pdrnn_send(
+                self._handle, dst, array.ctypes.data, array.nbytes
+            ),
+            "send",
+        )
+
+    def recv(self, src: int, shape, dtype=np.float32) -> np.ndarray:
+        out = np.empty(shape, dtype=dtype)
+        self._check(
+            self._lib.pdrnn_recv(self._handle, src, out.ctypes.data, out.nbytes),
+            "recv",
+        )
+        return out
+
+    def broadcast(self, array: np.ndarray, root: int = 0) -> np.ndarray:
+        array = np.ascontiguousarray(array)
+        self._check(
+            self._lib.pdrnn_broadcast(
+                self._handle, root, array.ctypes.data, array.nbytes
+            ),
+            "broadcast",
+        )
+        return array
+
+    def allreduce(self, array: np.ndarray, op: str = "sum") -> np.ndarray:
+        if array.dtype != np.float32:
+            raise TypeError("allreduce supports float32")
+        array = np.ascontiguousarray(array)
+        self._check(
+            self._lib.pdrnn_allreduce_f32(
+                self._handle, array.ctypes.data, array.size,
+                {"sum": 0, "mean": 1}[op],
+            ),
+            "allreduce",
+        )
+        return array
+
+    def allgather(self, array: np.ndarray) -> np.ndarray:
+        array = np.ascontiguousarray(array)
+        out = np.empty((self.world_size,) + array.shape, dtype=array.dtype)
+        self._check(
+            self._lib.pdrnn_allgather(
+                self._handle, array.ctypes.data, array.nbytes, out.ctypes.data
+            ),
+            "allgather",
+        )
+        return out
+
+    def barrier(self):
+        self._check(self._lib.pdrnn_barrier(self._handle), "barrier")
+
+    def close(self):
+        if self._handle:
+            self._lib.pdrnn_destroy(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def init_from_env() -> Communicator:
+    """Build a communicator from MASTER_ADDR/MASTER_PORT/RANK/WORLD_SIZE -
+    the same rendezvous contract the reference's RPC path uses."""
+    return Communicator(
+        master_addr=os.environ.get("MASTER_ADDR", "127.0.0.1"),
+        master_port=int(os.environ.get("MASTER_PORT", "29500")),
+        rank=int(os.environ.get("RANK", "0")),
+        world_size=int(os.environ.get("WORLD_SIZE", "1")),
+    )

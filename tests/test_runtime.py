@@ -1,0 +1,157 @@
+"""Native TCP collectives: multi-process correctness + fault injection.
+
+Multi-process on one machine stands in for multi-node, the same pattern as
+the reference's docker master/slave cluster (SURVEY.md §4.2).
+"""
+
+import multiprocessing as mp
+import time
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_rnn_tpu.runtime import Communicator, build_native_library
+
+PORT = 29710
+
+
+def _run_ranks(target, world, port, extra=()):
+    """Spawn `world` processes running target(rank, world, port, *extra);
+    collect per-rank results via a queue."""
+    ctx = mp.get_context("spawn")
+    queue = ctx.Queue()
+    procs = [
+        ctx.Process(target=_wrapper, args=(target, rank, world, port, queue, extra))
+        for rank in range(world)
+    ]
+    for p in procs:
+        p.start()
+    results = {}
+    for _ in range(world):
+        rank, value = queue.get(timeout=120)
+        results[rank] = value
+    for p in procs:
+        p.join(timeout=30)
+        assert p.exitcode == 0
+    return results
+
+
+def _wrapper(target, rank, world, port, queue, extra):
+    value = target(rank, world, port, *extra)
+    queue.put((rank, value))
+
+
+# -- per-rank bodies (module-level for spawn picklability) -------------------
+
+def _body_allreduce(rank, world, port):
+    with Communicator("127.0.0.1", port, rank, world) as comm:
+        data = np.full(1000, float(rank + 1), np.float32)
+        comm.allreduce(data)
+        return data.copy()
+
+
+def _body_allreduce_mean_uneven(rank, world, port):
+    with Communicator("127.0.0.1", port, rank, world) as comm:
+        data = np.arange(7, dtype=np.float32) + rank  # 7 not divisible by 4
+        comm.allreduce(data, op="mean")
+        return data.copy()
+
+
+def _body_broadcast(rank, world, port):
+    with Communicator("127.0.0.1", port, rank, world) as comm:
+        data = (
+            np.arange(5, dtype=np.float32)
+            if rank == 2
+            else np.zeros(5, np.float32)
+        )
+        comm.broadcast(data, root=2)
+        return data.copy()
+
+
+def _body_sendrecv(rank, world, port):
+    with Communicator("127.0.0.1", port, rank, world) as comm:
+        if rank == 0:
+            for dst in range(1, world):
+                comm.send(dst, np.full(3, 7.5, np.float32))
+            return np.full(3, 7.5, np.float32)
+        return comm.recv(0, (3,))
+
+
+def _body_allgather(rank, world, port):
+    with Communicator("127.0.0.1", port, rank, world) as comm:
+        return comm.allgather(np.full(2, float(rank), np.float32)).copy()
+
+
+def _body_barrier_then_time(rank, world, port):
+    with Communicator("127.0.0.1", port, rank, world) as comm:
+        if rank == 1:
+            time.sleep(0.5)  # everyone must wait for the laggard
+        comm.barrier()
+        return time.time()
+
+
+def _body_fault_delay(rank, world, port, delay_ms):
+    with Communicator("127.0.0.1", port, rank, world) as comm:
+        data = np.ones(64, np.float32)
+        comm.allreduce(data)  # warm path
+        start = time.perf_counter()
+        comm.set_fault(delay_ms=delay_ms)
+        comm.allreduce(data)
+        return time.perf_counter() - start
+
+
+class TestNativeCollectives:
+    def test_library_builds(self):
+        assert build_native_library().exists()
+
+    def test_single_rank_noop(self):
+        with Communicator(world_size=1) as comm:
+            data = np.arange(4, dtype=np.float32)
+            out = comm.allreduce(data.copy())
+            np.testing.assert_array_equal(out, data)
+            comm.barrier()
+
+    def test_ring_allreduce_sum(self):
+        world = 4
+        results = _run_ranks(_body_allreduce, world, PORT)
+        expected = np.full(1000, sum(range(1, world + 1)), np.float32)
+        for rank in range(world):
+            np.testing.assert_allclose(results[rank], expected)
+
+    def test_allreduce_mean_uneven_count(self):
+        world = 4
+        results = _run_ranks(_body_allreduce_mean_uneven, world, PORT + 1)
+        expected = np.arange(7, dtype=np.float32) + np.mean(np.arange(world))
+        for rank in range(world):
+            np.testing.assert_allclose(results[rank], expected, rtol=1e-6)
+
+    def test_broadcast_from_nonzero_root(self):
+        results = _run_ranks(_body_broadcast, 3, PORT + 2)
+        for rank in range(3):
+            np.testing.assert_array_equal(
+                results[rank], np.arange(5, dtype=np.float32)
+            )
+
+    def test_send_recv_star(self):
+        results = _run_ranks(_body_sendrecv, 4, PORT + 3)
+        for rank in range(4):
+            np.testing.assert_array_equal(results[rank], np.full(3, 7.5, np.float32))
+
+    def test_allgather_rank_order(self):
+        world = 4
+        results = _run_ranks(_body_allgather, world, PORT + 4)
+        expected = np.repeat(np.arange(world, dtype=np.float32)[:, None], 2, axis=1)
+        for rank in range(world):
+            np.testing.assert_array_equal(results[rank], expected)
+
+    def test_barrier_waits_for_laggard(self):
+        start = time.time()
+        results = _run_ranks(_body_barrier_then_time, 3, PORT + 5)
+        # every rank passed the barrier only after rank 1's 0.5s sleep
+        for t in results.values():
+            assert t - start >= 0.45
+
+    def test_fault_injection_delay_slows_allreduce(self):
+        results = _run_ranks(_body_fault_delay, 2, PORT + 6, extra=(50.0,))
+        # 2 ranks -> 2 ring steps, each delayed >=50ms on the send side
+        assert max(results.values()) >= 0.05
