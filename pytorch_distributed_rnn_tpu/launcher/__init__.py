@@ -1,0 +1,41 @@
+"""Launcher + benchmark harness (the fabfile layer, TPU-native).
+
+SURVEY §2.9 parity: run-config → command synthesis, shuffled benchmark
+sweeps with append-only ``results_*.json`` and resume-by-skip, network
+fault-injection sweep, and a rendezvous preflight — targeting local virtual
+device meshes and native-transport process worlds instead of SSH-to-Pis.
+"""
+
+from pytorch_distributed_rnn_tpu.launcher.commands import (
+    RunConfig,
+    command_string,
+    get_command,
+    make_config,
+)
+from pytorch_distributed_rnn_tpu.launcher.bench import (
+    BENCHMARK_RUN,
+    DEBUG_RUN,
+    NETWORK_RULES,
+    execute_run,
+    expand_run_configs,
+    load_results,
+    preflight,
+    run_benchmark,
+    run_network_test,
+)
+
+__all__ = [
+    "RunConfig",
+    "command_string",
+    "get_command",
+    "make_config",
+    "BENCHMARK_RUN",
+    "DEBUG_RUN",
+    "NETWORK_RULES",
+    "execute_run",
+    "expand_run_configs",
+    "load_results",
+    "preflight",
+    "run_benchmark",
+    "run_network_test",
+]

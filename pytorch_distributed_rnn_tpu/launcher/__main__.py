@@ -1,0 +1,115 @@
+"""Launcher CLI — the ``fab <task>`` analogue.
+
+Tasks (mirroring ``/root/reference/fabfile.py`` Fabric tasks):
+
+  preflight         rendezvous check (``prepare_connections`` analogue)
+  prepare-data      seed a dataset directory (``copy_src`` analogue: gets the
+                    workload onto the machine; synthesizes HAR-shaped data
+                    when the real UCI HAR download is absent)
+  run-debug         single seeded 1-epoch run (``run_debug``)
+  run-all           full shuffled benchmark sweep (``run_all``)
+  run-network-test  delay/loss perturbation sweep (``run_network_test``)
+  show-commands     print synthesized commands without running
+
+Example:
+  python -m pytorch_distributed_rnn_tpu.launcher run-all \
+      --results results.json --dataset-path data
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from pytorch_distributed_rnn_tpu.launcher import bench
+from pytorch_distributed_rnn_tpu.launcher.commands import command_string
+
+
+def _add_common(parser):
+    parser.add_argument("--dataset-path", default="data")
+    parser.add_argument("--results", default="results.json")
+    parser.add_argument("--timeout", type=float, default=None)
+    parser.add_argument(
+        "--backend", choices=["cpu", "native"], default="cpu",
+        help="cpu: virtual-device fake cluster; native: attached accelerator",
+    )
+
+
+def _dataset_parameters(args):
+    return {"dataset-path": args.dataset_path}
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="pytorch_distributed_rnn_tpu.launcher")
+    sub = parser.add_subparsers(dest="task", required=True)
+
+    p = sub.add_parser("preflight")
+    p.add_argument("--world-size", type=int, default=2)
+
+    p = sub.add_parser("prepare-data")
+    p.add_argument("--dataset-path", default="data")
+    # real UCI HAR split sizes; the processor's x96 truncation then yields
+    # the reference's 6912 training sequences (processor.py:63-66)
+    p.add_argument("--num-train", type=int, default=7352)
+    p.add_argument("--num-test", type=int, default=2947)
+
+    for task in ("run-debug", "run-all", "show-commands"):
+        p = sub.add_parser(task)
+        _add_common(p)
+
+    p = sub.add_parser("run-network-test")
+    _add_common(p)
+    p.add_argument("--devices", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=1440)
+
+    args = parser.parse_args(argv)
+
+    if args.task == "preflight":
+        for ident in bench.preflight(args.world_size):
+            print(ident)
+        print("preflight ok")
+        return 0
+
+    if args.task == "prepare-data":
+        from pytorch_distributed_rnn_tpu.data import write_synthetic_har_dataset
+
+        write_synthetic_har_dataset(
+            args.dataset_path, num_train=args.num_train, num_test=args.num_test
+        )
+        print(f"dataset ready under {args.dataset_path}")
+        return 0
+
+    if args.task == "show-commands":
+        for config in bench.expand_run_configs(
+            bench.BENCHMARK_RUN, _dataset_parameters(args), args.backend
+        ):
+            print(command_string(config))
+        return 0
+
+    if args.task == "run-debug":
+        run = bench.DEBUG_RUN
+    elif args.task == "run-all":
+        run = bench.BENCHMARK_RUN
+    elif args.task == "run-network-test":
+        executed = bench.run_network_test(
+            args.results,
+            devices=args.devices,
+            batch_size=args.batch_size,
+            extra_parameters=_dataset_parameters(args),
+            timeout=args.timeout,
+        )
+        print(f"executed {executed} run(s) -> {args.results}")
+        return 0
+
+    configs = bench.expand_run_configs(
+        run, _dataset_parameters(args), args.backend
+    )
+    executed = bench.run_benchmark(
+        configs, args.results, timeout=args.timeout
+    )
+    print(f"executed {executed} run(s) -> {args.results}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

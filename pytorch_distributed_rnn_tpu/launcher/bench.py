@@ -1,0 +1,289 @@
+"""Benchmark sweep runner: shuffled runs, append-only JSON, resume-by-skip.
+
+Capability parity with the reference's fabfile benchmark harness
+(``/root/reference/fabfile.py:48-66,130-191,257-290``):
+
+- ``BENCHMARK_RUN`` / ``DEBUG_RUN`` sweep definitions — cartesian product of
+  trainers × device counts × batch sizes, seed 123456789, 1 epoch,
+  ``--no-validation`` (``fabfile.py:48-66``).
+- runs execute in shuffled order; each result is appended to a JSON file
+  with the full command, stdout and stderr (``fabfile.py:257-290``).
+- a crashed sweep resumes by skipping configs whose command string already
+  appears in the results file (``fabfile.py:270-276``).
+- the network-perturbation sweep applies delay/loss around runs
+  (``fabfile.py:130-191``) — here injected into the native TCP transport
+  via the ``PDRNN_FAULT_*`` env contract instead of ``tc netem``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+from pytorch_distributed_rnn_tpu.launcher.commands import (
+    RunConfig,
+    command_string,
+    get_command,
+    make_config,
+)
+
+# Sweep definitions mirroring fabfile.py:29-66.  "devices" replaces the
+# reference's host counts {1,2,4,8,12}; 8 is the canonical TPU-slice/virtual
+# CPU mesh size here.
+BENCHMARK_RUN = {
+    "trainers": ["local", "distributed", "horovod"],
+    "devices": [1, 2, 4, 8],
+    "slots": [1],
+    "batch_sizes": [480, 960, 1440],
+    "parameters": {
+        "epochs": 1,
+        "seed": 123456789,
+        "learning-rate": 0.0025,
+        "no-validation": True,
+        "log": "INFO",
+    },
+}
+
+DEBUG_RUN = {
+    "trainers": ["local"],
+    "devices": [1],
+    "slots": [1],
+    "batch_sizes": [1440],
+    "parameters": {
+        "epochs": 1,
+        "seed": 123456789,
+        "learning-rate": 0.0025,
+        "no-validation": True,
+        "log": "INFO",
+    },
+}
+
+# fabfile.py:130-191: delays 0-400 ms, loss 0-15 %.
+NETWORK_RULES = [
+    ("delay", 0.0),
+    ("delay", 100.0),
+    ("delay", 200.0),
+    ("delay", 400.0),
+    ("loss", 0.05),
+    ("loss", 0.10),
+    ("loss", 0.15),
+]
+
+
+def expand_run_configs(run: dict, extra_parameters=None, backend="cpu",
+                       fault_type=None, fault_value=0.0):
+    """Cartesian expansion of a sweep definition into RunConfigs."""
+    configs = []
+    for trainer, devices, slots, bs in itertools.product(
+        run["trainers"], run["devices"], run["slots"], run["batch_sizes"]
+    ):
+        if trainer == "local" and devices * slots != 1:
+            continue  # local is single-device by definition
+        params = dict(run["parameters"])
+        params["batch-size"] = bs
+        params.update(extra_parameters or {})
+        configs.append(
+            make_config(trainer, devices, slots, params, backend,
+                        fault_type, fault_value)
+        )
+    return configs
+
+
+def load_results(path) -> list:
+    path = Path(path)
+    if not path.exists():
+        return []
+    with open(path) as f:
+        return json.load(f)
+
+
+def _append_result(path, results: list, entry: dict):
+    results.append(entry)
+    tmp = Path(str(path) + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(results, f, indent=1)
+    os.replace(tmp, path)
+
+
+def execute_run(config: RunConfig, timeout: float | None = None,
+                cwd=None) -> dict:
+    """Run one config as a subprocess; capture everything the notebooks and
+    resume logic need (the per-run dict shape follows fabfile.py:280-290)."""
+    argv, extra_env = get_command(config)
+    env = dict(os.environ)
+    env.update(extra_env)
+    # make the framework importable regardless of the run's cwd (the
+    # rsync-deploy analogue: the launcher guarantees code visibility)
+    repo_root = str(Path(__file__).resolve().parents[2])
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo_root, env.get("PYTHONPATH")) if p
+    )
+    start = time.perf_counter()
+    try:
+        proc = subprocess.run(
+            argv, capture_output=True, text=True, env=env, timeout=timeout,
+            cwd=cwd,
+        )
+        returncode, stdout, stderr = proc.returncode, proc.stdout, proc.stderr
+    except subprocess.TimeoutExpired as exc:
+        # record the timeout as a FAILED run so the append+resume contract
+        # holds: a hung config must not re-block the sweep on every re-run
+        returncode = -1
+        stdout = exc.stdout.decode() if isinstance(exc.stdout, bytes) else (
+            exc.stdout or "")
+        stderr = (exc.stderr.decode() if isinstance(exc.stderr, bytes) else (
+            exc.stderr or "")) + f"\n[launcher] timed out after {timeout}s"
+    duration = time.perf_counter() - start
+    return {
+        "trainer": config.trainer,
+        "devices": config.devices,
+        "slots": config.slots,
+        "parameters": config.parameters_dict(),
+        "rule_type": config.fault_type,
+        "rule_value": config.fault_value,
+        "command": command_string(config),
+        "returncode": returncode,
+        "stdout": stdout,
+        "stderr": stderr,
+        "wall_seconds": duration,
+    }
+
+
+def run_benchmark(
+    configs,
+    results_path,
+    shuffle_seed: int | None = 0,
+    timeout: float | None = None,
+    executor=execute_run,
+    log=print,
+):
+    """Execute ``configs`` (shuffled), appending to ``results_path``.
+
+    Configs whose command string already appears in the results file are
+    skipped — re-running after a crash continues where it left off.
+    Returns the number of runs actually executed.
+    """
+    results = load_results(results_path)
+    executed_commands = {r.get("command") for r in results}
+
+    pending = [c for c in configs if command_string(c) not in executed_commands]
+    skipped = len(configs) - len(pending)
+    if skipped:
+        log(f"resume: skipping {skipped} already-executed run(s)")
+
+    if shuffle_seed is not None:
+        random.Random(shuffle_seed).shuffle(pending)
+
+    for i, config in enumerate(pending):
+        log(f"[{i + 1}/{len(pending)}] {command_string(config)}")
+        entry = executor(config, timeout=timeout)
+        _append_result(results_path, results, entry)
+        status = "ok" if entry.get("returncode") == 0 else "FAILED"
+        log(f"  -> {status} in {entry.get('wall_seconds', 0):.1f}s")
+    return len(pending)
+
+
+def run_network_test(
+    results_path,
+    devices: int = 2,
+    batch_size: int = 1440,
+    rules=NETWORK_RULES,
+    extra_parameters=None,
+    backend: str = "cpu",
+    timeout: float | None = None,
+    executor=execute_run,
+    log=print,
+):
+    """Network-perturbation sweep (``fab run_network_test`` analogue).
+
+    The reference perturbed DDP+Horovod over MPI/Ethernet with ``tc netem``
+    (fabfile.py:130-183).  Here the true-network strategy is the parameter
+    server over the native TCP transport, so the sweep runs it under each
+    delay/loss rule; in-process SPMD strategies have no host network to
+    perturb (their collectives ride ICI) and are exercised unperturbed as
+    the control row.
+    """
+    params = {
+        "epochs": 1,
+        "seed": 123456789,
+        "batch-size": batch_size,
+        "no-validation": True,
+        "log": "INFO",
+    }
+    params.update(extra_parameters or {})
+
+    configs = [make_config("distributed", devices, 1, params, backend)]
+    for rule_type, rule_value in rules:
+        configs.append(
+            make_config(
+                "parameter-server", devices, 1, params, backend,
+                fault_type=rule_type, fault_value=rule_value,
+            )
+        )
+    return run_benchmark(
+        configs, results_path, shuffle_seed=None, timeout=timeout,
+        executor=executor, log=log,
+    )
+
+
+def preflight(world_size: int = 2, master_port: int = 29531) -> list:
+    """Connectivity check: the ``mpirun ... hostname`` analogue
+    (``fabfile.py:69-77``).  Spawns ``world_size`` processes that rendezvous
+    over the native transport and allgather their identities; returns the
+    list of ``"hostname:pid"`` strings (raises if any rank fails)."""
+    code = (
+        "import os, socket, numpy as np\n"
+        "from pytorch_distributed_rnn_tpu.runtime import Communicator\n"
+        "rank = int(os.environ['RANK']); world = int(os.environ['WORLD_SIZE'])\n"
+        "comm = Communicator('127.0.0.1', int(os.environ['MASTER_PORT']),"
+        " rank, world)\n"
+        "ident = f'{socket.gethostname()}:{os.getpid()}'.encode()[:64]\n"
+        "buf = np.zeros(64, np.uint8)\n"
+        "buf[:len(ident)] = np.frombuffer(ident, np.uint8)\n"
+        "out = comm.allgather(buf)\n"
+        "if rank == 0:\n"
+        "    for row in out:\n"
+        "        print(bytes(row.tobytes()).rstrip(b'\\0').decode())\n"
+        "comm.close()\n"
+    )
+    procs = []
+    for rank in range(world_size):
+        env = dict(os.environ)
+        env.update(
+            RANK=str(rank),
+            WORLD_SIZE=str(world_size),
+            MASTER_PORT=str(master_port),
+            PDRNN_PLATFORM="cpu",
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", code],
+                env=env, stdout=subprocess.PIPE, text=True,
+            )
+        )
+    identities = []
+    try:
+        for rank, proc in enumerate(procs):
+            out, _ = proc.communicate(timeout=60)
+            if proc.returncode != 0:
+                raise RuntimeError(f"preflight rank {rank} failed")
+            if rank == 0:
+                identities = [line for line in out.splitlines() if line]
+    finally:
+        # a failed/hung rank must not orphan the others: an orphaned rank 0
+        # would keep master_port bound and poison every later rendezvous
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+    if len(identities) != world_size:
+        raise RuntimeError(
+            f"preflight saw {len(identities)} ranks, expected {world_size}"
+        )
+    return identities

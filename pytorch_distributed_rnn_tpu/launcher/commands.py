@@ -1,0 +1,111 @@
+"""Run-config → command synthesis (the fabfile command builder, TPU-native).
+
+Capability parity with the reference's ``get_command``
+(``/root/reference/fabfile.py:194-235``), which turned run-config dicts
+``{trainer, hosts, slots, parameters}`` into ``python main.py ...`` /
+``mpirun --host h1:s,... python main.py ... distributed`` /
+``horovodrun -np N --hosts ...`` strings.
+
+TPU-native translation of the launch topology:
+
+- "hosts" become **devices**: positions along the data-parallel mesh axis.
+  On real hardware the trainer uses every visible chip; for hardware-free
+  runs (the docker-compose fake-cluster analogue, SURVEY §4.2) we export
+  ``PDRNN_PLATFORM=cpu`` + ``PDRNN_NUM_CPU_DEVICES=N`` so one process hosts
+  an N-device virtual mesh — the ``mpirun -np N`` analogue without MPI.
+- "slots" (processes per host, ``fabfile.py:51,203-206``) multiply the
+  world size exactly like ``--map-by slot`` did.
+- the parameter-server strategy stays a true multi-process launch over the
+  native TCP transport; its world is ``devices * slots`` workers + 1 master.
+- fault injection is an env contract (``PDRNN_FAULT_DELAY_MS`` /
+  ``PDRNN_FAULT_LOSS_PROB``) consumed by the native transport at
+  communicator construction — the ``tc netem`` analogue.
+"""
+
+from __future__ import annotations
+
+import shlex
+import sys
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class RunConfig:
+    """One benchmark run (the reference's run-config dict made explicit)."""
+
+    trainer: str  # local | distributed | horovod | parameter-server
+    devices: int = 1  # "hosts" analogue: dp world size
+    slots: int = 1  # processes-per-host analogue: multiplies world
+    parameters: tuple = field(default_factory=tuple)  # ((flag, value), ...)
+    backend: str = "cpu"  # cpu (virtual-device sim) | native (attached chips)
+    fault_type: str | None = None  # delay | loss
+    fault_value: float = 0.0
+
+    @property
+    def world_size(self) -> int:
+        return self.devices * self.slots
+
+    def parameters_dict(self) -> dict:
+        return dict(self.parameters)
+
+
+def make_config(trainer, devices=1, slots=1, parameters=None, backend="cpu",
+                fault_type=None, fault_value=0.0) -> RunConfig:
+    """RunConfig from a plain parameter dict (hashable/frozen inside)."""
+    items = tuple(sorted((str(k), v) for k, v in (parameters or {}).items()))
+    return RunConfig(trainer, devices, slots, items, backend,
+                     fault_type, fault_value)
+
+
+def get_command(config: RunConfig, python: str | None = None):
+    """Synthesize ``(argv, env)`` for a run config.
+
+    ``argv`` is the subprocess argument vector; ``env`` holds only the
+    *additional* environment this run needs (platform override, virtual
+    device count, fault injection) — the caller merges it over ``os.environ``.
+    """
+    python = python or sys.executable
+    argv = [python, "-m", "pytorch_distributed_rnn_tpu.main"]
+
+    for flag, value in config.parameters:
+        if value is True:
+            argv.append(f"--{flag}")
+        elif value is False or value is None:
+            continue
+        else:
+            argv.extend([f"--{flag}", str(value)])
+
+    env: dict[str, str] = {}
+    world = config.world_size
+
+    if config.trainer in ("local", "distributed", "horovod"):
+        argv.append(config.trainer)
+        if config.trainer != "local" and config.backend == "cpu":
+            env["PDRNN_PLATFORM"] = "cpu"
+            env["PDRNN_NUM_CPU_DEVICES"] = str(world)
+    elif config.trainer == "parameter-server":
+        argv.extend(["parameter-server", "--world-size", str(world + 1)])
+        if config.backend == "cpu":
+            env["PDRNN_PLATFORM"] = "cpu"
+    else:
+        raise ValueError(f"unknown trainer {config.trainer!r}")
+
+    if config.fault_type == "delay" and config.fault_value:
+        env["PDRNN_FAULT_DELAY_MS"] = str(config.fault_value)
+    elif config.fault_type == "loss" and config.fault_value:
+        env["PDRNN_FAULT_LOSS_PROB"] = str(config.fault_value)
+
+    return argv, env
+
+
+def command_string(config: RunConfig) -> str:
+    """Canonical shell string for a config — the resume key.
+
+    The reference resumed a crashed sweep by comparing already-run command
+    strings in the results JSON (``fabfile.py:270-276``); this string plays
+    the same role, with the env prefix included so the same CLI under a
+    different topology/fault is a distinct run.
+    """
+    argv, env = get_command(config, python="python")
+    prefix = [f"{k}={v}" for k, v in sorted(env.items())]
+    return " ".join(prefix + [shlex.quote(a) for a in argv])

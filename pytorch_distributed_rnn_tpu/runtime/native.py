@@ -128,6 +128,13 @@ class Communicator:
             )
         self.rank = rank
         self.world_size = world_size
+        # netem analogue: the launcher's network-perturbation sweep exports
+        # these before spawning ranks, mirroring how the reference applies
+        # `tc qdisc ... netem` per host around a run (fabfile.py:130-191)
+        delay_ms = float(os.environ.get("PDRNN_FAULT_DELAY_MS", "0") or 0)
+        loss_prob = float(os.environ.get("PDRNN_FAULT_LOSS_PROB", "0") or 0)
+        if delay_ms or loss_prob:
+            self.set_fault(delay_ms, loss_prob)
 
     # -- fault injection (netem analogue) -----------------------------------
 
