@@ -1,0 +1,56 @@
+"""Evaluation CLI: results JSON → scaling tables, CSV, plots.
+
+Example:
+  python -m pytorch_distributed_rnn_tpu.evaluation results.json \
+      --csv scaling.csv --plot scaling.png
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from pytorch_distributed_rnn_tpu.evaluation.analysis import (
+    create_measurement_df,
+    scaling_table,
+)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="pytorch_distributed_rnn_tpu.evaluation"
+    )
+    parser.add_argument("results", nargs="+", help="results_*.json files")
+    parser.add_argument("--csv", default=None, help="write scaling table CSV")
+    parser.add_argument("--plot", default=None, help="write scaling figure")
+    parser.add_argument("--batch-size", type=int, default=None,
+                        help="restrict the plot to one batch size")
+    args = parser.parse_args(argv)
+
+    import pandas as pd
+
+    df = pd.concat(
+        [create_measurement_df(path) for path in args.results],
+        ignore_index=True,
+    )
+    if df.empty:
+        print("no perf lines found in the given results files")
+        return 1
+
+    table = scaling_table(df)
+    with pd.option_context("display.width", 120, "display.precision", 3):
+        print(table.to_string(index=False))
+
+    if args.csv:
+        table.to_csv(args.csv, index=False)
+        print(f"wrote {args.csv}")
+    if args.plot:
+        from pytorch_distributed_rnn_tpu.evaluation.plots import plot_scaling
+
+        plot_scaling(df, args.plot, batch_size=args.batch_size)
+        print(f"wrote {args.plot}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,134 @@
+"""Perf-line parsing and measurement aggregation.
+
+The regex is the notebooks' own (``Experiments.ipynb`` cell 2), extended to
+capture every rank's line rather than only rank 0's so per-node and
+aggregate memory plots (cells 5-7) are both derivable.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+
+import pandas as pd
+
+# The machine-readable telemetry contract (formatter.py:27).  Rank is part
+# of the line; the notebooks anchored on rank 0 ('0: Memory Usage: ...').
+PERF_LINE_RE = re.compile(
+    r"(\d+): Memory Usage: (\d+\.\d+), Training Duration: (\d+\.\d+)"
+)
+
+TRAIN_SIZE_RE = re.compile(r"Training set of size (\d+)")
+
+# The benchmark workload's training-set size (BASELINE.md): used to derive
+# seq/s when a run's log does not state its dataset size.
+DEFAULT_NUM_SEQUENCES = 6912
+
+
+def parse_perf_lines(text: str):
+    """All ``(rank, memory_mb, duration_s)`` tuples in a captured stream."""
+    return [
+        (int(rank), float(mem), float(dur))
+        for rank, mem, dur in PERF_LINE_RE.findall(text or "")
+    ]
+
+
+def create_measurement_df(results) -> pd.DataFrame:
+    """Measurement dataframe from launcher results (the ``create_measurement_df``
+    analogue, one row per (run, rank)).
+
+    ``results`` is the list the launcher appends to ``results_*.json`` — or a
+    path to such a file.  Runs whose stderr carries no perf line (crashes)
+    are dropped, exactly as the notebooks' regex silently skipped them.
+    """
+    if isinstance(results, (str, Path)):
+        with open(results) as f:
+            results = json.load(f)
+
+    rows = []
+    for run in results:
+        text = (run.get("stderr") or "") + "\n" + (run.get("stdout") or "")
+        perf = parse_perf_lines(text)
+        size_match = TRAIN_SIZE_RE.search(text)
+        num_sequences = (
+            int(size_match.group(1)) if size_match else DEFAULT_NUM_SEQUENCES
+        )
+        params = run.get("parameters", {})
+        epochs = int(params.get("epochs", 1))
+        for rank, memory, duration in perf:
+            rows.append(
+                {
+                    "trainer": run.get("trainer"),
+                    "devices": run.get("devices", 1),
+                    "slots": run.get("slots", 1),
+                    "world": run.get("devices", 1) * run.get("slots", 1),
+                    "batch_size": params.get("batch-size"),
+                    "rule_type": run.get("rule_type"),
+                    "rule_value": run.get("rule_value"),
+                    "rank": rank,
+                    "memory_mb": memory,
+                    "duration_s": duration,
+                    "num_sequences": num_sequences,
+                    "seq_per_sec": num_sequences * epochs / duration
+                    if duration > 0
+                    else float("nan"),
+                }
+            )
+    return pd.DataFrame(rows)
+
+
+def aggregate_measurements(df: pd.DataFrame) -> pd.DataFrame:
+    """Mean over repeats of rank-0 rows, grouped by run configuration —
+    the number the reference reported (rank 0's line, BASELINE.md)."""
+    if df.empty:
+        return df
+    rank0 = df[df["rank"] == 0]
+    grouped = (
+        rank0.groupby(
+            ["trainer", "devices", "slots", "batch_size"], dropna=False
+        )
+        .agg(
+            duration_s=("duration_s", "mean"),
+            memory_mb=("memory_mb", "mean"),
+            seq_per_sec=("seq_per_sec", "mean"),
+            repeats=("duration_s", "size"),
+        )
+        .reset_index()
+    )
+    return grouped
+
+
+def scaling_table(df: pd.DataFrame, baseline_trainer: str = "local") -> pd.DataFrame:
+    """Scaling study: speedup and efficiency vs the 1-device baseline.
+
+    Mirrors the derived figures in BASELINE.md ("DDP scaling efficiency
+    1→8 nodes"): for each (trainer, batch_size), speedup = t_baseline / t_N
+    and efficiency = speedup / N.  The baseline is the ``local`` trainer at
+    the same batch size when present, else the trainer's own 1-device row.
+    """
+    agg = aggregate_measurements(df)
+    if agg.empty:
+        return agg
+
+    baselines = {}
+    for _, row in agg.iterrows():
+        if row["trainer"] == baseline_trainer and row["devices"] == 1:
+            baselines[row["batch_size"]] = row["duration_s"]
+
+    def _baseline_for(row):
+        if row["batch_size"] in baselines:
+            return baselines[row["batch_size"]]
+        own = agg[
+            (agg["trainer"] == row["trainer"])
+            & (agg["devices"] == 1)
+            & (agg["batch_size"] == row["batch_size"])
+        ]
+        return own["duration_s"].iloc[0] if len(own) else float("nan")
+
+    agg = agg.copy()
+    agg["speedup"] = agg.apply(
+        lambda r: _baseline_for(r) / r["duration_s"], axis=1
+    )
+    agg["efficiency"] = agg["speedup"] / (agg["devices"] * agg["slots"])
+    return agg

@@ -1,0 +1,51 @@
+"""Scaling-study plots (the Experiments.ipynb plotting cells, scriptable).
+
+Reproduces the reference's figure set — training time vs node count per
+trainer, rank-0 and aggregate memory vs node count — from the measurement
+dataframe, writing PNG/PDF instead of living in a notebook.
+"""
+
+from __future__ import annotations
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import matplotlib.pyplot as plt
+
+from pytorch_distributed_rnn_tpu.evaluation.analysis import (
+    aggregate_measurements,
+)
+
+
+def plot_scaling(df, path, batch_size=None):
+    """Write a 3-panel scaling figure: duration, throughput, memory vs
+    device count, one line per trainer.  Returns the figure path."""
+    agg = aggregate_measurements(df)
+    if batch_size is not None:
+        agg = agg[agg["batch_size"] == batch_size]
+    if agg.empty:
+        raise ValueError("no rank-0 measurements to plot")
+
+    fig, axes = plt.subplots(1, 3, figsize=(15, 4))
+    for trainer, group in agg.groupby("trainer"):
+        group = group.sort_values("devices")
+        axes[0].plot(group["devices"], group["duration_s"], "o-", label=trainer)
+        axes[1].plot(group["devices"], group["seq_per_sec"], "o-", label=trainer)
+        axes[2].plot(group["devices"], group["memory_mb"], "o-", label=trainer)
+
+    for ax, ylabel in zip(
+        axes, ["training duration (s)", "throughput (seq/s)", "rank-0 RSS (MB)"]
+    ):
+        ax.set_xlabel("devices")
+        ax.set_ylabel(ylabel)
+        ax.legend()
+        ax.grid(True, alpha=0.3)
+    title = "scaling study" + (
+        f" (batch size {batch_size})" if batch_size is not None else ""
+    )
+    fig.suptitle(title)
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+    return path

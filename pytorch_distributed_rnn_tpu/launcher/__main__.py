@@ -96,10 +96,10 @@ def main(argv=None):
             devices=args.devices,
             batch_size=args.batch_size,
             extra_parameters=_dataset_parameters(args),
+            backend=args.backend,
             timeout=args.timeout,
         )
-        print(f"executed {executed} run(s) -> {args.results}")
-        return 0
+        return _report(executed, args.results)
 
     configs = bench.expand_run_configs(
         run, _dataset_parameters(args), args.backend
@@ -107,8 +107,14 @@ def main(argv=None):
     executed = bench.run_benchmark(
         configs, args.results, timeout=args.timeout
     )
-    print(f"executed {executed} run(s) -> {args.results}")
-    return 0
+    return _report(executed, args.results)
+
+
+def _report(executed, results_path) -> int:
+    failed = [e for e in executed if e.get("returncode") != 0]
+    print(f"executed {len(executed)} run(s) -> {results_path}"
+          + (f" ({len(failed)} FAILED)" if failed else ""))
+    return 1 if failed else 0
 
 
 if __name__ == "__main__":

@@ -167,7 +167,8 @@ def run_benchmark(
 
     Configs whose command string already appears in the results file are
     skipped — re-running after a crash continues where it left off.
-    Returns the number of runs actually executed.
+    Returns the list of result entries actually executed (callers can
+    check ``returncode`` to distinguish a clean sweep from failures).
     """
     results = load_results(results_path)
     executed_commands = {r.get("command") for r in results}
@@ -180,13 +181,15 @@ def run_benchmark(
     if shuffle_seed is not None:
         random.Random(shuffle_seed).shuffle(pending)
 
+    executed = []
     for i, config in enumerate(pending):
         log(f"[{i + 1}/{len(pending)}] {command_string(config)}")
         entry = executor(config, timeout=timeout)
         _append_result(results_path, results, entry)
+        executed.append(entry)
         status = "ok" if entry.get("returncode") == 0 else "FAILED"
         log(f"  -> {status} in {entry.get('wall_seconds', 0):.1f}s")
-    return len(pending)
+    return executed
 
 
 def run_network_test(

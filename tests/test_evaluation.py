@@ -1,0 +1,153 @@
+"""Evaluation-layer tests: the notebooks' data contract survives.
+
+The regex, dataframe shape, and derived scaling figures mirror
+``/root/reference/evaluation/Experiments.ipynb`` (cell 2 regex; BASELINE.md
+derivations).  The round-trip test feeds results entries shaped exactly
+like the launcher's output.
+"""
+
+import json
+
+import pandas as pd
+import pytest
+
+from pytorch_distributed_rnn_tpu.evaluation import (
+    PERF_LINE_RE,
+    aggregate_measurements,
+    create_measurement_df,
+    parse_perf_lines,
+    plot_scaling,
+    scaling_table,
+)
+
+
+def _run(trainer, devices, duration, memory, batch=1440, repeats_suffix="",
+         rule_type=None, rule_value=0.0, ranks=1):
+    stderr_lines = ["INFO:root:Training set of size 6912"]
+    for rank in range(ranks):
+        stderr_lines.append(
+            f"{rank}: Memory Usage: {memory + rank:.6f}, "
+            f"Training Duration: {duration + rank / 10:.6f}"
+        )
+    return {
+        "trainer": trainer,
+        "devices": devices,
+        "slots": 1,
+        "parameters": {"batch-size": batch, "epochs": 1},
+        "rule_type": rule_type,
+        "rule_value": rule_value,
+        "command": f"cmd-{trainer}-{devices}-{batch}-{duration}{repeats_suffix}",
+        "returncode": 0,
+        "stdout": "",
+        "stderr": "\n".join(stderr_lines),
+        "wall_seconds": duration + 1.0,
+    }
+
+
+def test_perf_line_regex_matches_reference_contract():
+    # byte-identical to the line format the reference notebooks parse
+    line = "0: Memory Usage: 727.90625, Training Duration: 145.123456"
+    (match,) = PERF_LINE_RE.findall(line)
+    assert match == ("0", "727.90625", "145.123456")
+
+
+def test_parse_perf_lines_multi_rank():
+    text = (
+        "noise\n0: Memory Usage: 100.5, Training Duration: 10.0\n"
+        "1: Memory Usage: 90.25, Training Duration: 9.5\n"
+    )
+    parsed = parse_perf_lines(text)
+    assert parsed == [(0, 100.5, 10.0), (1, 90.25, 9.5)]
+
+
+def test_create_measurement_df_drops_crashed_runs():
+    results = [
+        _run("local", 1, 100.0, 700.0),
+        {"trainer": "distributed", "devices": 8, "slots": 1,
+         "parameters": {"batch-size": 1440}, "returncode": 1,
+         "stdout": "", "stderr": "Traceback ...", "command": "x"},
+    ]
+    df = create_measurement_df(results)
+    assert len(df) == 1
+    assert df.iloc[0]["trainer"] == "local"
+    assert df.iloc[0]["num_sequences"] == 6912
+    assert df.iloc[0]["seq_per_sec"] == pytest.approx(6912 / 100.0)
+
+
+def test_aggregate_means_over_repeats():
+    results = [
+        _run("local", 1, 100.0, 700.0, repeats_suffix="-a"),
+        _run("local", 1, 110.0, 720.0, repeats_suffix="-b"),
+    ]
+    agg = aggregate_measurements(create_measurement_df(results))
+    assert len(agg) == 1
+    assert agg.iloc[0]["duration_s"] == pytest.approx(105.0)
+    assert agg.iloc[0]["memory_mb"] == pytest.approx(710.0)
+    assert agg.iloc[0]["repeats"] == 2
+
+
+def test_scaling_table_efficiency_vs_local():
+    # local 1 dev: 144s; ddp 8 dev: 33s -> speedup 4.36, efficiency ~0.545
+    # (the BASELINE.md shape)
+    results = [
+        _run("local", 1, 144.0, 700.0),
+        _run("distributed", 8, 33.0, 220.0, ranks=1),
+    ]
+    table = scaling_table(create_measurement_df(results))
+    ddp = table[table["trainer"] == "distributed"].iloc[0]
+    assert ddp["speedup"] == pytest.approx(144.0 / 33.0)
+    assert ddp["efficiency"] == pytest.approx(144.0 / 33.0 / 8)
+
+
+def test_scaling_table_falls_back_to_own_1dev_baseline():
+    results = [
+        _run("distributed", 1, 150.0, 700.0),
+        _run("distributed", 4, 50.0, 300.0),
+    ]
+    table = scaling_table(create_measurement_df(results))
+    four = table[table["devices"] == 4].iloc[0]
+    assert four["speedup"] == pytest.approx(3.0)
+
+
+def test_multi_rank_aggregation_uses_rank0():
+    results = [_run("distributed", 2, 50.0, 400.0, ranks=2)]
+    agg = aggregate_measurements(create_measurement_df(results))
+    assert agg.iloc[0]["duration_s"] == pytest.approx(50.0)
+    assert agg.iloc[0]["memory_mb"] == pytest.approx(400.0)
+
+
+def test_network_rule_columns_survive():
+    results = [
+        _run("parameter-server", 2, 60.0, 300.0, rule_type="delay",
+             rule_value=100.0),
+    ]
+    df = create_measurement_df(results)
+    assert df.iloc[0]["rule_type"] == "delay"
+    assert df.iloc[0]["rule_value"] == 100.0
+
+
+def test_cli_and_plot_round_trip(tmp_path):
+    results = [
+        _run("local", 1, 144.0, 700.0),
+        _run("distributed", 2, 80.0, 490.0),
+        _run("distributed", 8, 33.0, 220.0),
+        _run("horovod", 8, 49.0, 224.0),
+    ]
+    results_path = tmp_path / "results.json"
+    results_path.write_text(json.dumps(results))
+
+    from pytorch_distributed_rnn_tpu.evaluation.__main__ import main
+
+    csv_path = tmp_path / "scaling.csv"
+    png_path = tmp_path / "scaling.png"
+    rc = main([str(results_path), "--csv", str(csv_path),
+               "--plot", str(png_path)])
+    assert rc == 0
+    table = pd.read_csv(csv_path)
+    assert set(table["trainer"]) == {"local", "distributed", "horovod"}
+    assert png_path.exists() and png_path.stat().st_size > 0
+
+
+def test_plot_requires_measurements(tmp_path):
+    with pytest.raises(ValueError):
+        plot_scaling(create_measurement_df([]), tmp_path / "x.png")

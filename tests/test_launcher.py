@@ -121,7 +121,7 @@ def test_run_benchmark_appends_and_resumes(tmp_path):
     ran = []
     n = run_benchmark(configs, results_path, executor=_fake_executor(ran),
                       log=lambda *_: None)
-    assert n == 3
+    assert len(n) == 3
     results = load_results(results_path)
     assert len(results) == 3
     assert all(r["returncode"] == 0 for r in results)
@@ -131,7 +131,7 @@ def test_run_benchmark_appends_and_resumes(tmp_path):
     extra = configs + [make_config("local", parameters={"batch-size": 240})]
     n2 = run_benchmark(extra, results_path, executor=_fake_executor(ran2),
                        log=lambda *_: None)
-    assert n2 == 1
+    assert len(n2) == 1 and n2[0]["returncode"] == 0
     assert len(ran2) == 1
     assert ran2[0].parameters_dict()["batch-size"] == 240
     assert len(load_results(results_path)) == 4
@@ -192,7 +192,7 @@ def test_end_to_end_debug_run(tmp_path):
         executor=lambda c, timeout=None: execute_run(c, timeout=600,
                                                      cwd=tmp_path),
     )
-    assert n == 1
+    assert len(n) == 1
     (result,) = load_results(results_path)
     assert result["returncode"] == 0, result["stderr"][-2000:]
     # the perf line the evaluation layer parses must be in stderr
