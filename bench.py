@@ -54,11 +54,14 @@ def main():
         model, train_set, batch_size=BATCH_SIZE, learning_rate=0.0025, seed=SEED
     )
 
-    trainer.train(epochs=1)  # warm-up: compile both batch shapes
+    trainer.train(epochs=1)  # warm-up: compile the 1-epoch program
 
+    # reference methodology is 1-epoch wall-clock (base.py:93-96); repeat
+    # 1-epoch runs so every timed run reuses the compiled epoch program
     epochs = 3
     start = time.perf_counter()
-    trainer.train(epochs=epochs)
+    for _ in range(epochs):
+        trainer.train(epochs=1)
     duration = time.perf_counter() - start
 
     seq_per_sec = epochs * NUM_SEQUENCES / duration

@@ -30,6 +30,7 @@ class MotionModel:
     output_dim: int = 6
     cell: str = "lstm"
     unroll: int = 1
+    impl: str = "auto"  # "scan" | "fused" (Pallas) | "auto" (fused on TPU)
 
     def init(self, key: jax.Array):
         rnn_key, fc_key = jax.random.split(key)
@@ -42,6 +43,8 @@ class MotionModel:
 
     def apply(self, params, x: jax.Array) -> jax.Array:
         """x: (B, T, input_dim) -> logits (B, output_dim)."""
-        outputs, _ = stacked_rnn(params["rnn"], x, self.cell, unroll=self.unroll)
+        outputs, _ = stacked_rnn(
+            params["rnn"], x, self.cell, unroll=self.unroll, impl=self.impl
+        )
         last = outputs[:, -1, :]
         return last @ params["fc"]["weight"].T + params["fc"]["bias"]

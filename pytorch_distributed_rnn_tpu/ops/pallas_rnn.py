@@ -1,0 +1,332 @@
+"""Fused LSTM scan as Pallas TPU kernels (the performance path).
+
+The ``lax.scan`` LSTM in ``ops/rnn.py`` is correct and portable, but each
+timestep is its own XLA loop iteration: the tiny recurrent matmul
+``(B, H) @ (H, 4H)`` plus gate math pays per-step loop/fusion overhead 128
+times per layer.  For the reference workload (H=32 - the motion model,
+``/root/reference/src/motion/model.py:9-16``) that overhead dominates the
+actual FLOPs.
+
+This module fuses the *entire* time loop into one Pallas kernel:
+
+- Grid ``(batch_tiles, T)``.  The TPU grid is sequential, so VMEM scratch
+  persists across grid steps: ``h``/``c`` live in scratch for all T steps of
+  a batch tile, and Pallas double-buffers the per-step ``x_proj`` block
+  fetch automatically.
+- The input projection for all timesteps is still one big MXU matmul
+  *outside* the kernel (same trick as the scan path); the kernel only does
+  the serial part: ``gates = x_proj[t] + h @ w_hh^T`` and the gate math.
+- Backward is a second kernel running the grid in reverse time order,
+  carrying ``dh``/``dc`` in scratch and accumulating ``dw_hh`` in a VMEM
+  accumulator across the whole grid, wired up via ``jax.custom_vjp``
+  (Pallas kernels are not auto-differentiable).
+
+Layouts are time-major ``(T, B, ...)`` inside the fused region so each
+block's trailing two dims ``(block_b, 4H)`` align with the (8, 128) f32
+tile.  Weight layout and gate order (i, f, g, o) follow torch exactly like
+the scan path, so both implementations are interchangeable and parity-tested
+against each other and against torch.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret() -> bool:
+    """Pallas interpret mode off-TPU so the CPU test mesh runs the kernels."""
+    return jax.default_backend() != "tpu"
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _pick_block_b(batch: int) -> int:
+    """Batch tile: large enough to keep the MXU/VPU busy, small enough that
+    several (block_b, 4H) blocks sit comfortably in VMEM - and chosen so
+    the padded batch wastes at most 7 rows (e.g. 1440 -> 3 tiles of 480,
+    not 3 tiles of 512)."""
+    num_tiles = -(-batch // 512)
+    return _round_up(-(-batch // num_tiles), 8)
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _lstm_fwd_kernel(x_proj_ref, h0_ref, c0_ref, w_hh_t_ref,
+                     h_all_ref, c_all_ref, h_scr, c_scr):
+    t = pl.program_id(1)
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[:] = h0_ref[:]
+        c_scr[:] = c0_ref[:]
+
+    h = h_scr[:]
+    c = c_scr[:]
+    gates = x_proj_ref[0] + jnp.dot(
+        h, w_hh_t_ref[:], preferred_element_type=jnp.float32
+    )
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f)
+    g = jnp.tanh(g)
+    o = jax.nn.sigmoid(o)
+    c = f * c + i * g
+    h = o * jnp.tanh(c)
+    h_scr[:] = h
+    c_scr[:] = c
+    h_all_ref[0] = h
+    c_all_ref[0] = c
+
+
+def _lstm_fwd_pallas(x_proj, h0, c0, w_hh_t, *, block_b):
+    """x_proj: (T, Bp, 4H) time-major; returns h_all, c_all (T, Bp, H)."""
+    seq_len, batch_p, gate_dim = x_proj.shape
+    hidden = gate_dim // 4
+    nb = batch_p // block_b
+    grid = (nb, seq_len)
+    dtype = x_proj.dtype
+
+    h_all, c_all = pl.pallas_call(
+        _lstm_fwd_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_b, gate_dim), lambda b, t: (t, b, 0)),
+            pl.BlockSpec((block_b, hidden), lambda b, t: (b, 0)),
+            pl.BlockSpec((block_b, hidden), lambda b, t: (b, 0)),
+            pl.BlockSpec((hidden, gate_dim), lambda b, t: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_b, hidden), lambda b, t: (t, b, 0)),
+            pl.BlockSpec((1, block_b, hidden), lambda b, t: (t, b, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((seq_len, batch_p, hidden), dtype),
+            jax.ShapeDtypeStruct((seq_len, batch_p, hidden), dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_b, hidden), jnp.float32),
+            pltpu.VMEM((block_b, hidden), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x_proj, h0, c0, w_hh_t)
+    return h_all, c_all
+
+
+# ---------------------------------------------------------------------------
+# Backward kernel (reverse time order)
+# ---------------------------------------------------------------------------
+
+
+def _lstm_bwd_kernel(x_proj_ref, h_prev_ref, c_prev_ref, c_t_ref,
+                     dh_all_ref, dh_T_ref, dc_T_ref, w_hh_t_ref, w_hh_ref,
+                     h0_ref, c0_ref,
+                     dx_proj_ref, dw_hh_ref, dh0_ref, dc0_ref,
+                     dh_scr, dc_scr, dw_scr):
+    b = pl.program_id(0)
+    t = pl.program_id(1)
+    nb = pl.num_programs(0)
+    seq_len = pl.num_programs(1)
+    tt_is_first = t == 0          # tt == T-1: start of backward sweep
+    tt_is_last = t == seq_len - 1  # tt == 0: end of backward sweep
+
+    @pl.when(jnp.logical_and(b == 0, tt_is_first))
+    def _():
+        dw_scr[:] = jnp.zeros_like(dw_scr)
+
+    @pl.when(tt_is_first)
+    def _():
+        dh_scr[:] = dh_T_ref[:]
+        dc_scr[:] = dc_T_ref[:]
+
+    # At tt == 0 the "previous" state is the initial carry, not a saved step.
+    h_prev = jnp.where(tt_is_last, h0_ref[:], h_prev_ref[0])
+    c_prev = jnp.where(tt_is_last, c0_ref[:], c_prev_ref[0])
+
+    # Recompute the gates for this step (cheaper than saving 4H activations).
+    gates = x_proj_ref[0] + jnp.dot(
+        h_prev, w_hh_t_ref[:], preferred_element_type=jnp.float32
+    )
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f)
+    g = jnp.tanh(g)
+    o = jax.nn.sigmoid(o)
+
+    dh = dh_scr[:] + dh_all_ref[0]
+    dc = dc_scr[:]
+
+    tanh_c = jnp.tanh(c_t_ref[0])
+    do = dh * tanh_c
+    dc = dc + dh * o * (1.0 - tanh_c * tanh_c)
+    di = dc * g
+    df = dc * c_prev
+    dg = dc * i
+
+    d_gates = jnp.concatenate(
+        [
+            di * i * (1.0 - i),
+            df * f * (1.0 - f),
+            dg * (1.0 - g * g),
+            do * o * (1.0 - o),
+        ],
+        axis=-1,
+    )
+
+    dx_proj_ref[0] = d_gates.astype(dx_proj_ref.dtype)
+    dw_scr[:] += jnp.dot(
+        d_gates.T, h_prev, preferred_element_type=jnp.float32
+    )
+
+    dh_prev = jnp.dot(d_gates, w_hh_ref[:], preferred_element_type=jnp.float32)
+    dc_prev = dc * f
+    dh_scr[:] = dh_prev
+    dc_scr[:] = dc_prev
+
+    @pl.when(tt_is_last)
+    def _():
+        dh0_ref[:] = dh_prev.astype(dh0_ref.dtype)
+        dc0_ref[:] = dc_prev.astype(dc0_ref.dtype)
+
+    @pl.when(jnp.logical_and(b == nb - 1, tt_is_last))
+    def _():
+        dw_hh_ref[:] = dw_scr[:].astype(dw_hh_ref.dtype)
+
+
+def _lstm_bwd_pallas(x_proj, h_all, c_all, h0, c0, w_hh_t,
+                     dh_all, dh_T, dc_T, *, block_b):
+    seq_len, batch_p, gate_dim = x_proj.shape
+    hidden = gate_dim // 4
+    nb = batch_p // block_b
+    grid = (nb, seq_len)
+    dtype = x_proj.dtype
+    w_hh = w_hh_t.T  # (4H, H)
+
+    rev = lambda b, t: (seq_len - 1 - t, b, 0)        # noqa: E731
+    rev_prev = lambda b, t: (                          # noqa: E731
+        jnp.maximum(seq_len - 2 - t, 0), b, 0)
+
+    dx_proj, dw_hh, dh0, dc0 = pl.pallas_call(
+        _lstm_bwd_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_b, gate_dim), rev),       # x_proj[tt]
+            pl.BlockSpec((1, block_b, hidden), rev_prev),    # h_all[tt-1]
+            pl.BlockSpec((1, block_b, hidden), rev_prev),    # c_all[tt-1]
+            pl.BlockSpec((1, block_b, hidden), rev),         # c_all[tt]
+            pl.BlockSpec((1, block_b, hidden), rev),         # dh_all[tt]
+            pl.BlockSpec((block_b, hidden), lambda b, t: (b, 0)),  # dh_T
+            pl.BlockSpec((block_b, hidden), lambda b, t: (b, 0)),  # dc_T
+            pl.BlockSpec((hidden, gate_dim), lambda b, t: (0, 0)),
+            pl.BlockSpec((gate_dim, hidden), lambda b, t: (0, 0)),
+            pl.BlockSpec((block_b, hidden), lambda b, t: (b, 0)),  # h0
+            pl.BlockSpec((block_b, hidden), lambda b, t: (b, 0)),  # c0
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_b, gate_dim), rev),
+            pl.BlockSpec((gate_dim, hidden), lambda b, t: (0, 0)),
+            pl.BlockSpec((block_b, hidden), lambda b, t: (b, 0)),
+            pl.BlockSpec((block_b, hidden), lambda b, t: (b, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((seq_len, batch_p, gate_dim), dtype),
+            jax.ShapeDtypeStruct((gate_dim, hidden), dtype),
+            jax.ShapeDtypeStruct((batch_p, hidden), dtype),
+            jax.ShapeDtypeStruct((batch_p, hidden), dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_b, hidden), jnp.float32),
+            pltpu.VMEM((block_b, hidden), jnp.float32),
+            pltpu.VMEM((gate_dim, hidden), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x_proj, h_all, c_all, c_all, dh_all, dh_T, dc_T, w_hh_t, w_hh, h0, c0)
+    return dx_proj, dw_hh, dh0, dc0
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper: differentiable fused scan
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def fused_lstm_scan(x_proj, w_hh_t, h0, c0, block_b):
+    """Fused LSTM time loop.
+
+    Args: ``x_proj`` (T, Bp, 4H) with both biases folded in, ``w_hh_t``
+    (H, 4H), ``h0``/``c0`` (Bp, H); ``Bp`` must be a multiple of
+    ``block_b``.  Returns ``(h_all (T, Bp, H), (h_T, c_T))``.
+    """
+    h_all, c_all = _lstm_fwd_pallas(x_proj, h0, c0, w_hh_t, block_b=block_b)
+    return h_all, (h_all[-1], c_all[-1])
+
+
+def _fused_fwd(x_proj, w_hh_t, h0, c0, block_b):
+    h_all, c_all = _lstm_fwd_pallas(x_proj, h0, c0, w_hh_t, block_b=block_b)
+    out = (h_all, (h_all[-1], c_all[-1]))
+    return out, (x_proj, h_all, c_all, h0, c0, w_hh_t)
+
+
+def _fused_bwd(block_b, residuals, cotangents):
+    x_proj, h_all, c_all, h0, c0, w_hh_t = residuals
+    dh_all, (dh_T, dc_T) = cotangents
+    dx_proj, dw_hh, dh0, dc0 = _lstm_bwd_pallas(
+        x_proj, h_all, c_all, h0, c0, w_hh_t,
+        dh_all, dh_T, dc_T, block_b=block_b,
+    )
+    return dx_proj, dw_hh.T, dh0, dc0
+
+
+fused_lstm_scan.defvjp(_fused_fwd, _fused_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Layer API (drop-in for ops.rnn.lstm_layer)
+# ---------------------------------------------------------------------------
+
+
+def lstm_layer_fused(params, x, h0=None, c0=None, *, block_b=None):
+    """Drop-in replacement for ``ops.rnn.lstm_layer`` running the time loop
+    as a fused Pallas kernel.  Same params (torch layout), same results.
+    """
+    batch, _, _ = x.shape
+    hidden = params["w_hh"].shape[1]
+    dtype = x.dtype
+
+    if block_b is None:
+        block_b = _pick_block_b(batch)
+    batch_p = _round_up(max(batch, block_b), block_b)
+
+    # One big MXU matmul for every timestep's input projection (both biases
+    # fold into the same pre-activation), then to time-major.
+    x_proj = (
+        jnp.einsum("bti,gi->btg", x, params["w_ih"])
+        + params["b_ih"]
+        + params["b_hh"]
+    )
+    x_proj = jnp.swapaxes(x_proj, 0, 1)  # (T, B, 4H)
+    if batch_p != batch:
+        x_proj = jnp.pad(x_proj, ((0, 0), (0, batch_p - batch), (0, 0)))
+
+    if h0 is None:
+        h0 = jnp.zeros((batch, hidden), dtype)
+    if c0 is None:
+        c0 = jnp.zeros((batch, hidden), dtype)
+    if batch_p != batch:
+        h0 = jnp.pad(h0, ((0, batch_p - batch), (0, 0)))
+        c0 = jnp.pad(c0, ((0, batch_p - batch), (0, 0)))
+
+    h_all, (h_T, c_T) = fused_lstm_scan(
+        x_proj, params["w_hh"].T, h0, c0, block_b
+    )
+    outputs = jnp.swapaxes(h_all, 0, 1)[:batch]
+    return outputs, (h_T[:batch], c_T[:batch])

@@ -156,6 +156,25 @@ def init_stacked_rnn(
     ]
 
 
+def resolve_rnn_impl(impl: str, cell: str) -> str:
+    """Resolve the recurrent-step implementation.
+
+    ``"scan"`` = portable ``lax.scan`` path; ``"fused"`` = Pallas fused
+    time-loop kernel (``ops/pallas_rnn.py``); ``"auto"`` picks the fused
+    kernel on TPU where it is the performance path, and the scan path
+    elsewhere (off-TPU the kernel runs in the slow interpreter).
+    """
+    if impl not in ("auto", "scan", "fused"):
+        raise ValueError(f"unknown rnn impl {impl!r}")
+    if impl == "auto":
+        if cell == "lstm" and jax.default_backend() == "tpu":
+            return "fused"
+        return "scan"
+    if impl == "fused" and cell != "lstm":
+        raise ValueError(f"fused impl supports cell='lstm' only, got {cell!r}")
+    return impl
+
+
 def stacked_rnn(
     layers,
     x,
@@ -164,6 +183,7 @@ def stacked_rnn(
     dropout: float = 0.0,
     dropout_key=None,
     unroll: int = 1,
+    impl: str = "auto",
 ):
     """Apply a stack of RNN layers; dropout between layers (not after the
     last), matching torch's stacked ``nn.LSTM(dropout=...)`` placement.
@@ -174,11 +194,18 @@ def stacked_rnn(
 
     Returns (outputs (B, T, H), list of per-layer final carries).
     """
+    impl = resolve_rnn_impl(impl, cell)
+    if impl == "fused":
+        from pytorch_distributed_rnn_tpu.ops.pallas_rnn import lstm_layer_fused
+
     finals = []
     out = x
     for idx, layer in enumerate(layers):
         if cell == "lstm":
-            out, final = lstm_layer(layer, out, unroll=unroll)
+            if impl == "fused":
+                out, final = lstm_layer_fused(layer, out)
+            else:
+                out, final = lstm_layer(layer, out, unroll=unroll)
         elif cell == "gru":
             out, final = gru_layer(layer, out, unroll=unroll)
         else:

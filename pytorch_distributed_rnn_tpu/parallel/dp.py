@@ -23,6 +23,7 @@ from __future__ import annotations
 from functools import partial
 
 import jax
+import jax.numpy as jnp
 import optax
 from jax.sharding import PartitionSpec as P
 from jax import shard_map
@@ -72,6 +73,35 @@ def distributed_optimizer(optimizer, axis: str = "dp"):
     return optax.GradientTransformation(init, update)
 
 
+def _make_grad_step(loss_and_metrics, optimizer, axis: str, sync: str):
+    """The one grad+sync+update body every SPMD factory shares.
+
+    ``sync="backward"`` (DDP flavor) allreduces gradients right after the
+    backward pass, so the optimizer sees averaged gradients;
+    ``sync="step"`` (Horovod flavor) hands raw local gradients to a
+    :func:`distributed_optimizer` that allreduces inside its update -
+    mirroring where each reference strategy hooks its allreduce.  Returns
+    ``step(params, opt_state, batch, *extra) -> (params, opt_state,
+    local_loss, local_metrics)``; ``*extra`` is forwarded to the loss fn
+    (the weighted-run path's mask).
+    """
+    if sync not in ("backward", "step"):
+        raise ValueError(f"sync must be 'backward' or 'step', got {sync!r}")
+    opt = distributed_optimizer(optimizer, axis) if sync == "step" else optimizer
+
+    def step(params, opt_state, batch, *extra):
+        (loss, metrics), grads = jax.value_and_grad(
+            loss_and_metrics, has_aux=True
+        )(params, batch, *extra)
+        if sync == "backward":
+            grads = pmean_tree(grads, axis)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, metrics
+
+    return step
+
+
 def make_spmd_train_step(
     loss_and_metrics,
     optimizer,
@@ -89,40 +119,167 @@ def make_spmd_train_step(
     replicated; it returns ``(params, opt_state, loss, metrics)`` where
     ``loss`` is the global mean and ``metrics`` are globally summed.
     """
-    if sync not in ("backward", "step"):
-        raise ValueError(f"sync must be 'backward' or 'step', got {sync!r}")
-
-    param_spec = P()  # replicated
-    batch_spec = P(axis)
+    grad_step = _make_grad_step(loss_and_metrics, optimizer, axis, sync)
+    rep = P()
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(param_spec, param_spec, batch_spec),
-        out_specs=(param_spec, param_spec, param_spec, param_spec),
+        in_specs=(rep, rep, P(axis)),
+        out_specs=(rep, rep, rep, rep),
         check_vma=False,
     )
     def _step(params, opt_state, batch):
-        (loss, metrics), grads = jax.value_and_grad(
-            loss_and_metrics, has_aux=True
-        )(params, batch)
+        params, opt_state, loss, metrics = grad_step(params, opt_state, batch)
+        return (
+            params,
+            opt_state,
+            jax.lax.pmean(loss, axis),
+            psum_tree(metrics, axis),
+        )
 
-        if sync == "backward":
-            # DDP flavor: allreduce right after backward, optimizer sees
-            # averaged gradients.
-            grads = pmean_tree(grads, axis)
-            updates, opt_state = optimizer.update(grads, opt_state, params)
-        else:
-            # Horovod flavor: raw local gradients go into a
-            # distributed_optimizer, which allreduces inside its update.
-            updates, opt_state = distributed_optimizer(optimizer, axis).update(
-                grads, opt_state, params
+    return jax.jit(_step, donate_argnums=(0, 1) if donate else ())
+
+
+def make_spmd_idx_train_step(
+    loss_and_metrics,
+    optimizer,
+    mesh,
+    axis: str = "dp",
+    sync: str = "backward",
+    donate: bool = True,
+):
+    """Like :func:`make_spmd_train_step` but the batch is selected ON
+    DEVICE: ``step(params, opt_state, features, labels, idx)`` gathers
+    ``(features[idx], labels[idx])`` inside the SPMD program.
+
+    TPU-native data path: the dataset lives in HBM (replicated), and only
+    the per-batch *indices* cross host->device each step - the reference
+    instead re-loads per-rank tensors from host memory every batch
+    (``/root/reference/src/motion/trainer/base.py:107``), which over a slow
+    host link starves the accelerator.  ``idx`` is sharded along ``axis``
+    (rank-major), so each shard gathers exactly its rank's micro-batch.
+    """
+    grad_step = _make_grad_step(loss_and_metrics, optimizer, axis, sync)
+    rep = P()
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(rep, rep, rep, rep, P(axis)),
+        out_specs=(rep, rep, rep, rep),
+        check_vma=False,
+    )
+    def _step(params, opt_state, features, labels, idx):
+        batch = (features[idx], labels[idx])
+        params, opt_state, loss, metrics = grad_step(params, opt_state, batch)
+        return (
+            params,
+            opt_state,
+            jax.lax.pmean(loss, axis),
+            psum_tree(metrics, axis),
+        )
+
+    return jax.jit(_step, donate_argnums=(0, 1) if donate else ())
+
+
+def make_spmd_epoch_fn(
+    loss_and_metrics,
+    optimizer,
+    mesh,
+    axis: str = "dp",
+    sync: str = "backward",
+    donate: bool = True,
+):
+    """Whole-epoch SPMD program: ``lax.scan`` over the epoch's batch-index
+    matrix, one device dispatch per epoch.
+
+    ``epoch_fn(params, opt_state, features, labels, idx_mat)`` with
+    ``idx_mat`` of shape (num_batches, global_batch) sharded
+    ``P(None, axis)`` runs every train step back-to-back on device and
+    returns ``(params, opt_state, loss_sum, metrics_sum)`` where
+    ``loss_sum`` is the sum over batches of the global-mean batch loss (the
+    quantity the reference accumulates, ``base.py:123-128``).  Eliminates
+    per-step dispatch/transfer latency entirely - the TPU-native answer to
+    the reference's per-batch Python loop.
+    """
+    grad_step = _make_grad_step(loss_and_metrics, optimizer, axis, sync)
+    rep = P()
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(rep, rep, rep, rep, P(None, axis)),
+        out_specs=(rep, rep, rep, rep),
+        check_vma=False,
+    )
+    def _epoch(params, opt_state, features, labels, idx_mat):
+        def body(carry, idx):
+            params, opt_state = carry
+            batch = (features[idx], labels[idx])
+            params, opt_state, loss, metrics = grad_step(
+                params, opt_state, batch
+            )
+            return (params, opt_state), (jax.lax.pmean(loss, axis), metrics)
+
+        (params, opt_state), (losses, metrics) = jax.lax.scan(
+            body, (params, opt_state), idx_mat
+        )
+        loss_sum = jnp.sum(losses)
+        metrics_sum = psum_tree(
+            jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics), axis
+        )
+        return params, opt_state, loss_sum, metrics_sum
+
+    return jax.jit(_epoch, donate_argnums=(0, 1) if donate else ())
+
+
+def make_spmd_run_fn(
+    weighted_loss_and_metrics,
+    optimizer,
+    mesh,
+    axis: str = "dp",
+    sync: str = "backward",
+    donate: bool = True,
+):
+    """The whole multi-epoch training run as ONE SPMD program: scan over
+    every (weight-masked) batch of every epoch.
+
+    ``run(params, opt_state, features, labels, idx_mat, w_mat)`` with
+    ``idx_mat``/``w_mat`` of shape (total_steps, global_batch) sharded
+    ``P(None, axis)``; returns per-step global-mean losses and summed
+    correct-counts.  The weighted local means pmean exactly to the global
+    weighted mean because every rank's chunk carries the same number of
+    live examples (the sampler pads shards to equal length, and batch
+    padding is per-rank-equal by construction).
+    """
+    grad_step = _make_grad_step(weighted_loss_and_metrics, optimizer, axis, sync)
+    rep = P()
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(rep, rep, rep, rep, P(None, axis), P(None, axis)),
+        out_specs=(rep, rep, rep, rep),
+        check_vma=False,
+    )
+    def _run(params, opt_state, features, labels, idx_mat, w_mat):
+        def body(carry, step_in):
+            params, opt_state = carry
+            idx, w = step_in
+            batch = (features[idx], labels[idx])
+            params, opt_state, loss, metrics = grad_step(
+                params, opt_state, batch, w
+            )
+            return (params, opt_state), (
+                jax.lax.pmean(loss, axis),
+                metrics["correct"],
             )
 
-        params = optax.apply_updates(params, updates)
-        loss = jax.lax.pmean(loss, axis)
-        metrics = psum_tree(metrics, axis)
-        return params, opt_state, loss, metrics
+        (params, opt_state), (losses, correct) = jax.lax.scan(
+            body, (params, opt_state), (idx_mat, w_mat)
+        )
+        # one vector psum after the scan instead of one per step
+        return params, opt_state, losses, jax.lax.psum(correct, axis)
 
-    jitted = jax.jit(_step, donate_argnums=(0, 1) if donate else ())
-    return jitted
+    return jax.jit(_run, donate_argnums=(0, 1) if donate else ())

@@ -32,6 +32,10 @@ log = logging.getLogger(__name__)
 class ParameterServerWorkerTrainer(Trainer):
     """Trainer whose optimizer step happens on the master."""
 
+    # every step pushes gradients / pulls params over TCP: the host must
+    # act per batch, so the scanned device-resident epoch path cannot apply
+    DEVICE_DATA = False
+
     def __init__(
         self,
         comm,

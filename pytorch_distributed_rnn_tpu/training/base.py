@@ -46,7 +46,20 @@ class Trainer:
 
     ``model`` is a functional model object with ``init(key)`` / ``apply``
     (e.g. ``MotionModel``); ``training_set`` etc. are array datasets.
+
+    Data path (``DEVICE_DATA = True``): the training arrays are placed in
+    device memory ONCE and every batch is gathered on device from a small
+    per-step index vector - when per-batch progress logging is off, the
+    whole epoch additionally runs as ONE ``lax.scan`` program (a single
+    dispatch per epoch).  This replaces the reference's per-batch
+    host-loads (``/root/reference/src/motion/trainer/base.py:107``), which
+    on an accelerator behind a host link leave the chip idle between steps.
+    Strategies that must act on the host every batch (the parameter-server
+    worker pushing gradients over TCP) set ``DEVICE_DATA = False`` and keep
+    the materialized-batch loop.
     """
+
+    DEVICE_DATA = True
 
     def __init__(
         self,
@@ -80,6 +93,11 @@ class Trainer:
 
         self._train_step_fn = None
         self._eval_step_fn = None
+        self._idx_step_fn = None
+        self._epoch_fn = None
+        self._run_fn = None
+        self._device_data = None
+        self._eval_data_cache = {}
         self._resume_best_loss = None
 
     # -- subclass hooks ------------------------------------------------------
@@ -97,21 +115,94 @@ class Trainer:
         correct = jnp.sum(jnp.argmax(logits, axis=1) == y)
         return loss, {"correct": correct}
 
-    def _build_train_step(self):
-        """One fused XLA program: grad + update + metrics."""
+    def _weighted_loss_and_metrics(self, params, batch, w):
+        """Masked variant used by the fused whole-run program: ``w`` is a
+        0/1 weight per example.  With all-ones weights this equals
+        ``_loss_and_metrics`` exactly; with a zero-padded tail it equals
+        the reference's smaller final batch's mean (``base.py:46-51``).
+        Override together with ``_loss_and_metrics``."""
+        x, y = batch
+        logits = self.model.apply(params, x)
+        nll = cross_entropy_loss(logits, y, reduction="none")
+        loss = jnp.sum(nll * w) / jnp.sum(w)
+        correct = jnp.sum((jnp.argmax(logits, axis=1) == y) * (w > 0))
+        return loss, {"correct": correct}
 
-        def step(params, opt_state, batch):
+    def _make_grad_step(self, loss_and_metrics):
+        """The shared grad+update body: ``step(params, opt_state, batch,
+        *extra) -> (params, opt_state, loss, metrics)``; ``*extra`` is
+        forwarded to the loss fn (the weighted-run path's mask)."""
+
+        def step(params, opt_state, batch, *extra):
             (loss, metrics), grads = jax.value_and_grad(
-                self._loss_and_metrics, has_aux=True
-            )(params, batch)
+                loss_and_metrics, has_aux=True
+            )(params, batch, *extra)
             updates, opt_state = self.optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             return params, opt_state, loss, metrics
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        return step
+
+    def _build_train_step(self):
+        """One fused XLA program: grad + update + metrics."""
+        return jax.jit(
+            self._make_grad_step(self._loss_and_metrics), donate_argnums=(0, 1)
+        )
 
     def _build_eval_step(self):
         return jax.jit(self._loss_and_metrics)
+
+    def _build_idx_train_step(self):
+        """Train step taking (params, opt_state, features, labels, idx):
+        the batch is gathered on device from resident arrays."""
+        grad_step = self._make_grad_step(self._loss_and_metrics)
+
+        def step(params, opt_state, features, labels, idx):
+            return grad_step(params, opt_state, (features[idx], labels[idx]))
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def _build_epoch_fn(self):
+        """Whole-epoch program: ``lax.scan`` over the epoch's (num_batches,
+        batch) index matrix - one dispatch per epoch."""
+        grad_step = self._make_grad_step(self._loss_and_metrics)
+
+        def epoch(params, opt_state, features, labels, idx_mat):
+            def body(carry, idx):
+                params, opt_state, loss, metrics = grad_step(
+                    *carry, (features[idx], labels[idx])
+                )
+                return (params, opt_state), (loss, metrics)
+
+            (params, opt_state), (losses, metrics) = jax.lax.scan(
+                body, (params, opt_state), idx_mat
+            )
+            metrics_sum = jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics)
+            return params, opt_state, jnp.sum(losses), metrics_sum
+
+        return jax.jit(epoch, donate_argnums=(0, 1))
+
+    def _build_run_fn(self):
+        """The whole multi-epoch training run as ONE program: scan over
+        every batch of every epoch (weight-masked so the final partial
+        batch keeps reference semantics), returning per-step losses and
+        correct-counts for the host to fold into per-epoch history."""
+        grad_step = self._make_grad_step(self._weighted_loss_and_metrics)
+
+        def run(params, opt_state, features, labels, idx_mat, w_mat):
+            def body(carry, step_in):
+                idx, w = step_in
+                params, opt_state, loss, metrics = grad_step(
+                    *carry, (features[idx], labels[idx]), w
+                )
+                return (params, opt_state), (loss, metrics["correct"])
+
+            (params, opt_state), (losses, correct) = jax.lax.scan(
+                body, (params, opt_state), (idx_mat, w_mat)
+            )
+            return params, opt_state, losses, correct
+
+        return jax.jit(run, donate_argnums=(0, 1))
 
     # -- data ----------------------------------------------------------------
 
@@ -123,18 +214,80 @@ class Trainer:
     def _prepare_batch(self, features, labels):
         return jnp.asarray(features), jnp.asarray(labels).reshape(-1)
 
+    def _data_sharding(self):
+        """Sharding for device-resident dataset arrays (None = default
+        placement; SPMD subclasses replicate over the mesh)."""
+        return None
+
+    def _device_train_data(self):
+        """Training arrays resident on device (uploaded once, cached)."""
+        if self._device_data is None:
+            features = np.asarray(self.training_set.features)
+            labels = np.asarray(self.training_set.labels).reshape(-1)
+            sharding = self._data_sharding()
+            if sharding is None:
+                self._device_data = (
+                    jax.device_put(features),
+                    jax.device_put(labels),
+                )
+            else:
+                self._device_data = (
+                    jax.device_put(features, sharding),
+                    jax.device_put(labels, sharding),
+                )
+        return self._device_data
+
+    def _epoch_index_batches(self):
+        """The epoch's batches as a list of index arrays, in order.  All
+        but possibly the last have equal size (reference loader semantics:
+        final partial batch included, ``base.py:46-51``)."""
+        indices = np.asarray(self.sampler.indices())
+        return [
+            indices[start : start + self.batch_size]
+            for start in range(0, len(indices), self.batch_size)
+        ]
+
+    def _pad_batch(self, b, full_size):
+        """Pad an index batch to ``full_size`` with zero-weighted dummy
+        examples (index 0, weight 0) for the fused fixed-shape run."""
+        pad = full_size - len(b)
+        if pad == 0:
+            return b, np.ones(full_size, np.float32)
+        return (
+            np.concatenate([b, np.zeros(pad, dtype=b.dtype)]),
+            np.concatenate([np.ones(len(b), np.float32), np.zeros(pad, np.float32)]),
+        )
+
     # -- loop ----------------------------------------------------------------
 
     def train(self, epochs: int):
         training_history: list[float] = []
         validation_history: list[float] = []
         formatter = self._get_formatter(epochs)
-        if self._train_step_fn is None:
+        if self.DEVICE_DATA:
+            if self._idx_step_fn is None:
+                self._idx_step_fn = self._build_idx_train_step()
+            if self._epoch_fn is None:
+                self._epoch_fn = self._build_epoch_fn()
+        elif self._train_step_fn is None:
             self._train_step_fn = self._build_train_step()
         if self._eval_step_fn is None:
             self._eval_step_fn = self._build_eval_step()
 
+        # the whole run fuses into one device program when nothing needs
+        # the host between batches or epochs: no per-epoch validation /
+        # checkpointing, no per-batch progress logging
+        fused_run = (
+            self.DEVICE_DATA
+            and self.validation_set is None
+            and epochs > 0
+            and not logging.getLogger().isEnabledFor(logging.INFO)
+        )
+
         def train_inner():
+            if fused_run:
+                training_history.extend(self._train_run_fused(epochs))
+                return
             # seed the best-model threshold from a resumed checkpoint so a
             # worse post-resume epoch cannot clobber best-model.ckpt
             best_loss = self._resume_best_loss
@@ -162,12 +315,102 @@ class Trainer:
 
         return self.params, training_history, validation_history
 
+    def _train_run_fused(self, epochs: int):
+        """Run ``epochs`` epochs as one device program; returns the
+        per-epoch train-loss history (reference normalization: sum of
+        batch-mean losses / dataset size)."""
+        if self._run_fn is None:
+            self._run_fn = self._build_run_fn()
+        features, labels = self._device_train_data()
+
+        idx_rows, w_rows = [], []
+        num_batches = None
+        for epoch in range(epochs):
+            self.sampler.set_epoch(epoch)
+            batches = self._epoch_index_batches()
+            num_batches = len(batches)
+            full_size = len(batches[0])
+            for b in batches:
+                idx, w = self._pad_batch(b, full_size)
+                idx_rows.append(idx)
+                w_rows.append(w)
+        idx_mat = np.stack(idx_rows)
+        w_mat = np.stack(w_rows)
+
+        self.params, self.opt_state, losses, correct = self._run_fn(
+            self.params, self.opt_state, features, labels, idx_mat, w_mat
+        )
+        losses = np.asarray(losses).reshape(epochs, num_batches)
+        n = len(self.training_set)
+        return [float(losses[e].sum()) / n for e in range(epochs)]
+
     def _train_epoch(self, formatter):
-        # Accumulate on-device and convert once per epoch: per-batch
-        # float()/int() would block on a host-device sync every step and
-        # serialize XLA's async dispatch.  Per-batch logging (which needs
-        # the values on host) only happens when INFO is actually enabled.
-        log_progress = logging.getLogger().isEnabledFor(logging.INFO)
+        if not self.DEVICE_DATA:
+            return self._train_epoch_host(formatter)
+
+        # per-batch progress moved INFO -> DEBUG (conscious fix, PARITY.md):
+        # each progress message needs loss/correct on host, serializing one
+        # device round-trip per batch; at INFO the epoch runs as one
+        # scanned program and only epoch-level messages are emitted
+        log_progress = logging.getLogger().isEnabledFor(logging.DEBUG)
+        features, labels = self._device_train_data()
+        batches = self._epoch_index_batches()
+        total_loss = jnp.zeros(())
+        total_correct = jnp.zeros((), jnp.int32)
+
+        if log_progress:
+            # per-batch progress needs values on host each step: dispatch
+            # batch-by-batch (still device-gathered, only indices transfer)
+            for batch_idx, idx in enumerate(batches):
+                self.params, self.opt_state, loss, metrics = self._idx_step_fn(
+                    self.params, self.opt_state, features, labels, idx
+                )
+                total_loss = total_loss + loss
+                total_correct = total_correct + metrics["correct"]
+                logging.info(
+                    formatter.train_progress_message(
+                        batch_idx=batch_idx,
+                        batches=len(batches),
+                        training_examples=len(idx),
+                        correct=int(metrics["correct"]),
+                        loss=float(loss),
+                    )
+                )
+        else:
+            # fast path: all equal-size batches as ONE scanned program,
+            # the final partial batch (if any) as one extra step
+            full = batches
+            remainder = None
+            if len(batches) > 1 and len(batches[-1]) != len(batches[0]):
+                full, remainder = batches[:-1], batches[-1]
+            if full:
+                idx_mat = np.stack(full)
+                (
+                    self.params,
+                    self.opt_state,
+                    loss_sum,
+                    metrics_sum,
+                ) = self._epoch_fn(
+                    self.params, self.opt_state, features, labels, idx_mat
+                )
+                total_loss = total_loss + loss_sum
+                total_correct = total_correct + metrics_sum["correct"]
+            if remainder is not None:
+                self.params, self.opt_state, loss, metrics = self._idx_step_fn(
+                    self.params, self.opt_state, features, labels, remainder
+                )
+                total_loss = total_loss + loss
+                total_correct = total_correct + metrics["correct"]
+
+        # parity quirk kept: sum of batch-mean losses / dataset size
+        train_loss = float(total_loss) / len(self.training_set)
+        train_acc = int(total_correct) / len(self.training_set)
+        return train_loss, train_acc
+
+    def _train_epoch_host(self, formatter):
+        """Legacy materialized-batch loop (used when the strategy must act
+        on host every step, e.g. the parameter-server worker)."""
+        log_progress = logging.getLogger().isEnabledFor(logging.DEBUG)
         total_loss = jnp.zeros(())
         total_correct = jnp.zeros((), jnp.int32)
         loader = self._train_loader()
@@ -199,8 +442,15 @@ class Trainer:
     def _evaluate(self, dataset, formatter, epoch=None):
         """Full-dataset evaluation in one batch (reference loads val/test
         with batch_size=len(dataset), base.py:53-54)."""
-        features, labels = dataset[np.arange(len(dataset))]
-        batch = self._prepare_batch(features, labels)
+        # cache holds (dataset, batch): the strong reference keeps id()
+        # stable (a collected dataset's id could be reused by a new one)
+        key = id(dataset)
+        cached = self._eval_data_cache.get(key)
+        if cached is None or cached[0] is not dataset:
+            features, labels = dataset[np.arange(len(dataset))]
+            cached = (dataset, self._prepare_batch(features, labels))
+            self._eval_data_cache[key] = cached
+        batch = cached[1]
         loss, metrics = self._eval_step_fn(self.params, batch)
         eval_loss = float(loss)  # one batch -> already the mean-of-batches
         total_correct = int(metrics["correct"])

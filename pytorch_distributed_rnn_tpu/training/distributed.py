@@ -22,8 +22,16 @@ the reference's rank-0-only evaluation (``distributed.py:20-22``).
 
 from __future__ import annotations
 
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
 from pytorch_distributed_rnn_tpu.data.sampler import DistributedSampler
-from pytorch_distributed_rnn_tpu.parallel.dp import make_spmd_train_step
+from pytorch_distributed_rnn_tpu.parallel.dp import (
+    make_spmd_epoch_fn,
+    make_spmd_idx_train_step,
+    make_spmd_run_fn,
+    make_spmd_train_step,
+)
 from pytorch_distributed_rnn_tpu.parallel.mesh import make_mesh
 from pytorch_distributed_rnn_tpu.training.base import Trainer
 from pytorch_distributed_rnn_tpu.training.formatter import TrainingMessageFormatter
@@ -79,6 +87,73 @@ class SpmdTrainer(Trainer):
             axis=self.axis,
             sync=self.SYNC,
         )
+
+    def _build_idx_train_step(self):
+        return make_spmd_idx_train_step(
+            self._loss_and_metrics,
+            self.optimizer,
+            self.mesh,
+            axis=self.axis,
+            sync=self.SYNC,
+        )
+
+    def _build_epoch_fn(self):
+        return make_spmd_epoch_fn(
+            self._loss_and_metrics,
+            self.optimizer,
+            self.mesh,
+            axis=self.axis,
+            sync=self.SYNC,
+        )
+
+    def _build_run_fn(self):
+        return make_spmd_run_fn(
+            self._weighted_loss_and_metrics,
+            self.optimizer,
+            self.mesh,
+            axis=self.axis,
+            sync=self.SYNC,
+        )
+
+    def _data_sharding(self):
+        # dataset replicated over the mesh; per-batch index vectors shard
+        # along dp so each device gathers its rank's micro-batch locally
+        return NamedSharding(self.mesh, P())
+
+    def _epoch_index_batches(self):
+        """Rank-major global-batch index vectors: device r's shard of each
+        batch is exactly what MPI rank r would have loaded (per-rank batch
+        = batch_size // world_size, reference ``distributed.py:48-49``)."""
+        per_rank_bs = max(1, self.batch_size // self.world_size)
+        shards = self.sampler.global_indices()  # (world, num_samples)
+        num_samples = shards.shape[1]
+        return [
+            shards[:, start : start + per_rank_bs].reshape(-1)
+            for start in range(0, num_samples, per_rank_bs)
+        ]
+
+    def _pad_batch(self, b, full_size):
+        """Rank-major padding: each rank's chunk is padded independently so
+        sharding the padded batch along ``dp`` keeps rank alignment (and
+        every rank carries the same number of live examples, which makes
+        the pmean of local weighted means exact)."""
+        if len(b) == full_size:
+            return b, np.ones(full_size, np.float32)
+        world = self.world_size
+        per_rank_full = full_size // world
+        chunk = b.reshape(world, -1)
+        pad = per_rank_full - chunk.shape[1]
+        idx = np.concatenate(
+            [chunk, np.zeros((world, pad), dtype=b.dtype)], axis=1
+        ).reshape(-1)
+        w = np.concatenate(
+            [
+                np.ones_like(chunk, dtype=np.float32),
+                np.zeros((world, pad), np.float32),
+            ],
+            axis=1,
+        ).reshape(-1)
+        return idx, w
 
     def _train_loader(self):
         """Yield rank-major global batches.
