@@ -1,0 +1,301 @@
+"""Sequence/context parallelism for recurrent models.
+
+The reference has no long-sequence story at all: sequence length is a fixed
+property of the data (128 HAR timesteps consumed on one device,
+``/root/reference/src/motion/model.py:13-16``, ``processor.py:93``).  This
+module is the TPU-native capability that lifts that limit: the time axis is
+sharded over an ``sp`` mesh axis, so a sequence S times longer fits in the
+same per-chip HBM and the parallelizable work scales out.
+
+An LSTM/GRU splits cleanly into two cost classes:
+
+- **Input projections** ``(B*T, in) x (in, 4H)`` - the large MXU matmuls
+  where the FLOPs are.  These have no time dependency and run fully parallel
+  on the sharded time chunks.
+- **Gate recurrence** - inherently serial in T.  It runs as a *chunk relay*:
+  every turn, all shards scan their local chunk; the (h, c) carry then hops
+  to the next shard via ``lax.ppermute`` (XLA CollectivePermute over ICI).
+  Shard ``s``'s scan consumes the correct incoming carry exactly at turn
+  ``s`` (induction: shard 0 starts from the true initial carry at turn 0;
+  shard ``s`` receives shard ``s-1``'s turn-``s-1`` result), so its outputs
+  are captured at that turn.  Serial latency stays O(T) - that is the
+  recurrence's true dependency depth - but per-chip memory and all
+  projection FLOPs scale 1/S.
+
+For stacked RNNs the relay admits a **wavefront schedule**: cell
+``(layer l, chunk s)`` depends on ``(l, s-1)`` (carry) and ``(l-1, s)``
+(activations, already resident on shard ``s``).  Scheduling ``l = w - s`` at
+wavefront ``w`` overlaps layers across shards, finishing in ``L + S - 1``
+turns of ``T/S`` recurrence steps each - latency ``T + (L-1)*T/S`` instead
+of the layer-sequential ``L*T``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from pytorch_distributed_rnn_tpu.parallel.collectives import broadcast_from
+
+
+def _lstm_chunk_scan(w_hh_t, carry, x_proj_chunk, unroll: int = 1):
+    """Scan the LSTM gate recurrence over one local time chunk.
+
+    ``x_proj_chunk``: (B, T_local, 4H) pre-activations (input projection plus
+    both biases already folded in); ``carry``: ``(h, c)`` each (B, H).
+    Returns ``((h, c), outputs (B, T_local, H))``.
+    """
+
+    def step(carry, xp_t):
+        h, c = carry
+        gates = xp_t + h @ w_hh_t
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c), h
+
+    carry, out = lax.scan(
+        step, carry, jnp.swapaxes(x_proj_chunk, 0, 1), unroll=unroll
+    )
+    return carry, jnp.swapaxes(out, 0, 1)
+
+
+def _relay(axis: str, n: int, carry, chunk_fn):
+    """Run ``chunk_fn(carry) -> (carry, outputs)`` as an ``n``-turn relay
+    over mesh axis ``axis``.
+
+    All shards execute every turn (SPMD); shard ``s``'s outputs are valid at
+    turn ``s`` and captured then.  Carries rotate one hop per turn.  Returns
+    ``(final_carry, outputs)`` with ``final_carry`` = the last shard's carry,
+    replicated to all shards.
+    """
+    idx = lax.axis_index(axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def select(active, new, old):
+        return jax.tree.map(
+            lambda a, b: jnp.where(active, a, b), new, old
+        )
+
+    def turn(state, t):
+        carry, outputs = state
+        new_carry, new_out = chunk_fn(carry)
+        outputs = select(idx == t, new_out, outputs)
+        shifted = jax.tree.map(
+            lambda x: lax.ppermute(x, axis, perm), new_carry
+        )
+        # shard t+1 adopts what arrived; everyone else keeps their state so
+        # an already-captured carry isn't clobbered by garbage.
+        carry = select(idx == t + 1, shifted, carry)
+        return (carry, outputs), new_carry
+
+    out0 = jax.eval_shape(chunk_fn, carry)[1]
+    outputs = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), out0)
+    (_, outputs), carries = lax.scan(
+        turn, (carry, outputs), jnp.arange(n)
+    )
+
+    # At turn n-1 the last shard is the active one, so its new_carry is the
+    # true final carry; take that turn's slot and broadcast from shard n-1.
+    final_carry = jax.tree.map(lambda x: x[-1], carries)
+    final_carry = broadcast_from(final_carry, axis, n - 1)
+    return final_carry, outputs
+
+
+def sp_lstm_layer(params, x_local, axis: str, *, unroll: int = 1):
+    """One LSTM layer over a time-sharded sequence, inside ``shard_map``.
+
+    ``x_local``: this shard's (B, T/S, in) time chunk.  Returns
+    ``(outputs_local (B, T/S, H), (h_T, c_T))`` with the final carry
+    replicated across the ``sp`` axis.  Numerics match
+    :func:`~pytorch_distributed_rnn_tpu.ops.rnn.lstm_layer` on the gathered
+    sequence exactly (same gate order, same fold of both biases into the
+    input projection).
+    """
+    n = lax.axis_size(axis)
+    batch = x_local.shape[0]
+    hidden = params["w_hh"].shape[1]
+    dtype = x_local.dtype
+
+    # Fully parallel across time shards: the big MXU matmul.
+    x_proj = (
+        jnp.einsum("bti,gi->btg", x_local, params["w_ih"])
+        + params["b_ih"]
+        + params["b_hh"]
+    )
+    w_hh_t = params["w_hh"].T
+
+    h0 = jnp.zeros((batch, hidden), dtype)
+    c0 = jnp.zeros((batch, hidden), dtype)
+
+    chunk_fn = partial(_lstm_chunk_scan, w_hh_t, x_proj_chunk=x_proj,
+                       unroll=unroll)
+    final, outputs = _relay(axis, n, (h0, c0), lambda c: chunk_fn(c))
+    return outputs, final
+
+
+def sp_stacked_lstm(layers, x_local, axis: str, *, unroll: int = 1):
+    """Layer-sequential stacked LSTM over a time-sharded sequence.
+
+    Each layer is a full relay; total latency O(L*T).  Prefer
+    :func:`sp_stacked_lstm_wavefront` when L > 1.
+    Returns ``(outputs_local, [per-layer final carries])``.
+    """
+    finals = []
+    out = x_local
+    for layer in layers:
+        out, final = sp_lstm_layer(layer, out, axis, unroll=unroll)
+        finals.append(final)
+    return out, finals
+
+
+def _stack_layer_params(layers):
+    """Stack homogeneous (input size == hidden) layer dicts into arrays with
+    a leading layer axis, for dynamic indexing inside the wavefront loop."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+
+
+def sp_stacked_lstm_wavefront(layers, x_local, axis: str, *,
+                              unroll: int = 1):
+    """Wavefront-scheduled stacked LSTM over a time-sharded sequence.
+
+    Cell ``(l, s)`` = layer ``l``'s recurrence over shard ``s``'s chunk.  At
+    wavefront ``w`` shard ``s`` computes ``l = w - s`` (when ``0 <= l < L``):
+    the carry for ``(l, s)`` arrived from shard ``s-1`` at wavefront ``w-1``,
+    and the layer input - layer ``l-1``'s output on this chunk - was produced
+    locally at wavefront ``w-1``.  ``L + S - 1`` wavefronts total, so deep
+    stacks overlap across shards instead of serializing (GPipe's schedule,
+    transposed onto the time axis).
+
+    Layer 0 (input size != hidden) is hoisted out of the homogeneous
+    wavefront loop: its input projection depends on the raw features, every
+    deeper layer consumes (B, T/S, H).  Returns
+    ``(outputs_local, [per-layer final carries])`` matching
+    :func:`sp_stacked_lstm` exactly.
+    """
+    if len(layers) == 1:
+        out, final = sp_lstm_layer(layers[0], x_local, axis, unroll=unroll)
+        return out, [final]
+
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # Layer 0 runs as a plain relay (heterogeneous input width)...
+    out0, final0 = sp_lstm_layer(layers[0], x_local, axis, unroll=unroll)
+
+    # ...then layers 1..L-1 run as one wavefront over stacked params.
+    deep = layers[1:]
+    L = len(deep)
+    stacked = _stack_layer_params(deep)
+    batch, t_local, _ = out0.shape
+    hidden = deep[0]["w_hh"].shape[1]
+    dtype = out0.dtype
+
+    def select(active, new, old):
+        return jax.tree.map(lambda a, b: jnp.where(active, a, b), new, old)
+
+    zero_carry = (
+        jnp.zeros((batch, hidden), dtype),
+        jnp.zeros((batch, hidden), dtype),
+    )
+
+    def wavefront(state, w):
+        # acts: (B, T/S, H) current input activations for this shard's next
+        # assigned layer; carry: incoming (h, c); outs: captured last-layer
+        # outputs; finals: (L, B, H) x2 captured per-layer final carries.
+        acts, carry, outs, finals = state
+        l = w - idx
+        active = (l >= 0) & (l < L)
+        l_safe = jnp.clip(l, 0, L - 1)
+        layer = jax.tree.map(
+            lambda p: lax.dynamic_index_in_dim(p, l_safe, keepdims=False),
+            stacked,
+        )
+        x_proj = (
+            jnp.einsum("bti,gi->btg", acts, layer["w_ih"])
+            + layer["b_ih"]
+            + layer["b_hh"]
+        )
+        new_carry, new_out = _lstm_chunk_scan(
+            layer["w_hh"].T, carry, x_proj, unroll=unroll
+        )
+
+        # capture final carries: shard n-1 finishing layer l
+        is_final = active & (idx == n - 1)
+        finals = jax.tree.map(
+            lambda buf, new: jnp.where(
+                is_final
+                & (jnp.arange(L)[:, None, None] == l_safe),
+                new[None], buf,
+            ),
+            finals, new_carry,
+        )
+        # capture last-layer outputs on every shard
+        outs = select(active & (l == L - 1), new_out, outs)
+        # next wavefront's input on this shard is this wavefront's output
+        acts = select(active, new_out, acts)
+
+        # relay the carry to the next shard; shard 0 always (re)starts the
+        # next layer from zeros.
+        shifted = jax.tree.map(
+            lambda x: lax.ppermute(x, axis, perm), new_carry
+        )
+        carry = select(idx == 0, zero_carry, shifted)
+        return (acts, carry, outs, finals), None
+
+    outs = jnp.zeros((batch, t_local, hidden), dtype)
+    finals_buf = (
+        jnp.zeros((L, batch, hidden), dtype),
+        jnp.zeros((L, batch, hidden), dtype),
+    )
+    (_, _, outs, finals_buf), _ = lax.scan(
+        wavefront,
+        (out0, zero_carry, outs, finals_buf),
+        jnp.arange(L + n - 1),
+    )
+    # final carries live on shard n-1 only; replicate.
+    finals_buf = broadcast_from(finals_buf, axis, n - 1)
+    finals = [final0] + [
+        (finals_buf[0][l], finals_buf[1][l]) for l in range(L)
+    ]
+    return outs, finals
+
+
+def make_sp_forward(model_params, mesh, axis: str = "sp", *,
+                    schedule: str = "wavefront", unroll: int = 1):
+    """Build a jitted sequence-parallel forward for a MotionModel-shaped
+    params tree (``{"rnn": [...], "fc": {...}}``): stacked LSTM over a
+    time-sharded (B, T, in) input followed by the last-timestep projection.
+
+    The input is sharded ``P(None, axis)`` (time), the logits come back
+    replicated - only the shard owning the last chunk computes a non-trivial
+    projection; a psum-based broadcast makes the result uniform.
+    """
+    if schedule not in ("wavefront", "sequential"):
+        raise ValueError(f"unknown schedule {schedule!r}")
+    n = mesh.shape[axis]
+    stack = (
+        sp_stacked_lstm_wavefront if schedule == "wavefront"
+        else sp_stacked_lstm
+    )
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(None, axis)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def forward(params, x_local):
+        out_local, _ = stack(params["rnn"], x_local, axis, unroll=unroll)
+        last = out_local[:, -1, :]  # true last step only on shard n-1
+        logits = last @ params["fc"]["weight"].T + params["fc"]["bias"]
+        return broadcast_from(logits, axis, n - 1)
+
+    return jax.jit(forward)
