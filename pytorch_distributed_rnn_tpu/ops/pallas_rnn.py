@@ -67,8 +67,8 @@ def _lstm_fwd_kernel(x_proj_ref, h0_ref, c0_ref, w_hh_t_ref,
 
     @pl.when(t == 0)
     def _():
-        h_scr[:] = h0_ref[:]
-        c_scr[:] = c0_ref[:]
+        h_scr[:] = h0_ref[:].astype(jnp.float32)
+        c_scr[:] = c0_ref[:].astype(jnp.float32)
 
     h = h_scr[:]
     c = c_scr[:]
@@ -84,8 +84,8 @@ def _lstm_fwd_kernel(x_proj_ref, h0_ref, c0_ref, w_hh_t_ref,
     h = o * jnp.tanh(c)
     h_scr[:] = h
     c_scr[:] = c
-    h_all_ref[0] = h
-    c_all_ref[0] = c
+    h_all_ref[0] = h.astype(h_all_ref.dtype)
+    c_all_ref[0] = c.astype(c_all_ref.dtype)
 
 
 def _lstm_fwd_pallas(x_proj, h0, c0, w_hh_t, *, block_b):
@@ -145,8 +145,8 @@ def _lstm_bwd_kernel(x_proj_ref, h_prev_ref, c_prev_ref, c_t_ref,
 
     @pl.when(tt_is_first)
     def _():
-        dh_scr[:] = dh_T_ref[:]
-        dc_scr[:] = dc_T_ref[:]
+        dh_scr[:] = dh_T_ref[:].astype(jnp.float32)
+        dc_scr[:] = dc_T_ref[:].astype(jnp.float32)
 
     # At tt == 0 the "previous" state is the initial carry, not a saved step.
     h_prev = jnp.where(tt_is_last, h0_ref[:], h_prev_ref[0])

@@ -58,6 +58,20 @@ def init_gru_layer(key, input_size: int, hidden_size: int, dtype=jnp.float32):
 # Single layers
 # ---------------------------------------------------------------------------
 
+def lstm_step(w_hh_t, carry, xp_t):
+    """One LSTM gate step: ``xp_t`` is the (B, 4H) pre-activation with input
+    projection and both biases folded in, ``carry`` is ``(h, c)``.  The one
+    definition of the gate math (order i, f, g, o, torch semantics) shared by
+    every scan-based path (``lstm_layer``, ``parallel/sp.py``); the Pallas
+    kernel mirrors it and is parity-tested against it."""
+    h, c = carry
+    gates = xp_t + h @ w_hh_t
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return (h, c), h
+
+
 def lstm_layer(params, x, h0=None, c0=None, *, unroll: int = 1):
     """Run one LSTM layer over ``x`` of shape (B, T, in).
 
@@ -83,21 +97,12 @@ def lstm_layer(params, x, h0=None, c0=None, *, unroll: int = 1):
     if c0 is None:
         c0 = jnp.zeros((batch, hidden), dtype)
 
-    def step(carry, xp_t):
-        h, c = carry
-        gates = xp_t + h @ w_hh_t
-        i, f, g, o = jnp.split(gates, 4, axis=-1)
-        i = jax.nn.sigmoid(i)
-        f = jax.nn.sigmoid(f)
-        g = jnp.tanh(g)
-        o = jax.nn.sigmoid(o)
-        c = f * c + i * g
-        h = o * jnp.tanh(c)
-        return (h, c), h
-
     # scan over time: move T to the leading axis.
     (h_t, c_t), outputs = lax.scan(
-        step, (h0, c0), jnp.swapaxes(x_proj, 0, 1), unroll=unroll
+        lambda carry, xp_t: lstm_step(w_hh_t, carry, xp_t),
+        (h0, c0),
+        jnp.swapaxes(x_proj, 0, 1),
+        unroll=unroll,
     )
     return jnp.swapaxes(outputs, 0, 1), (h_t, c_t)
 

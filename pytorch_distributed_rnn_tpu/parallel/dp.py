@@ -220,12 +220,14 @@ def make_spmd_epoch_fn(
             params, opt_state, loss, metrics = grad_step(
                 params, opt_state, batch
             )
-            return (params, opt_state), (jax.lax.pmean(loss, axis), metrics)
+            return (params, opt_state), (loss, metrics)
 
         (params, opt_state), (losses, metrics) = jax.lax.scan(
             body, (params, opt_state), idx_mat
         )
-        loss_sum = jnp.sum(losses)
+        # pmean is linear: one scalar AllReduce after the scan instead of
+        # one per step
+        loss_sum = jax.lax.pmean(jnp.sum(losses), axis)
         metrics_sum = psum_tree(
             jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics), axis
         )
@@ -271,15 +273,18 @@ def make_spmd_run_fn(
             params, opt_state, loss, metrics = grad_step(
                 params, opt_state, batch, w
             )
-            return (params, opt_state), (
-                jax.lax.pmean(loss, axis),
-                metrics["correct"],
-            )
+            return (params, opt_state), (loss, metrics["correct"])
 
         (params, opt_state), (losses, correct) = jax.lax.scan(
             body, (params, opt_state), (idx_mat, w_mat)
         )
-        # one vector psum after the scan instead of one per step
-        return params, opt_state, losses, jax.lax.psum(correct, axis)
+        # pmean/psum are linear: one vector collective each after the scan
+        # instead of one per step
+        return (
+            params,
+            opt_state,
+            jax.lax.pmean(losses, axis),
+            jax.lax.psum(correct, axis),
+        )
 
     return jax.jit(_run, donate_argnums=(0, 1) if donate else ())

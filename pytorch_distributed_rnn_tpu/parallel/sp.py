@@ -40,27 +40,23 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 from jax import shard_map
 
+from pytorch_distributed_rnn_tpu.ops.rnn import lstm_step
 from pytorch_distributed_rnn_tpu.parallel.collectives import broadcast_from
 
 
 def _lstm_chunk_scan(w_hh_t, carry, x_proj_chunk, unroll: int = 1):
-    """Scan the LSTM gate recurrence over one local time chunk.
+    """Scan the LSTM gate recurrence (the shared :func:`ops.rnn.lstm_step`)
+    over one local time chunk.
 
     ``x_proj_chunk``: (B, T_local, 4H) pre-activations (input projection plus
     both biases already folded in); ``carry``: ``(h, c)`` each (B, H).
     Returns ``((h, c), outputs (B, T_local, H))``.
     """
-
-    def step(carry, xp_t):
-        h, c = carry
-        gates = xp_t + h @ w_hh_t
-        i, f, g, o = jnp.split(gates, 4, axis=-1)
-        c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
-        h = jax.nn.sigmoid(o) * jnp.tanh(c)
-        return (h, c), h
-
     carry, out = lax.scan(
-        step, carry, jnp.swapaxes(x_proj_chunk, 0, 1), unroll=unroll
+        lambda c, xp_t: lstm_step(w_hh_t, c, xp_t),
+        carry,
+        jnp.swapaxes(x_proj_chunk, 0, 1),
+        unroll=unroll,
     )
     return carry, jnp.swapaxes(out, 0, 1)
 
@@ -133,9 +129,10 @@ def sp_lstm_layer(params, x_local, axis: str, *, unroll: int = 1):
     h0 = jnp.zeros((batch, hidden), dtype)
     c0 = jnp.zeros((batch, hidden), dtype)
 
-    chunk_fn = partial(_lstm_chunk_scan, w_hh_t, x_proj_chunk=x_proj,
-                       unroll=unroll)
-    final, outputs = _relay(axis, n, (h0, c0), lambda c: chunk_fn(c))
+    final, outputs = _relay(
+        axis, n, (h0, c0),
+        partial(_lstm_chunk_scan, w_hh_t, x_proj_chunk=x_proj, unroll=unroll),
+    )
     return outputs, final
 
 
@@ -154,12 +151,6 @@ def sp_stacked_lstm(layers, x_local, axis: str, *, unroll: int = 1):
     return out, finals
 
 
-def _stack_layer_params(layers):
-    """Stack homogeneous (input size == hidden) layer dicts into arrays with
-    a leading layer axis, for dynamic indexing inside the wavefront loop."""
-    return jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
-
-
 def sp_stacked_lstm_wavefront(layers, x_local, axis: str, *,
                               unroll: int = 1):
     """Wavefront-scheduled stacked LSTM over a time-sharded sequence.
@@ -172,10 +163,10 @@ def sp_stacked_lstm_wavefront(layers, x_local, axis: str, *,
     stacks overlap across shards instead of serializing (GPipe's schedule,
     transposed onto the time axis).
 
-    Layer 0 (input size != hidden) is hoisted out of the homogeneous
-    wavefront loop: its input projection depends on the raw features, every
-    deeper layer consumes (B, T/S, H).  Returns
-    ``(outputs_local, [per-layer final carries])`` matching
+    Layer 0's input projection (heterogeneous width: ``in`` not ``H``) is
+    precomputed for the local chunk - fully parallel, outside the wavefront -
+    so layer 0's recurrence joins the same schedule as every deeper layer.
+    Returns ``(outputs_local, [per-layer final carries])`` matching
     :func:`sp_stacked_lstm` exactly.
     """
     if len(layers) == 1:
@@ -186,16 +177,23 @@ def sp_stacked_lstm_wavefront(layers, x_local, axis: str, *,
     idx = lax.axis_index(axis)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    # Layer 0 runs as a plain relay (heterogeneous input width)...
-    out0, final0 = sp_lstm_layer(layers[0], x_local, axis, unroll=unroll)
+    L = len(layers)
+    batch, t_local, _ = x_local.shape
+    hidden = layers[0]["w_hh"].shape[1]
+    dtype = x_local.dtype
 
-    # ...then layers 1..L-1 run as one wavefront over stacked params.
-    deep = layers[1:]
-    L = len(deep)
-    stacked = _stack_layer_params(deep)
-    batch, t_local, _ = out0.shape
-    hidden = deep[0]["w_hh"].shape[1]
-    dtype = out0.dtype
+    # Layer 0's pre-activations: parallel across shards, ready before the
+    # wavefront starts.
+    xp0 = (
+        jnp.einsum("bti,gi->btg", x_local, layers[0]["w_ih"])
+        + layers[0]["b_ih"]
+        + layers[0]["b_hh"]
+    )
+    # Recurrent weights for ALL layers (homogeneous (H, 4H)); input weights
+    # and bias sums for the deep layers only (homogeneous (4H, H) / (4H,)).
+    w_hh_t_all = jnp.stack([p["w_hh"].T for p in layers])
+    w_ih_deep = jnp.stack([p["w_ih"] for p in layers[1:]])
+    b_deep = jnp.stack([p["b_ih"] + p["b_hh"] for p in layers[1:]])
 
     def select(active, new, old):
         return jax.tree.map(lambda a, b: jnp.where(active, a, b), new, old)
@@ -206,24 +204,26 @@ def sp_stacked_lstm_wavefront(layers, x_local, axis: str, *,
     )
 
     def wavefront(state, w):
-        # acts: (B, T/S, H) current input activations for this shard's next
-        # assigned layer; carry: incoming (h, c); outs: captured last-layer
-        # outputs; finals: (L, B, H) x2 captured per-layer final carries.
+        # acts: (B, T/S, H) previous layer's output on this chunk; carry:
+        # incoming (h, c); outs: captured last-layer outputs; finals:
+        # (L, B, H) x2 captured per-layer final carries.
         acts, carry, outs, finals = state
         l = w - idx
         active = (l >= 0) & (l < L)
         l_safe = jnp.clip(l, 0, L - 1)
-        layer = jax.tree.map(
-            lambda p: lax.dynamic_index_in_dim(p, l_safe, keepdims=False),
-            stacked,
+        dl = jnp.clip(l - 1, 0, L - 2)
+        xp_deep = (
+            jnp.einsum(
+                "bti,gi->btg",
+                acts,
+                lax.dynamic_index_in_dim(w_ih_deep, dl, keepdims=False),
+            )
+            + lax.dynamic_index_in_dim(b_deep, dl, keepdims=False)
         )
-        x_proj = (
-            jnp.einsum("bti,gi->btg", acts, layer["w_ih"])
-            + layer["b_ih"]
-            + layer["b_hh"]
-        )
+        x_proj = jnp.where(l == 0, xp0, xp_deep)
         new_carry, new_out = _lstm_chunk_scan(
-            layer["w_hh"].T, carry, x_proj, unroll=unroll
+            lax.dynamic_index_in_dim(w_hh_t_all, l_safe, keepdims=False),
+            carry, x_proj, unroll=unroll,
         )
 
         # capture final carries: shard n-1 finishing layer l
@@ -250,24 +250,23 @@ def sp_stacked_lstm_wavefront(layers, x_local, axis: str, *,
         return (acts, carry, outs, finals), None
 
     outs = jnp.zeros((batch, t_local, hidden), dtype)
+    acts0 = jnp.zeros((batch, t_local, hidden), dtype)
     finals_buf = (
         jnp.zeros((L, batch, hidden), dtype),
         jnp.zeros((L, batch, hidden), dtype),
     )
     (_, _, outs, finals_buf), _ = lax.scan(
         wavefront,
-        (out0, zero_carry, outs, finals_buf),
+        (acts0, zero_carry, outs, finals_buf),
         jnp.arange(L + n - 1),
     )
     # final carries live on shard n-1 only; replicate.
     finals_buf = broadcast_from(finals_buf, axis, n - 1)
-    finals = [final0] + [
-        (finals_buf[0][l], finals_buf[1][l]) for l in range(L)
-    ]
+    finals = [(finals_buf[0][l], finals_buf[1][l]) for l in range(L)]
     return outs, finals
 
 
-def make_sp_forward(model_params, mesh, axis: str = "sp", *,
+def make_sp_forward(mesh, axis: str = "sp", *,
                     schedule: str = "wavefront", unroll: int = 1):
     """Build a jitted sequence-parallel forward for a MotionModel-shaped
     params tree (``{"rnn": [...], "fc": {...}}``): stacked LSTM over a

@@ -367,7 +367,7 @@ class Trainer:
                 )
                 total_loss = total_loss + loss
                 total_correct = total_correct + metrics["correct"]
-                logging.info(
+                logging.debug(
                     formatter.train_progress_message(
                         batch_idx=batch_idx,
                         batches=len(batches),
@@ -423,7 +423,7 @@ class Trainer:
             total_loss = total_loss + loss
             total_correct = total_correct + metrics["correct"]
             if log_progress:
-                logging.info(
+                logging.debug(
                     formatter.train_progress_message(
                         batch_idx=batch_idx,
                         batches=num_batches,

@@ -115,3 +115,37 @@ def test_fused_under_jit_and_odd_batch():
     np.testing.assert_allclose(out, out_ref, rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(h, h_ref, rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(c, c_ref, rtol=1e-5, atol=1e-5)
+
+
+def test_fused_bf16_forward():
+    """Non-f32 inputs lower correctly: compute stays f32 in scratch, outputs
+    cast back to the input dtype."""
+    import jax.numpy as jnp
+    from pytorch_distributed_rnn_tpu.ops.rnn import init_lstm_layer, lstm_layer
+
+    params = init_lstm_layer(jax.random.PRNGKey(0), 9, 16, dtype=jnp.bfloat16)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 12, 9), jnp.bfloat16)
+    out_fused, (h_f, c_f) = lstm_layer_fused(params, x)
+    out_ref, (h_r, c_r) = lstm_layer(params, x)
+    assert out_fused.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out_fused, np.float32), np.asarray(out_ref, np.float32),
+        rtol=0.05, atol=0.05,
+    )
+
+
+def test_fused_bf16_grad():
+    """Backward kernel handles non-f32 cotangents (bf16 scratch casts)."""
+    import jax.numpy as jnp
+    from pytorch_distributed_rnn_tpu.ops.rnn import init_lstm_layer
+
+    params = init_lstm_layer(jax.random.PRNGKey(0), 9, 16, dtype=jnp.bfloat16)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 12, 9), jnp.bfloat16)
+
+    def loss(p, x):
+        out, _ = lstm_layer_fused(p, x)
+        return jnp.sum(out ** 2).astype(jnp.float32)
+
+    grads = jax.grad(loss)(params, x)
+    assert all(jnp.all(jnp.isfinite(g.astype(jnp.float32)))
+               for g in jax.tree.leaves(grads))

@@ -105,7 +105,7 @@ def test_make_sp_forward_matches_model(sp_mesh, schedule):
     params = model.init(jax.random.PRNGKey(3))
     x = jax.random.normal(jax.random.PRNGKey(4), (BATCH, T, IN))
 
-    forward = make_sp_forward(params, sp_mesh, schedule=schedule)
+    forward = make_sp_forward(sp_mesh, schedule=schedule)
     logits_sp = forward(params, x)
     logits_ref = model.apply(params, x)
     np.testing.assert_allclose(logits_sp, logits_ref, rtol=1e-5, atol=1e-6)
