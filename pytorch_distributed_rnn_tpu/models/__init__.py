@@ -1,4 +1,5 @@
+from pytorch_distributed_rnn_tpu.models.attention import AttentionClassifier
 from pytorch_distributed_rnn_tpu.models.motion import MotionModel
 from pytorch_distributed_rnn_tpu.models.toy import ToyModel
 
-__all__ = ["MotionModel", "ToyModel"]
+__all__ = ["AttentionClassifier", "MotionModel", "ToyModel"]

@@ -1,0 +1,120 @@
+"""Attention sequence classifier: the long-context model family.
+
+The reference's only model is the motion LSTM
+(``/root/reference/src/motion/model.py:4-17``).  This family covers the same
+task shape - (B, T, features) window -> class logits - with a pre-norm
+Transformer encoder, so the framework's sequence/context-parallel execution
+paths (ring attention / Ulysses, ``ops/attention.py``) have a first-class
+model to drive.  Same functional API as :class:`MotionModel`:
+``params = model.init(key)``, ``logits = model.apply(params, x)``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_rnn_tpu.ops.attention import mha_attention
+from pytorch_distributed_rnn_tpu.ops.initializers import linear_init
+
+
+def _layer_norm(x, scale, bias, eps=1e-5):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + eps) * scale + bias
+
+
+def init_block(key, dim: int, num_heads: int, mlp_ratio: int = 4):
+    """One pre-norm encoder block's params."""
+    ks = jax.random.split(key, 6)
+    return {
+        "ln1": {"scale": jnp.ones((dim,)), "bias": jnp.zeros((dim,))},
+        "wq": linear_init(ks[0], dim, dim),
+        "wk": linear_init(ks[1], dim, dim),
+        "wv": linear_init(ks[2], dim, dim),
+        "wo": linear_init(ks[3], dim, dim),
+        "ln2": {"scale": jnp.ones((dim,)), "bias": jnp.zeros((dim,))},
+        "fc1": linear_init(ks[4], dim, mlp_ratio * dim),
+        "fc2": linear_init(ks[5], mlp_ratio * dim, dim),
+    }
+
+
+def _linear(p, x):
+    return x @ p["weight"].T + p["bias"]
+
+
+def _split_heads(x, num_heads):
+    b, t, d = x.shape
+    return x.reshape(b, t, num_heads, d // num_heads).transpose(0, 2, 1, 3)
+
+
+def _merge_heads(x):
+    b, h, t, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, t, h * d)
+
+
+def block_qkv(params, x, num_heads: int):
+    """Pre-norm + QKV projections: the position-wise prologue every
+    sequence-parallel strategy runs locally on its chunk."""
+    y = _layer_norm(x, **params["ln1"])
+    q = _split_heads(_linear(params["wq"], y), num_heads)
+    k = _split_heads(_linear(params["wk"], y), num_heads)
+    v = _split_heads(_linear(params["wv"], y), num_heads)
+    return q, k, v
+
+
+def block_epilogue(params, x, attn_out):
+    """Output projection + residual + MLP: position-wise, runs locally on
+    any sequence chunk."""
+    x = x + _linear(params["wo"], _merge_heads(attn_out))
+    y = _layer_norm(x, **params["ln2"])
+    y = _linear(params["fc2"], jax.nn.gelu(_linear(params["fc1"], y)))
+    return x + y
+
+
+def apply_block(params, x, num_heads: int, attention=None):
+    """One encoder block.  ``attention(q, k, v) -> out`` defaults to full
+    attention; sequence-parallel callers inject ring/Ulysses attention."""
+    q, k, v = block_qkv(params, x, num_heads)
+    attn = attention if attention is not None else (
+        lambda q, k, v: mha_attention(q, k, v)
+    )
+    return block_epilogue(params, x, attn(q, k, v))
+
+
+@dataclass(frozen=True)
+class AttentionClassifier:
+    """Pre-norm Transformer encoder over (B, T, input_dim) windows, mean
+    pooled into class logits."""
+
+    input_dim: int = 9
+    dim: int = 64
+    depth: int = 2
+    num_heads: int = 4
+    output_dim: int = 6
+    max_len: int = 4096
+
+    def init(self, key: jax.Array):
+        ks = jax.random.split(key, self.depth + 3)
+        return {
+            "embed": linear_init(ks[0], self.input_dim, self.dim),
+            "pos": jax.random.normal(ks[1], (self.max_len, self.dim)) * 0.02,
+            "blocks": [
+                init_block(ks[2 + i], self.dim, self.num_heads)
+                for i in range(self.depth)
+            ],
+            "head": linear_init(ks[-1], self.dim, self.output_dim),
+        }
+
+    def apply(self, params, x: jax.Array, attention=None) -> jax.Array:
+        """x: (B, T, input_dim) -> logits (B, output_dim).  ``attention``
+        overrides the per-block attention (ring/Ulysses injection point);
+        positions are added by the caller for sequence-parallel chunks."""
+        t = x.shape[1]
+        h = _linear(params["embed"], x) + params["pos"][:t]
+        for blk in params["blocks"]:
+            h = apply_block(blk, h, self.num_heads, attention)
+        pooled = jnp.mean(h, axis=1)
+        return _linear(params["head"], pooled)

@@ -4,6 +4,11 @@ from pytorch_distributed_rnn_tpu.ops.initializers import (
     uniform_bound,
 )
 from pytorch_distributed_rnn_tpu.ops.losses import cross_entropy_loss, mse_loss
+from pytorch_distributed_rnn_tpu.ops.attention import (
+    mha_attention,
+    ring_attention,
+    ulysses_attention,
+)
 from pytorch_distributed_rnn_tpu.ops.rnn import (
     init_gru_layer,
     init_lstm_layer,
@@ -19,6 +24,9 @@ __all__ = [
     "uniform_bound",
     "cross_entropy_loss",
     "mse_loss",
+    "mha_attention",
+    "ring_attention",
+    "ulysses_attention",
     "init_gru_layer",
     "init_lstm_layer",
     "init_stacked_rnn",

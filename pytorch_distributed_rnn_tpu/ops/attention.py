@@ -1,0 +1,148 @@
+"""Attention primitives: full, ring (sequence-parallel), and Ulysses.
+
+The reference framework is RNN-only - its sole sequence model is the motion
+LSTM (``/root/reference/src/motion/model.py:4-17``) with a fixed 128-step
+window.  Long-context support is a first-class capability of this framework,
+so attention ships with two sequence/context-parallel execution strategies,
+both pure XLA-collective designs (no NCCL/MPI analogue needed):
+
+- **Ring attention** (`ring_attention`): Q stays put, K/V blocks rotate
+  around the ``sp`` ring via ``lax.ppermute`` (CollectivePermute over ICI).
+  Each of the S rounds combines one K/V block into a running flash-style
+  (online-softmax) accumulator, so the full (T x T) score matrix never
+  materializes and per-chip memory is O(T^2/S^2) per round.  Compute and
+  the next block's transfer overlap naturally on TPU.
+- **Ulysses / all-to-all** (`ulysses_attention`): one ``lax.all_to_all``
+  re-shards from sequence-sharded to head-sharded, full attention runs
+  locally per head group, and a second all-to-all restores sequence
+  sharding.  Cheaper collectives for moderate T; requires heads % S == 0.
+
+Both match :func:`mha_attention` on the gathered sequence exactly (same
+softmax, fp32 accumulation) and are parity-tested against it.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def mha_attention(q, k, v, *, causal: bool = False, q_offset=0, k_offset=0):
+    """Reference multi-head attention.
+
+    ``q``: (B, H, Tq, D), ``k``/``v``: (B, H, Tk, D) -> (B, H, Tq, D).
+    ``q_offset``/``k_offset`` are the global positions of the first query /
+    key, so causal masking works on sequence chunks.
+    """
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[2])
+        k_pos = k_offset + jnp.arange(k.shape[2])
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+def _online_update(q, k, v, m, l, acc, *, scale, mask=None):
+    """Fold one K/V block into a flash-style running softmax.
+
+    ``m``: (B, H, Tq) running max, ``l``: (B, H, Tq) running denominator,
+    ``acc``: (B, H, Tq, D) running numerator, all fp32.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, -jnp.inf)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # exp(-inf - -inf) guard: rows with no valid key yet keep m = -inf
+    p = jnp.exp(s - m_new[..., None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    corr = jnp.exp(m - m_new)
+    corr = jnp.where(jnp.isfinite(corr), corr, 0.0)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    acc_new = acc * corr[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return m_new, l_new, acc_new
+
+
+def ring_attention(q, k, v, axis: str, *, causal: bool = False):
+    """Sequence-parallel attention over a time-sharded sequence, for use
+    inside ``shard_map``.
+
+    ``q``/``k``/``v``: this shard's (B, H, T/S, D) chunk, sharded on global
+    time along mesh axis ``axis``.  K/V blocks rotate S times around the
+    ring; each round updates the online-softmax accumulator for the local
+    queries.  Returns the local (B, H, T/S, D) output chunk.
+    """
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    # blocks travel to the *next* shard each round, so after r rounds this
+    # shard holds the block that started (idx - r) mod n
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    scale = q.shape[-1] ** -0.5
+    b, h, t_local, d = q.shape
+    qf = q.astype(jnp.float32)
+    q_pos = idx * t_local + jnp.arange(t_local)
+
+    def block_mask(src):
+        if not causal:
+            return None
+        k_pos = src * t_local + jnp.arange(t_local)
+        return q_pos[:, None] >= k_pos[None, :]
+
+    # round 0 is the local block - no transfer needed; the scan then does
+    # permute-first so exactly n-1 CollectivePermutes run in total.
+    m0 = jnp.full((b, h, t_local), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, t_local), jnp.float32)
+    acc0 = jnp.zeros((b, h, t_local, d), jnp.float32)
+    m, l, acc = _online_update(
+        qf, k.astype(jnp.float32), v, m0, l0, acc0,
+        scale=scale, mask=block_mask(idx),
+    )
+
+    def round_(carry, r):
+        k_blk, v_blk, m, l, acc = carry
+        k_blk = lax.ppermute(k_blk, axis, perm)
+        v_blk = lax.ppermute(v_blk, axis, perm)
+        m, l, acc = _online_update(
+            qf, k_blk.astype(jnp.float32), v_blk, m, l, acc,
+            scale=scale, mask=block_mask((idx - r) % n),
+        )
+        return (k_blk, v_blk, m, l, acc), None
+
+    if n > 1:
+        (_, _, _, l, acc), _ = lax.scan(
+            round_, (k, v, m, l, acc), jnp.arange(1, n)
+        )
+    return (acc / l[..., None]).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis: str, *, causal: bool = False):
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses style), for use
+    inside ``shard_map``.
+
+    Input is sequence-sharded (B, H, T/S, D); one all-to-all re-shards to
+    head-sharded (B, H/S, T, D), attention runs locally over the full
+    sequence for this shard's heads, and a second all-to-all restores
+    sequence sharding.  Requires ``H %% S == 0``.
+    """
+    n = lax.axis_size(axis)
+    if q.shape[1] % n != 0:
+        raise ValueError(
+            f"ulysses needs heads ({q.shape[1]}) divisible by the axis size"
+            f" ({n})"
+        )
+    # split heads (axis 1) across shards, gather time (axis 2)
+    to_heads = lambda x: lax.all_to_all(   # noqa: E731
+        x, axis, split_axis=1, concat_axis=2, tiled=True)
+    to_seq = lambda x: lax.all_to_all(     # noqa: E731
+        x, axis, split_axis=2, concat_axis=1, tiled=True)
+    out = mha_attention(to_heads(q), to_heads(k), to_heads(v), causal=causal)
+    return to_seq(out)

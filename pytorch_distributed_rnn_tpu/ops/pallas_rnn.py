@@ -306,14 +306,10 @@ def lstm_layer_fused(params, x, h0=None, c0=None, *, block_b=None):
         block_b = _pick_block_b(batch)
     batch_p = _round_up(max(batch, block_b), block_b)
 
-    # One big MXU matmul for every timestep's input projection (both biases
-    # fold into the same pre-activation), then to time-major.
-    x_proj = (
-        jnp.einsum("bti,gi->btg", x, params["w_ih"])
-        + params["b_ih"]
-        + params["b_hh"]
-    )
-    x_proj = jnp.swapaxes(x_proj, 0, 1)  # (T, B, 4H)
+    from pytorch_distributed_rnn_tpu.ops.rnn import lstm_input_proj
+
+    # to time-major after the shared one-big-matmul input projection
+    x_proj = jnp.swapaxes(lstm_input_proj(params, x), 0, 1)  # (T, B, 4H)
     if batch_p != batch:
         x_proj = jnp.pad(x_proj, ((0, 0), (0, batch_p - batch), (0, 0)))
 

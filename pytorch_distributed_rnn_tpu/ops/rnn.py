@@ -58,6 +58,18 @@ def init_gru_layer(key, input_size: int, hidden_size: int, dtype=jnp.float32):
 # Single layers
 # ---------------------------------------------------------------------------
 
+def lstm_input_proj(params, x):
+    """Every timestep's LSTM pre-activation as one MXU matmul:
+    ``x (B, T, in) -> (B, T, 4H)`` with BOTH bias vectors folded in (they
+    add into the same pre-activation).  The one definition shared by the
+    scan path, the Pallas fused path, and the sequence-parallel paths."""
+    return (
+        jnp.einsum("bti,gi->btg", x, params["w_ih"])
+        + params["b_ih"]
+        + params["b_hh"]
+    )
+
+
 def lstm_step(w_hh_t, carry, xp_t):
     """One LSTM gate step: ``xp_t`` is the (B, 4H) pre-activation with input
     projection and both biases folded in, ``carry`` is ``(h, c)``.  The one
@@ -82,14 +94,7 @@ def lstm_layer(params, x, h0=None, c0=None, *, unroll: int = 1):
     hidden = params["w_hh"].shape[1]
     dtype = x.dtype
 
-    # One big MXU matmul for every timestep's input projection.  Both bias
-    # vectors fold in here because they are added to the same pre-activation.
-    x_proj = (
-        jnp.einsum("bti,gi->btg", x, params["w_ih"])
-        + params["b_ih"]
-        + params["b_hh"]
-    )
-
+    x_proj = lstm_input_proj(params, x)
     w_hh_t = params["w_hh"].T  # (H, 4H)
 
     if h0 is None:

@@ -40,7 +40,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 from jax import shard_map
 
-from pytorch_distributed_rnn_tpu.ops.rnn import lstm_step
+from pytorch_distributed_rnn_tpu.ops.rnn import lstm_input_proj, lstm_step
 from pytorch_distributed_rnn_tpu.parallel.collectives import broadcast_from
 
 
@@ -119,11 +119,7 @@ def sp_lstm_layer(params, x_local, axis: str, *, unroll: int = 1):
     dtype = x_local.dtype
 
     # Fully parallel across time shards: the big MXU matmul.
-    x_proj = (
-        jnp.einsum("bti,gi->btg", x_local, params["w_ih"])
-        + params["b_ih"]
-        + params["b_hh"]
-    )
+    x_proj = lstm_input_proj(params, x_local)
     w_hh_t = params["w_hh"].T
 
     h0 = jnp.zeros((batch, hidden), dtype)
@@ -184,11 +180,7 @@ def sp_stacked_lstm_wavefront(layers, x_local, axis: str, *,
 
     # Layer 0's pre-activations: parallel across shards, ready before the
     # wavefront starts.
-    xp0 = (
-        jnp.einsum("bti,gi->btg", x_local, layers[0]["w_ih"])
-        + layers[0]["b_ih"]
-        + layers[0]["b_hh"]
-    )
+    xp0 = lstm_input_proj(layers[0], x_local)
     # Recurrent weights for ALL layers (homogeneous (H, 4H)); input weights
     # and bias sums for the deep layers only (homogeneous (4H, H) / (4H,)).
     w_hh_t_all = jnp.stack([p["w_hh"].T for p in layers])
@@ -296,5 +288,56 @@ def make_sp_forward(mesh, axis: str = "sp", *,
         last = out_local[:, -1, :]  # true last step only on shard n-1
         logits = last @ params["fc"]["weight"].T + params["fc"]["bias"]
         return broadcast_from(logits, axis, n - 1)
+
+    return jax.jit(forward)
+
+
+def make_sp_attention_forward(model, mesh, axis: str = "sp", *,
+                              method: str = "ring", causal: bool = False):
+    """Build a jitted sequence-parallel forward for an
+    :class:`~pytorch_distributed_rnn_tpu.models.AttentionClassifier`.
+
+    The (B, T, in) input is sharded on time; every position-wise piece
+    (embed, layernorm, QKV/output projections, MLP, residuals) runs locally
+    on the chunk, and the attention core runs as ring attention (K/V blocks
+    rotating via ppermute) or Ulysses all-to-all, selected by ``method``.
+    The global mean-pool is a local mean + ``pmean`` over the axis.
+    """
+    from pytorch_distributed_rnn_tpu.models.attention import (
+        _linear, apply_block)
+    from pytorch_distributed_rnn_tpu.ops.attention import (
+        ring_attention, ulysses_attention)
+
+    if method not in ("ring", "ulysses"):
+        raise ValueError(f"unknown sp attention method {method!r}")
+    attn_fn = ring_attention if method == "ring" else ulysses_attention
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(None, axis)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def forward(params, x_local):
+        t_local = x_local.shape[1]
+        n = lax.axis_size(axis)
+        max_len = params["pos"].shape[0]
+        if t_local * n > max_len:
+            raise ValueError(
+                f"sequence length {t_local * n} exceeds the model's "
+                f"max_len {max_len}; dynamic_slice would silently clamp"
+            )
+        offset = lax.axis_index(axis) * t_local
+        pos = lax.dynamic_slice_in_dim(params["pos"], offset, t_local)
+        h = _linear(params["embed"], x_local) + pos
+        for blk in params["blocks"]:
+            h = apply_block(
+                blk, h, model.num_heads,
+                attention=lambda q, k, v: attn_fn(
+                    q, k, v, axis, causal=causal),
+            )
+        pooled = lax.pmean(jnp.mean(h, axis=1), axis)
+        return _linear(params["head"], pooled)
 
     return jax.jit(forward)

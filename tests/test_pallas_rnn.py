@@ -132,6 +132,14 @@ def test_fused_bf16_forward():
         np.asarray(out_fused, np.float32), np.asarray(out_ref, np.float32),
         rtol=0.05, atol=0.05,
     )
+    np.testing.assert_allclose(
+        np.asarray(h_f, np.float32), np.asarray(h_r, np.float32),
+        rtol=0.05, atol=0.05,
+    )
+    np.testing.assert_allclose(
+        np.asarray(c_f, np.float32), np.asarray(c_r, np.float32),
+        rtol=0.05, atol=0.05,
+    )
 
 
 def test_fused_bf16_grad():
