@@ -1,0 +1,135 @@
+"""Mixture-of-experts FFN: token-choice (top-1, Switch-style) routing.
+
+The reference has no MoE (SURVEY.md checklist: expert parallelism absent).
+This is the capability layer for the ``ep`` mesh axis: a router picks one
+expert per token, tokens are dispatched into per-expert capacity slots via
+one-hot matmuls (the TPU-friendly formulation - dense einsums instead of
+scatter/gather, so everything tiles onto the MXU), experts run their FFN,
+and outputs combine back weighted by the gate probability.
+
+``moe_ffn_dense`` computes every expert on every token (exact, O(E) flops)
+- the numerics reference.  ``moe_ffn`` dispatches through capacity slots;
+with ``capacity >= tokens routed to the busiest expert`` it matches the
+dense path exactly, otherwise overflow tokens drop (standard Switch
+behavior - the combine weight for dropped tokens is zero, so they pass
+through the residual unchanged).  ``parallel/ep.py`` shards the expert
+dimension of the same formulation over the mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_rnn_tpu.ops.initializers import linear_init
+
+
+def init_moe_ffn(key, dim: int, num_experts: int, hidden: int):
+    """Router + stacked expert FFN params."""
+    kr, k1, k2 = jax.random.split(key, 3)
+    e = num_experts
+
+    def stacked(k, shape, fan_in):
+        bound = fan_in ** -0.5
+        return jax.random.uniform(k, shape, minval=-bound, maxval=bound)
+
+    return {
+        "router": linear_init(kr, dim, num_experts),
+        "w1": stacked(k1, (e, dim, hidden), dim),
+        "b1": jnp.zeros((e, hidden)),
+        "w2": stacked(k2, (e, hidden, dim), hidden),
+        "b2": jnp.zeros((e, dim)),
+    }
+
+
+def _route(params, x):
+    """Top-1 routing: returns (expert_idx (N,), prob (N,), gates (N, E))."""
+    logits = x @ params["router"]["weight"].T + params["router"]["bias"]
+    gates = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(gates, axis=-1)
+    prob = jnp.max(gates, axis=-1)
+    return expert, prob, gates
+
+
+def load_balancing_loss(gates, expert, num_experts: int):
+    """Switch aux loss: E * sum_e (fraction of tokens to e) * (mean gate
+    prob of e); minimized at uniform routing."""
+    one_hot = jax.nn.one_hot(expert, num_experts, dtype=gates.dtype)
+    frac_tokens = jnp.mean(one_hot, axis=0)
+    frac_prob = jnp.mean(gates, axis=0)
+    return num_experts * jnp.sum(frac_tokens * frac_prob)
+
+
+def _expert_ffn(params, tokens):
+    """tokens: (E, C, D) - slot c of expert e -> same shape."""
+    h = jax.nn.gelu(
+        jnp.einsum("ecd,edh->ech", tokens, params["w1"])
+        + params["b1"][:, None, :]
+    )
+    return (
+        jnp.einsum("ech,ehd->ecd", h, params["w2"])
+        + params["b2"][:, None, :]
+    )
+
+
+def make_dispatch(expert, prob, num_experts: int, capacity: int, dtype):
+    """Build the (N, E, C) one-hot dispatch tensor and the prob-weighted
+    combine tensor from top-1 assignments.
+
+    Position within an expert's capacity = how many earlier tokens chose the
+    same expert; tokens whose position >= capacity are dropped (combine
+    weight 0).
+    """
+    one_hot = jax.nn.one_hot(expert, num_experts, dtype=jnp.int32)
+    # slot = how many earlier tokens chose the same expert
+    pos = jnp.sum((jnp.cumsum(one_hot, axis=0) - 1) * one_hot, axis=1)
+    in_cap = pos < capacity
+    dispatch = (
+        jax.nn.one_hot(expert, num_experts, dtype=dtype)[:, :, None]
+        * jax.nn.one_hot(jnp.where(in_cap, pos, -1), capacity, dtype=dtype)[
+            :, None, :
+        ]
+    )
+    combine = dispatch * prob[:, None, None]
+    return dispatch, combine
+
+
+def moe_ffn(params, x, *, capacity_factor: float = 2.0):
+    """Top-1 MoE FFN over tokens ``x`` (..., D) via one-hot dispatch.
+
+    Capacity per expert = ceil(tokens / E * capacity_factor).
+    """
+    shape = x.shape
+    d = shape[-1]
+    xt = x.reshape(-1, d)
+    n = xt.shape[0]
+    e = params["w1"].shape[0]
+    capacity = int(-(-n * capacity_factor // e))
+
+    expert, prob, gates = _route(params, xt)
+    dispatch, combine = make_dispatch(expert, prob, e, capacity, xt.dtype)
+    tokens = jnp.einsum("nec,nd->ecd", dispatch, xt)
+    out = jnp.einsum("nec,ecd->nd", combine, _expert_ffn(params, tokens))
+    aux = load_balancing_loss(gates, expert, e)
+    return out.reshape(shape), aux
+
+
+def moe_ffn_dense(params, x):
+    """Exact top-1 MoE: every expert computes every token, the gate picks.
+    O(E) compute - the parity reference for the dispatched paths."""
+    shape = x.shape
+    d = shape[-1]
+    xt = x.reshape(-1, d)
+    e = params["w1"].shape[0]
+
+    expert, prob, gates = _route(params, xt)
+    h = jax.nn.gelu(
+        jnp.einsum("nd,edh->neh", xt, params["w1"]) + params["b1"][None]
+    )
+    all_out = (
+        jnp.einsum("neh,ehd->ned", h, params["w2"]) + params["b2"][None]
+    )
+    sel = jax.nn.one_hot(expert, e, dtype=xt.dtype)
+    out = jnp.einsum("ne,ned->nd", sel, all_out) * prob[:, None]
+    aux = load_balancing_loss(gates, expert, e)
+    return out.reshape(shape), aux

@@ -15,6 +15,26 @@ from pytorch_distributed_rnn_tpu.parallel.dp import (
     make_spmd_train_step,
 )
 from pytorch_distributed_rnn_tpu.parallel.p2p import ring_relay_from_root
+from pytorch_distributed_rnn_tpu.parallel.sp import (
+    make_sp_attention_forward,
+    make_sp_forward,
+    sp_lstm_layer,
+    sp_stacked_lstm,
+    sp_stacked_lstm_wavefront,
+)
+from pytorch_distributed_rnn_tpu.parallel.tp import (
+    make_tp_forward,
+    tp_lstm_layer,
+    tp_stacked_lstm,
+)
+from pytorch_distributed_rnn_tpu.parallel.pp import (
+    make_pp_forward,
+    pp_stacked_lstm,
+)
+from pytorch_distributed_rnn_tpu.parallel.ep import (
+    ep_moe_ffn,
+    make_ep_moe_forward,
+)
 
 __all__ = [
     "make_mesh",
@@ -28,4 +48,16 @@ __all__ = [
     "broadcast_params",
     "distributed_optimizer",
     "ring_relay_from_root",
+    "make_sp_forward",
+    "make_sp_attention_forward",
+    "sp_lstm_layer",
+    "sp_stacked_lstm",
+    "sp_stacked_lstm_wavefront",
+    "make_tp_forward",
+    "tp_lstm_layer",
+    "tp_stacked_lstm",
+    "make_pp_forward",
+    "pp_stacked_lstm",
+    "ep_moe_ffn",
+    "make_ep_moe_forward",
 ]

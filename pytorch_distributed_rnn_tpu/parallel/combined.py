@@ -1,0 +1,149 @@
+"""Composed 3D parallelism: dp x sp x tp in one SPMD training step.
+
+The reference's only parallelism is data-parallel replicas over MPI
+(SURVEY.md checklist).  Here the three axes compose in a single
+``shard_map`` program over one mesh:
+
+- ``dp``: batch rows sharded; gradients sync via the pmean that
+  differentiating the global-mean loss induces (XLA AllReduce over ICI).
+- ``sp``: the time axis sharded; attention runs as ring attention
+  (``ops/attention.py``) with K/V blocks rotating over the ``sp`` ring.
+- ``tp``: attention heads and MLP hidden dim Megatron-sharded; QKV/fc1 are
+  column-parallel (no collective), wo/fc2 are row-parallel (one psum each).
+
+The loss is assembled to a fully-replicated scalar inside the program
+(logits psum'd over tp, pooled via pmean over sp, loss pmean'd over dp), so
+``jax.grad`` OF the shard_mapped function transposes every collective into
+exactly the right gradient exchange - no hand-written backward collectives,
+the property the reference's DDP reducer implements in C++
+(``/root/reference/src/motion/trainer/ddp.py:19``).
+
+Parameters stay replicated (the DP memory model, like the reference);
+shards slice their piece inside the program, which XLA fuses into the
+consuming matmul.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from pytorch_distributed_rnn_tpu.models.attention import (
+    _layer_norm,
+    _linear,
+)
+from pytorch_distributed_rnn_tpu.ops.attention import ring_attention
+from pytorch_distributed_rnn_tpu.ops.losses import cross_entropy_loss
+from pytorch_distributed_rnn_tpu.parallel.sp import (
+    sp_embed_prologue,
+    sp_mean_pool,
+)
+
+
+def _col_slice(p, k, per):
+    """Column-parallel slice: shard ``k`` takes ``per`` output rows."""
+    return {
+        "weight": lax.dynamic_slice_in_dim(p["weight"], k * per, per, axis=0),
+        "bias": lax.dynamic_slice_in_dim(p["bias"], k * per, per),
+    }
+
+
+def _row_slice(p, k, per):
+    """Row-parallel slice: shard ``k`` takes ``per`` input columns; bias is
+    added once, after the psum."""
+    return lax.dynamic_slice_in_dim(p["weight"], k * per, per, axis=1)
+
+
+def tp_sp_block(blk, h, num_heads: int, *, sp_axis: str, tp_axis: str,
+                causal: bool = False):
+    """One encoder block with heads tp-sharded and time sp-sharded.
+
+    ``h``: (B_local, T_local, dim).  QKV column-parallel -> ring attention
+    over ``sp`` on this shard's head group -> wo row-parallel (one psum
+    over ``tp``) -> MLP column+row parallel (one more psum).
+    """
+    ntp = lax.axis_size(tp_axis)
+    ktp = lax.axis_index(tp_axis)
+    dim = h.shape[-1]
+    if num_heads % ntp != 0:
+        raise ValueError(f"{num_heads} heads do not shard over tp={ntp}")
+    heads_local = num_heads // ntp
+    dh = dim // num_heads
+    per = heads_local * dh
+
+    def split_heads(x):
+        b, t, _ = x.shape
+        return x.reshape(b, t, heads_local, dh).transpose(0, 2, 1, 3)
+
+    y = _layer_norm(h, **blk["ln1"])
+    q = split_heads(_linear(_col_slice(blk["wq"], ktp, per), y))
+    k = split_heads(_linear(_col_slice(blk["wk"], ktp, per), y))
+    v = split_heads(_linear(_col_slice(blk["wv"], ktp, per), y))
+
+    attn = ring_attention(q, k, v, sp_axis, causal=causal)
+    b, hl, t, _ = attn.shape
+    merged = attn.transpose(0, 2, 1, 3).reshape(b, t, per)
+
+    wo_l = _row_slice(blk["wo"], ktp, per)
+    h = h + lax.psum(merged @ wo_l.T, tp_axis) + blk["wo"]["bias"]
+
+    y = _layer_norm(h, **blk["ln2"])
+    mlp_hidden = blk["fc1"]["weight"].shape[0]
+    if mlp_hidden % ntp != 0:
+        raise ValueError(f"mlp hidden {mlp_hidden} does not shard over tp")
+    per_mlp = mlp_hidden // ntp
+    u = jax.nn.gelu(_linear(_col_slice(blk["fc1"], ktp, per_mlp), y))
+    fc2_l = _row_slice(blk["fc2"], ktp, per_mlp)
+    return h + lax.psum(u @ fc2_l.T, tp_axis) + blk["fc2"]["bias"]
+
+
+def make_3d_loss_fn(model, mesh, *, dp_axis: str = "dp", sp_axis: str = "sp",
+                    tp_axis: str = "tp", causal: bool = False):
+    """Replicated-scalar loss for an AttentionClassifier over a
+    (dp, sp, tp) mesh: ``loss(params, x, y)`` with ``x`` (B, T, in) sharded
+    (dp, sp) and ``y`` (B,) sharded (dp)."""
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(dp_axis, sp_axis), P(dp_axis)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def loss_fn(params, x_local, y_local):
+        h = sp_embed_prologue(params, x_local, sp_axis)
+        for blk in params["blocks"]:
+            h = tp_sp_block(blk, h, model.num_heads, sp_axis=sp_axis,
+                            tp_axis=tp_axis, causal=causal)
+        logits = _linear(params["head"], sp_mean_pool(h, sp_axis))
+        return lax.pmean(cross_entropy_loss(logits, y_local), dp_axis)
+
+    return loss_fn
+
+
+def make_3d_train_step(model, optimizer, mesh, *, dp_axis: str = "dp",
+                       sp_axis: str = "sp", tp_axis: str = "tp",
+                       causal: bool = False, donate: bool = True):
+    """Jitted full training step with dp x sp x tp composed.
+
+    ``step(params, opt_state, (x, y)) -> (params, opt_state, loss)``;
+    ``x`` (B, T, in) should arrive sharded (dp, sp) on (batch, time) and
+    ``y`` (B,) sharded (dp) - jit reshards automatically if not.
+    """
+    loss_fn = make_3d_loss_fn(model, mesh, dp_axis=dp_axis, sp_axis=sp_axis,
+                              tp_axis=tp_axis, causal=causal)
+
+    def step(params, opt_state, batch):
+        x, y = batch
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())

@@ -1,0 +1,96 @@
+"""Expert parallelism: MoE experts sharded over an ``ep`` mesh axis.
+
+Tokens live batch-sharded along ``ep``; experts live expert-sharded along
+the same axis.  Each shard routes its local tokens against the (replicated)
+router, packs them into per-expert capacity slots with the one-hot dispatch
+einsum (``ops/moe.py``), and two ``lax.all_to_all`` collectives move token
+blocks to the shards owning their experts and back - the XLA-native
+equivalent of the dispatch/combine exchange in Switch/GShard, riding ICI
+instead of host networking.  Per-shard expert compute is
+``E/n`` experts x ``n*C`` slots; with ample capacity the result equals the
+dense reference exactly (drops otherwise, standard Switch semantics).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from pytorch_distributed_rnn_tpu.ops.moe import (
+    _expert_ffn,
+    _route,
+    make_dispatch,
+)
+
+
+def ep_moe_ffn(params, x_local, axis: str, *, capacity_factor: float = 2.0):
+    """Expert-parallel top-1 MoE FFN inside ``shard_map``.
+
+    ``params`` replicated, ``x_local``: this shard's (..., D) tokens
+    (batch-sharded along ``axis``).  Returns ``(out_local, aux_loss)`` with
+    ``aux_loss`` the global Switch load-balancing loss (psum'd).
+    """
+    n = lax.axis_size(axis)
+    k = lax.axis_index(axis)
+    shape = x_local.shape
+    d = shape[-1]
+    xt = x_local.reshape(-1, d)
+    n_tok = xt.shape[0]
+    e = params["w1"].shape[0]
+    if e % n != 0:
+        raise ValueError(f"{e} experts do not shard over {n} devices")
+    e_local = e // n
+    capacity = int(-(-n_tok * capacity_factor // e))
+
+    expert, prob, gates = _route(params, xt)
+    dispatch, combine = make_dispatch(expert, prob, e, capacity, xt.dtype)
+
+    # pack local tokens into (E, C, D) slots, send each expert block to its
+    # owner: (E, C, D) -> (E/n, n*C, D) with slots ordered by source shard
+    tokens = jnp.einsum("nec,nd->ecd", dispatch, xt)
+    tokens = lax.all_to_all(tokens, axis, split_axis=0, concat_axis=1,
+                            tiled=True)
+
+    local_params = {
+        name: lax.dynamic_slice_in_dim(params[name], k * e_local, e_local)
+        for name in ("w1", "b1", "w2", "b2")
+    }
+    out_tokens = _expert_ffn(local_params, tokens)
+
+    # return processed slots to their source shards and combine
+    out_tokens = lax.all_to_all(out_tokens, axis, split_axis=1,
+                                concat_axis=0, tiled=True)
+    out = jnp.einsum("nec,ecd->nd", combine, out_tokens)
+
+    # the Switch aux loss is a product of two *global* means - average the
+    # per-shard means first (pmean of each factor), then combine; averaging
+    # per-shard losses would bias the product
+    one_hot = jax.nn.one_hot(expert, e, dtype=gates.dtype)
+    frac_tokens = lax.pmean(jnp.mean(one_hot, axis=0), axis)
+    frac_prob = lax.pmean(jnp.mean(gates, axis=0), axis)
+    aux = e * jnp.sum(frac_tokens * frac_prob)
+    return out.reshape(shape), aux
+
+
+def make_ep_moe_forward(mesh, axis: str = "ep", *,
+                        capacity_factor: float = 2.0):
+    """Jitted expert-parallel MoE FFN: tokens (N, D) sharded along ``axis``
+    on entry, outputs sharded the same way; aux loss replicated."""
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(axis)),
+        out_specs=(P(axis), P()),
+        check_vma=False,
+    )
+    def forward(params, x_local):
+        return ep_moe_ffn(params, x_local, axis,
+                          capacity_factor=capacity_factor)
+
+    return jax.jit(forward)

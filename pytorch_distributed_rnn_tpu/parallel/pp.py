@@ -1,0 +1,167 @@
+"""Pipeline parallelism: stacked RNN layers partitioned into stages.
+
+The reference model is monolithic (SURVEY.md checklist: "no stage
+partitioning", ``/root/reference/src/motion/model.py:4-17``).  This module
+adds GPipe-style pipeline parallelism as a first-class axis: a stack of L
+RNN layers is split into S contiguous stages over a ``pp`` mesh axis, the
+batch is split into M microbatches, and stage ``k`` processes microbatch
+``m`` at tick ``t = k + m`` - ``M + S - 1`` ticks total, with activations
+hopping stage-to-stage via ``lax.ppermute`` (CollectivePermute over ICI).
+Bubble fraction (S-1)/(M+S-1) shrinks as M grows, the classic GPipe
+trade-off.  Backward works by differentiating straight through the SPMD
+program (ppermute transposes to the reverse hop), giving exact gradients -
+the schedule's reverse pass is XLA's transpose of the forward scan.
+
+An RNN pipelines over *depth*, not time: each stage runs its layers over a
+microbatch's full sequence, so stage state is just the (B_m, T, width)
+activation block.  Layer 0's input width (``in``) differs from every other
+layer's (``H``); to keep the stage loop homogeneous for traced layer
+indexing, inputs and all ``w_ih`` matrices are zero-padded to
+``W = max(in, H)`` - mathematically identical (the padded columns multiply
+zeros) and XLA folds the constant-zero columns away.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from pytorch_distributed_rnn_tpu.ops.rnn import lstm_step
+from pytorch_distributed_rnn_tpu.parallel.collectives import broadcast_from
+
+
+def _pad_last(x, width: int):
+    pad = width - x.shape[-1]
+    if pad == 0:
+        return x
+    cfg = [(0, 0)] * (x.ndim - 1) + [(0, pad)]
+    return jnp.pad(x, cfg)
+
+
+def _stack_padded(layers, width: int):
+    """Stack per-layer params into (L, ...) arrays, w_ih column-padded to
+    ``width`` so traced layer indexing sees homogeneous shapes."""
+    return {
+        "w_ih": jnp.stack([_pad_last(p["w_ih"], width) for p in layers]),
+        "w_hh_t": jnp.stack([p["w_hh"].T for p in layers]),
+        "b": jnp.stack([p["b_ih"] + p["b_hh"] for p in layers]),
+    }
+
+
+def _run_layer(stacked, l, acts, *, unroll: int = 1):
+    """Run layer ``l`` (traced index) over acts (B_m, T, W) -> (B_m, T, H)."""
+    w_ih = lax.dynamic_index_in_dim(stacked["w_ih"], l, keepdims=False)
+    w_hh_t = lax.dynamic_index_in_dim(stacked["w_hh_t"], l, keepdims=False)
+    b = lax.dynamic_index_in_dim(stacked["b"], l, keepdims=False)
+    x_proj = jnp.einsum("bti,gi->btg", acts, w_ih) + b
+    batch, hidden = acts.shape[0], w_hh_t.shape[0]
+    carry0 = (
+        jnp.zeros((batch, hidden), acts.dtype),
+        jnp.zeros((batch, hidden), acts.dtype),
+    )
+    _, out = lax.scan(
+        lambda c, xp: lstm_step(w_hh_t, c, xp),
+        carry0, jnp.swapaxes(x_proj, 0, 1), unroll=unroll,
+    )
+    return jnp.swapaxes(out, 0, 1)
+
+
+def pp_stacked_lstm(layers, x, axis: str, *, num_microbatches: int,
+                    unroll: int = 1):
+    """GPipe-scheduled stacked LSTM, for use inside ``shard_map`` over the
+    ``pp`` axis (params and ``x`` (B, T, in) replicated per stage).
+
+    ``L`` layers split into ``axis_size`` contiguous stages (L must divide
+    evenly); the batch splits into ``num_microbatches``.  Returns the full
+    (B, T, H) last-layer outputs, identical to
+    :func:`~pytorch_distributed_rnn_tpu.ops.rnn.stacked_rnn`.
+    """
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    L = len(layers)
+    if L % n != 0:
+        raise ValueError(f"{L} layers do not split into {n} stages")
+    per_stage = L // n
+    M = num_microbatches
+    batch, t, in_dim = x.shape
+    if batch % M != 0:
+        raise ValueError(f"batch {batch} not divisible into {M} microbatches")
+    bm = batch // M
+    hidden = layers[0]["w_hh"].shape[1]
+    width = max(in_dim, hidden)
+    dtype = x.dtype
+
+    stacked = _stack_padded(layers, width)
+    x_micro = _pad_last(x, width).reshape(M, bm, t, width)
+
+    def select(active, new, old):
+        return jax.tree.map(lambda a, b: jnp.where(active, a, b), new, old)
+
+    def tick(state, tk):
+        buf, outs = state
+        m = tk - idx
+        active = (m >= 0) & (m < M)
+        m_safe = jnp.clip(m, 0, M - 1)
+        # stage 0 reads its microbatch from the input; later stages consume
+        # what arrived from the previous stage
+        inp = jnp.where(
+            idx == 0,
+            lax.dynamic_index_in_dim(x_micro, m_safe, keepdims=False),
+            buf,
+        )
+        acts = inp
+        for j in range(per_stage):
+            # every layer consumes width-W input (layer output is H-wide)
+            acts = _run_layer(stacked, idx * per_stage + j,
+                              _pad_last(acts, width), unroll=unroll)
+        # last stage captures its microbatch's output
+        outs = jax.tree.map(
+            lambda buf_, new: jnp.where(
+                (active & (idx == n - 1))
+                & (jnp.arange(M)[:, None, None, None] == m_safe),
+                new[None], buf_,
+            ),
+            outs, acts,
+        )
+        # hand the activation to the next stage
+        buf = lax.ppermute(_pad_last(acts, width), axis, perm)
+        return (buf, outs), None
+
+    buf0 = jnp.zeros((bm, t, width), dtype)
+    outs0 = jnp.zeros((M, bm, t, hidden), dtype)
+    (_, outs), _ = lax.scan(tick, (buf0, outs0), jnp.arange(M + n - 1))
+    # outputs live on the last stage; replicate and restore batch order
+    outs = broadcast_from(outs, axis, n - 1)
+    return outs.reshape(batch, t, hidden)
+
+
+def make_pp_forward(mesh, axis: str = "pp", *, num_microbatches: int = 4,
+                    unroll: int = 1):
+    """Jitted pipeline-parallel forward for a MotionModel-shaped params
+    tree: staged stacked LSTM + last-timestep head (computed replicated -
+    it is tiny).  ``x`` replicated in, logits replicated out; numerics
+    match ``MotionModel.apply`` exactly.
+    """
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def forward(params, x):
+        out = pp_stacked_lstm(
+            params["rnn"], x, axis, num_microbatches=num_microbatches,
+            unroll=unroll,
+        )
+        last = out[:, -1, :]
+        return last @ params["fc"]["weight"].T + params["fc"]["bias"]
+
+    return jax.jit(forward)

@@ -292,6 +292,31 @@ def make_sp_forward(mesh, axis: str = "sp", *,
     return jax.jit(forward)
 
 
+def sp_embed_prologue(params, x_local, axis: str):
+    """Shared sequence-parallel prologue for attention models: embed the
+    local chunk and add its slice of the positional table, guarding against
+    ``dynamic_slice``'s silent clamping when T exceeds ``max_len``."""
+    from pytorch_distributed_rnn_tpu.models.attention import _linear
+
+    t_local = x_local.shape[1]
+    n = lax.axis_size(axis)
+    max_len = params["pos"].shape[0]
+    if t_local * n > max_len:
+        raise ValueError(
+            f"sequence length {t_local * n} exceeds the model's "
+            f"max_len {max_len}; dynamic_slice would silently clamp"
+        )
+    offset = lax.axis_index(axis) * t_local
+    pos = lax.dynamic_slice_in_dim(params["pos"], offset, t_local)
+    return _linear(params["embed"], x_local) + pos
+
+
+def sp_mean_pool(h, axis: str):
+    """Global mean-pool of a time-sharded (B, T/S, D) activation: local
+    mean + pmean over the axis (every chunk has equal length)."""
+    return lax.pmean(jnp.mean(h, axis=1), axis)
+
+
 def make_sp_attention_forward(model, mesh, axis: str = "sp", *,
                               method: str = "ring", causal: bool = False):
     """Build a jitted sequence-parallel forward for an
@@ -320,24 +345,13 @@ def make_sp_attention_forward(model, mesh, axis: str = "sp", *,
         check_vma=False,
     )
     def forward(params, x_local):
-        t_local = x_local.shape[1]
-        n = lax.axis_size(axis)
-        max_len = params["pos"].shape[0]
-        if t_local * n > max_len:
-            raise ValueError(
-                f"sequence length {t_local * n} exceeds the model's "
-                f"max_len {max_len}; dynamic_slice would silently clamp"
-            )
-        offset = lax.axis_index(axis) * t_local
-        pos = lax.dynamic_slice_in_dim(params["pos"], offset, t_local)
-        h = _linear(params["embed"], x_local) + pos
+        h = sp_embed_prologue(params, x_local, axis)
         for blk in params["blocks"]:
             h = apply_block(
                 blk, h, model.num_heads,
                 attention=lambda q, k, v: attn_fn(
                     q, k, v, axis, causal=causal),
             )
-        pooled = lax.pmean(jnp.mean(h, axis=1), axis)
-        return _linear(params["head"], pooled)
+        return _linear(params["head"], sp_mean_pool(h, axis))
 
     return jax.jit(forward)

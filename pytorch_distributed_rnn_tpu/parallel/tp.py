@@ -1,0 +1,152 @@
+"""Tensor parallelism: hidden-dimension sharding for RNNs and linears.
+
+The reference has no tensor parallelism (SURVEY.md checklist: "no sharded
+matmul anywhere in src/") - every rank holds a full model replica.  This
+module adds it as a first-class axis so models whose hidden state exceeds
+one chip's HBM (or whose matmuls want more MXUs) shard across a ``tp`` mesh
+axis; it composes orthogonally with the ``dp`` and ``sp`` axes.
+
+Sharding scheme for an LSTM layer (Megatron-style, adapted to recurrence):
+
+- Every gate's H dimension is sharded: shard ``k`` owns rows
+  ``[k*H/n, (k+1)*H/n)`` of each of the four gates of ``w_ih``, ``w_hh``
+  and both biases, so its input/recurrent matmuls produce only its
+  ``(B, 4H/n)`` gate slice and its ``(B, H/n)`` piece of ``h``/``c``.
+- The recurrent matmul needs the *full* previous ``h``, so each scan step
+  all-gathers the (B, H/n) hidden shards - the one collective per step,
+  (B, H) bytes over ICI, overlapping with the gate math.
+- The layer's output is all-gathered once per layer to feed the next
+  layer's (full-width) input projection.
+- The classifier head runs row-parallel: each shard multiplies its hidden
+  slice against its slice of the head weight, one ``psum`` combines the
+  partial logits (bias added after the sum).
+
+Params stay replicated in HBM and each shard *slices* its piece inside the
+SPMD program; XLA keeps the slice fused into the consuming matmul, and the
+single replicated copy is the same memory the DP strategies already pay.
+(A from-construction sharded-parameter variant is a natural follow-on; the
+compute path - where TP matters - is identical.)
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from pytorch_distributed_rnn_tpu.ops.rnn import lstm_input_proj
+
+
+def shard_gates(w, n: int, k, num_gates: int = 4):
+    """Slice shard ``k``'s rows of every gate from a (num_gates*H, ...)
+    tensor: reshape to (num_gates, H, ...), take H/n rows per gate, flatten
+    back to (num_gates*H/n, ...).  ``k`` may be traced (axis_index)."""
+    gh = w.shape[0]
+    h = gh // num_gates
+    if h % n != 0:
+        raise ValueError(f"hidden size {h} not divisible by tp size {n}")
+    per = h // n
+    gates = w.reshape(num_gates, h, *w.shape[1:])
+    sliced = lax.dynamic_slice_in_dim(gates, k * per, per, axis=1)
+    return sliced.reshape(num_gates * per, *w.shape[1:])
+
+
+def tp_lstm_layer(params, x, axis: str, *, unroll: int = 1):
+    """One LSTM layer with the hidden dimension sharded over ``axis``, for
+    use inside ``shard_map`` (params replicated, ``x`` (B, T, in) full).
+
+    Returns ``(outputs (B, T, H) full-width, (h_T, c_T) full-width)`` -
+    outputs are all-gathered so stacking composes; the per-step state stays
+    sharded inside the scan.
+    """
+    n = lax.axis_size(axis)
+    k = lax.axis_index(axis)
+    hidden = params["w_hh"].shape[1]
+    per = hidden // n
+    batch = x.shape[0]
+    dtype = x.dtype
+
+    local = {
+        "w_ih": shard_gates(params["w_ih"], n, k),
+        "w_hh": shard_gates(params["w_hh"], n, k),   # (4H/n, H)
+        "b_ih": shard_gates(params["b_ih"], n, k),
+        "b_hh": shard_gates(params["b_hh"], n, k),
+    }
+    x_proj = lstm_input_proj(local, x)               # (B, T, 4H/n)
+    w_hh_l_t = local["w_hh"].T                       # (H, 4H/n)
+
+    def step(carry, xp_t):
+        h_local, c_local = carry
+        # the one per-step collective: reassemble full h for the recurrence
+        h_full = lax.all_gather(h_local, axis, axis=1, tiled=True)
+        gates = xp_t + h_full @ w_hh_l_t             # (B, 4H/n)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c_local = jax.nn.sigmoid(f) * c_local + (
+            jax.nn.sigmoid(i) * jnp.tanh(g)
+        )
+        h_local = jax.nn.sigmoid(o) * jnp.tanh(c_local)
+        return (h_local, c_local), h_local
+
+    h0 = jnp.zeros((batch, per), dtype)
+    c0 = jnp.zeros((batch, per), dtype)
+    (h_t, c_t), out_local = lax.scan(
+        step, (h0, c0), jnp.swapaxes(x_proj, 0, 1), unroll=unroll
+    )
+    out_local = jnp.swapaxes(out_local, 0, 1)        # (B, T, H/n)
+    outputs = lax.all_gather(out_local, axis, axis=2, tiled=True)
+    h_t = lax.all_gather(h_t, axis, axis=1, tiled=True)
+    c_t = lax.all_gather(c_t, axis, axis=1, tiled=True)
+    return outputs, (h_t, c_t)
+
+
+def tp_stacked_lstm(layers, x, axis: str, *, unroll: int = 1):
+    """Stack of :func:`tp_lstm_layer`; returns (outputs, [finals])."""
+    finals = []
+    out = x
+    for layer in layers:
+        out, final = tp_lstm_layer(layer, out, axis, unroll=unroll)
+        finals.append(final)
+    return out, finals
+
+
+def row_parallel_head(params, h_full, axis: str):
+    """Row-parallel linear: each shard multiplies its slice of the input
+    dimension, one psum combines partial outputs, bias added after.
+
+    ``params``: {"weight" (out, H), "bias" (out,)} replicated;
+    ``h_full``: (B, H).
+    """
+    n = lax.axis_size(axis)
+    k = lax.axis_index(axis)
+    hidden = params["weight"].shape[1]
+    if hidden % n != 0:
+        raise ValueError(f"hidden size {hidden} not divisible by tp size {n}")
+    per = hidden // n
+    w_local = lax.dynamic_slice_in_dim(params["weight"], k * per, per, axis=1)
+    h_local = lax.dynamic_slice_in_dim(h_full, k * per, per, axis=1)
+    partial_out = h_local @ w_local.T
+    return lax.psum(partial_out, axis) + params["bias"]
+
+
+def make_tp_forward(mesh, axis: str = "tp", *, unroll: int = 1):
+    """Jitted tensor-parallel forward for a MotionModel-shaped params tree:
+    gate-sharded stacked LSTM + row-parallel head.  ``x`` replicated in,
+    logits replicated out; numerics match ``MotionModel.apply`` exactly.
+    """
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def forward(params, x):
+        out, _ = tp_stacked_lstm(params["rnn"], x, axis, unroll=unroll)
+        return row_parallel_head(params["fc"], out[:, -1, :], axis)
+
+    return jax.jit(forward)
