@@ -60,3 +60,22 @@ def write_synthetic_har_dataset(
         # labels on disk are 1-based, as in the real dataset
         np.savetxt(base_path / split / f"y_{split}.txt", y + 1, fmt="%d")
     return base_path
+
+
+def generate_char_tokens(num_sequences: int, seq_length: int,
+                         vocab_size: int = 256, seed: int = 0):
+    """Synthetic character streams for the char-RNN LM family: a mixture of
+    repeated motifs and noise so a language model has real structure to
+    learn (uniform-random tokens would pin the loss at log(vocab))."""
+    rng = np.random.RandomState(seed)
+    motifs = rng.randint(0, vocab_size, size=(8, 16))
+    rows = []
+    for _ in range(num_sequences):
+        row = []
+        while len(row) < seq_length + 1:
+            if rng.rand() < 0.8:
+                row.extend(motifs[rng.randint(len(motifs))])
+            else:
+                row.extend(rng.randint(0, vocab_size, size=4))
+        rows.append(row[: seq_length + 1])
+    return np.asarray(rows, dtype=np.int32)

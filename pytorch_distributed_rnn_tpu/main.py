@@ -70,6 +70,14 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None):
     apply_platform_overrides()
+    # env-gated multi-host rendezvous (PDRNN_COORDINATOR / MASTER_ADDR):
+    # must run before the first JAX computation; no-op single-controller
+    # otherwise.  The mpirun/MASTER_ADDR analogue - SURVEY.md §5.
+    from pytorch_distributed_rnn_tpu.parallel.multihost import (
+        initialize_multihost,
+    )
+
+    initialize_multihost()
     args = build_parser().parse_args(argv)
     return args.func(args)
 

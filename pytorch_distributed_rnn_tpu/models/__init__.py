@@ -1,5 +1,17 @@
 from pytorch_distributed_rnn_tpu.models.attention import AttentionClassifier
+from pytorch_distributed_rnn_tpu.models.char_rnn import (
+    CharRNN,
+    char_rnn_50m,
+    num_params,
+)
 from pytorch_distributed_rnn_tpu.models.motion import MotionModel
 from pytorch_distributed_rnn_tpu.models.toy import ToyModel
 
-__all__ = ["AttentionClassifier", "MotionModel", "ToyModel"]
+__all__ = [
+    "AttentionClassifier",
+    "CharRNN",
+    "char_rnn_50m",
+    "num_params",
+    "MotionModel",
+    "ToyModel",
+]

@@ -1,0 +1,81 @@
+"""Character-level RNN language model: the LM stress family.
+
+BASELINE.json's stress configs name a toy char-RNN and a "stacked-LSTM
+language model 50M params (stress XLA scan + grad psum)"; the reference
+itself only ships the motion classifier (`/root/reference/src/motion/
+model.py:4-17`), so this family is the framework's coverage of the
+sequence-to-sequence-logits shape: embedding -> stacked LSTM/GRU (the same
+``ops/rnn`` cells as the motion model, scan or fused Pallas path) ->
+per-timestep vocab projection.  Next-token loss lives here too so every
+trainer/strategy can drive the family unchanged.
+
+``char_rnn_50m()`` pins the ~50M-param preset the stress benchmarks use.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_rnn_tpu.ops.initializers import linear_init
+from pytorch_distributed_rnn_tpu.ops.losses import cross_entropy_loss
+from pytorch_distributed_rnn_tpu.ops.rnn import init_stacked_rnn, stacked_rnn
+
+
+@dataclass(frozen=True)
+class CharRNN:
+    """``params = model.init(key)``; ``logits = model.apply(params, tokens)``
+    maps (B, T) int tokens -> (B, T, vocab) next-token logits."""
+
+    vocab_size: int = 256
+    embed_dim: int = 128
+    hidden_dim: int = 256
+    layer_dim: int = 2
+    cell: str = "lstm"
+    unroll: int = 1
+    impl: str = "auto"  # "scan" | "fused" (Pallas) | "auto"
+
+    def init(self, key: jax.Array):
+        k_embed, k_rnn, k_head = jax.random.split(key, 3)
+        scale = self.embed_dim ** -0.5
+        return {
+            "embed": jax.random.normal(
+                k_embed, (self.vocab_size, self.embed_dim)) * scale,
+            "rnn": init_stacked_rnn(
+                k_rnn, self.embed_dim, self.hidden_dim, self.layer_dim,
+                self.cell,
+            ),
+            "head": linear_init(k_head, self.hidden_dim, self.vocab_size),
+        }
+
+    def apply(self, params, tokens: jax.Array) -> jax.Array:
+        """tokens: (B, T) int32 -> logits (B, T, vocab)."""
+        x = params["embed"][tokens]
+        outputs, _ = stacked_rnn(
+            params["rnn"], x, self.cell, unroll=self.unroll, impl=self.impl
+        )
+        return (
+            outputs @ params["head"]["weight"].T + params["head"]["bias"]
+        )
+
+    def loss(self, params, tokens: jax.Array) -> jax.Array:
+        """Next-token cross entropy: predict tokens[:, 1:] from
+        tokens[:, :-1], mean over all positions."""
+        logits = self.apply(params, tokens[:, :-1])
+        targets = tokens[:, 1:]
+        return cross_entropy_loss(
+            logits.reshape(-1, self.vocab_size), targets.reshape(-1)
+        )
+
+
+def char_rnn_50m(impl: str = "auto") -> CharRNN:
+    """The BASELINE.json stress config: ~50M-param stacked-LSTM LM
+    (vocab 256, embed 512, 4 x 1280 hidden -> 49.9M params)."""
+    return CharRNN(vocab_size=256, embed_dim=512, hidden_dim=1280,
+                   layer_dim=4, cell="lstm", impl=impl)
+
+
+def num_params(params) -> int:
+    return sum(int(p.size) for p in jax.tree.leaves(params))

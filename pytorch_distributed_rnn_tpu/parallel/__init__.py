@@ -35,6 +35,11 @@ from pytorch_distributed_rnn_tpu.parallel.ep import (
     ep_moe_ffn,
     make_ep_moe_forward,
 )
+from pytorch_distributed_rnn_tpu.parallel.multihost import (
+    global_device_mesh,
+    initialize_multihost,
+    process_info,
+)
 
 __all__ = [
     "make_mesh",
@@ -60,4 +65,7 @@ __all__ = [
     "pp_stacked_lstm",
     "ep_moe_ffn",
     "make_ep_moe_forward",
+    "initialize_multihost",
+    "global_device_mesh",
+    "process_info",
 ]

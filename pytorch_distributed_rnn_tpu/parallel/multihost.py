@@ -1,0 +1,124 @@
+"""Multi-host rendezvous: the ``MASTER_ADDR``/``mpirun`` analogue.
+
+The reference reaches multi-node scale three ways, all host-network based:
+``mpirun`` launches N python processes that rendezvous through OpenMPI
+(``/root/reference/fabfile.py:218-223``), Horovod does the same through
+``horovodrun`` (``:225-231``), and the parameter-server strategy sets
+``MASTER_ADDR``/``MASTER_PORT`` env vars for torch RPC
+(``param_server/__init__.py:41-42``).
+
+The TPU-native equivalent is ``jax.distributed.initialize``: every host
+process dials one coordinator, after which ``jax.devices()`` spans ALL
+hosts' chips and a single ``Mesh`` built over them makes XLA route
+collectives over ICI within a slice and DCN across hosts - no MPI, no
+per-rank send/recv code.  This module wraps that rendezvous with the same
+env-var ergonomics the reference used, so launchers (ours or bare
+``srun``/GKE) configure it the familiar way:
+
+- ``PDRNN_COORDINATOR``: coordinator ``host:port``.
+- ``PDRNN_NUM_PROCESSES``: process count.
+- ``PDRNN_PROCESS_ID``: this process's id.
+
+The reference-style names (``MASTER_ADDR``/``MASTER_PORT``,
+``WORLD_SIZE``/``RANK``) are honored only when ``PDRNN_MULTIHOST=1``
+explicitly opts in: those names are ALSO the native TCP runtime's
+rendezvous contract (``runtime/native.py``), and a CI harness that injects
+``WORLD_SIZE`` alone must not send every CLI invocation dialing a JAX
+coordinator.
+
+On TPU pods ``jax.distributed.initialize()`` with no arguments discovers
+everything from the TPU metadata service, so all of this is optional there;
+the env path exists for CPU/GPU clusters and tests.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def rendezvous_spec_from_env():
+    """Read the rendezvous triple from the environment.  Returns
+    ``(coordinator, num_processes, process_id)`` with ``None`` for anything
+    unset.  Reference-style names (``MASTER_ADDR`` etc.) are read only
+    under ``PDRNN_MULTIHOST=1`` - they double as the native TCP runtime's
+    contract and must not implicitly re-route to a JAX rendezvous."""
+    legacy = os.environ.get("PDRNN_MULTIHOST") == "1"
+
+    coordinator = os.environ.get("PDRNN_COORDINATOR")
+    if coordinator is None and legacy:
+        addr = os.environ.get("MASTER_ADDR")
+        port = os.environ.get("MASTER_PORT")
+        if addr is not None and port is not None:
+            coordinator = f"{addr}:{port}"
+
+    def _int_env(*names):
+        for name in names:
+            val = os.environ.get(name)
+            if val is not None:
+                return int(val)
+        return None
+
+    num_processes = _int_env(
+        "PDRNN_NUM_PROCESSES", *(("WORLD_SIZE",) if legacy else ())
+    )
+    process_id = _int_env(
+        "PDRNN_PROCESS_ID", *(("RANK",) if legacy else ())
+    )
+    return coordinator, num_processes, process_id
+
+
+def initialize_multihost(coordinator=None, num_processes=None,
+                         process_id=None) -> bool:
+    """Join the multi-host world.  Explicit arguments win over env vars;
+    with nothing set anywhere this is a no-op (single-controller mode) and
+    returns False.  Safe to call twice (the second call is a no-op)."""
+    env = rendezvous_spec_from_env()
+    coordinator = coordinator if coordinator is not None else env[0]
+    num_processes = num_processes if num_processes is not None else env[1]
+    process_id = process_id if process_id is not None else env[2]
+
+    if coordinator is None or num_processes is None or process_id is None:
+        if (coordinator, num_processes, process_id) != (None, None, None):
+            raise ValueError(
+                "incomplete multi-host rendezvous spec: need coordinator, "
+                f"num_processes AND process_id, got ({coordinator!r}, "
+                f"{num_processes!r}, {process_id!r})"
+            )
+        return False
+    if jax.distributed.is_initialized():
+        return True  # already initialized
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        if "must be called before" in str(e):
+            raise RuntimeError(
+                "multi-host rendezvous must happen before the first JAX "
+                "computation - call initialize_multihost() at process "
+                "start (the launcher does this when PDRNN_COORDINATOR "
+                "is set)"
+            ) from e
+        raise  # real rendezvous failures (unreachable coordinator, ...)
+    return True
+
+
+def process_info() -> tuple[int, int]:
+    """(rank, world_size) in reference terms: this process's index and the
+    number of processes in the rendezvous."""
+    return jax.process_index(), jax.process_count()
+
+
+def global_device_mesh(axes=None):
+    """A mesh over EVERY host's devices (``jax.devices()`` is global after
+    :func:`initialize_multihost`).  ``axes`` as in
+    :func:`~pytorch_distributed_rnn_tpu.parallel.mesh.make_mesh`; default is
+    one ``dp`` axis over all chips with hosts laid out contiguously, so dp
+    collectives ride ICI within a host/slice before crossing DCN."""
+    from pytorch_distributed_rnn_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(axes, devices=jax.devices())

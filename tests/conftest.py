@@ -11,6 +11,12 @@ import os
 # ambient environment points at a TPU (JAX_PLATFORMS=axon): the test suite is
 # the no-hardware path.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Hermeticity for subprocess-spawning tests (launcher/param-server/runtime):
+# without this, every spawned interpreter re-registers the axon TPU plugin
+# via sitecustomize and dials the real device's tunnel - slow always, and a
+# hang if the tunnel is busy/wedged.  The test suite is the no-hardware
+# path; children must be pure CPU.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

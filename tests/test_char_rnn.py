@@ -1,0 +1,95 @@
+"""Char-RNN LM family: shapes, param counts, learning, scan/fused parity,
+and data-parallel training."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from pytorch_distributed_rnn_tpu.data.synthetic import generate_char_tokens
+from pytorch_distributed_rnn_tpu.models import CharRNN, char_rnn_50m, num_params
+from pytorch_distributed_rnn_tpu.parallel import make_mesh, make_spmd_train_step
+
+VOCAB = 64
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    return CharRNN(vocab_size=VOCAB, embed_dim=16, hidden_dim=32,
+                   layer_dim=2, impl="scan")
+
+
+def test_shapes(small_model):
+    params = small_model.init(jax.random.PRNGKey(0))
+    tokens = jnp.zeros((4, 20), jnp.int32)
+    logits = small_model.apply(params, tokens)
+    assert logits.shape == (4, 20, VOCAB)
+    assert jnp.isfinite(small_model.loss(params, tokens))
+
+
+def test_50m_param_count():
+    model = char_rnn_50m()
+    params = model.init(jax.random.PRNGKey(0))
+    n = num_params(params)
+    assert 45e6 < n < 55e6, n
+
+
+@pytest.mark.parametrize("cell", ["lstm", "gru"])
+def test_lm_learns_structure(cell):
+    model = CharRNN(vocab_size=VOCAB, embed_dim=16, hidden_dim=32,
+                    layer_dim=1, cell=cell, impl="scan")
+    params = model.init(jax.random.PRNGKey(0))
+    tokens = jnp.asarray(
+        generate_char_tokens(16, 32, vocab_size=VOCAB, seed=0))
+    opt = optax.adam(5e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(p, s):
+        l, g = jax.value_and_grad(model.loss)(p, tokens)
+        u, s = opt.update(g, s, p)
+        return optax.apply_updates(p, u), s, l
+
+    losses = []
+    for _ in range(60):
+        params, opt_state, l = step(params, opt_state)
+        losses.append(float(l))
+    # structured motifs are learnable well below the uniform floor
+    assert losses[-1] < losses[0] * 0.6
+    assert losses[-1] < np.log(VOCAB) * 0.75
+
+
+def test_scan_vs_fused_parity(small_model):
+    """Fused Pallas path produces the same logits as the scan path."""
+    fused = CharRNN(vocab_size=VOCAB, embed_dim=16, hidden_dim=32,
+                    layer_dim=2, impl="fused")
+    params = small_model.init(jax.random.PRNGKey(1))
+    tokens = jnp.asarray(
+        generate_char_tokens(4, 16, vocab_size=VOCAB, seed=1))
+    np.testing.assert_allclose(
+        small_model.apply(params, tokens[:, :-1]),
+        fused.apply(params, tokens[:, :-1]),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_dp_training(small_model):
+    """The LM family drives the standard SPMD data-parallel step."""
+    mesh = make_mesh({"dp": 8})
+    params = small_model.init(jax.random.PRNGKey(2))
+    tokens = jnp.asarray(
+        generate_char_tokens(32, 24, vocab_size=VOCAB, seed=2))
+    opt = optax.adam(5e-3)
+    opt_state = opt.init(params)
+
+    def loss_and_metrics(p, batch):
+        (toks,) = batch
+        return small_model.loss(p, toks), {"count": jnp.array(1)}
+
+    step = make_spmd_train_step(loss_and_metrics, opt, mesh, donate=False)
+    first = None
+    for _ in range(20):
+        params, opt_state, loss, _ = step(params, opt_state, (tokens,))
+        first = first if first is not None else float(loss)
+    assert float(loss) < first

@@ -1,0 +1,94 @@
+"""Multi-host rendezvous wrapper: env parsing, no-op single-controller
+path, and a real single-process jax.distributed rendezvous."""
+
+import os
+
+import jax
+import pytest
+
+from pytorch_distributed_rnn_tpu.parallel.multihost import (
+    global_device_mesh,
+    initialize_multihost,
+    process_info,
+    rendezvous_spec_from_env,
+)
+
+
+def test_env_parsing_pdrnn_names(monkeypatch):
+    monkeypatch.setenv("PDRNN_COORDINATOR", "10.0.0.1:1234")
+    monkeypatch.setenv("PDRNN_NUM_PROCESSES", "4")
+    monkeypatch.setenv("PDRNN_PROCESS_ID", "2")
+    assert rendezvous_spec_from_env() == ("10.0.0.1:1234", 4, 2)
+
+
+def test_env_parsing_reference_names_require_opt_in(monkeypatch):
+    for name in ("PDRNN_COORDINATOR", "PDRNN_NUM_PROCESSES",
+                 "PDRNN_PROCESS_ID"):
+        monkeypatch.delenv(name, raising=False)
+    monkeypatch.setenv("MASTER_ADDR", "master")
+    monkeypatch.setenv("MASTER_PORT", "29500")
+    monkeypatch.setenv("WORLD_SIZE", "12")
+    monkeypatch.setenv("RANK", "3")
+    # MASTER_*/WORLD_SIZE/RANK double as the native TCP runtime's contract:
+    # ignored unless PDRNN_MULTIHOST=1 opts in
+    assert rendezvous_spec_from_env() == (None, None, None)
+    monkeypatch.setenv("PDRNN_MULTIHOST", "1")
+    assert rendezvous_spec_from_env() == ("master:29500", 12, 3)
+
+
+def test_incomplete_spec_raises(monkeypatch):
+    for name in ("PDRNN_COORDINATOR", "PDRNN_NUM_PROCESSES",
+                 "PDRNN_PROCESS_ID", "PDRNN_MULTIHOST"):
+        monkeypatch.delenv(name, raising=False)
+    monkeypatch.setenv("PDRNN_NUM_PROCESSES", "4")
+    with pytest.raises(ValueError, match="incomplete"):
+        initialize_multihost()
+
+
+def test_noop_without_config(monkeypatch):
+    for name in ("PDRNN_COORDINATOR", "PDRNN_NUM_PROCESSES",
+                 "PDRNN_PROCESS_ID", "MASTER_ADDR", "MASTER_PORT",
+                 "WORLD_SIZE", "RANK"):
+        monkeypatch.delenv(name, raising=False)
+    assert initialize_multihost() is False
+    rank, world = process_info()
+    assert (rank, world) == (0, 1)
+
+
+def test_rendezvous_after_backend_init_raises_clearly():
+    jax.devices()  # ensure backends are up in this process
+    if jax.distributed.is_initialized():
+        pytest.skip("distributed already initialized in this process")
+    with pytest.raises(RuntimeError, match="before the first JAX"):
+        initialize_multihost(coordinator="localhost:12355",
+                             num_processes=1, process_id=0)
+
+
+def test_single_process_rendezvous_and_global_mesh():
+    """A real 1-process rendezvous through jax.distributed, then a global
+    mesh over the (virtual 8-device) world - in a clean interpreter,
+    because the rendezvous must precede backend initialization."""
+    import subprocess
+    import sys
+
+    code = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+from pytorch_distributed_rnn_tpu.parallel.multihost import (
+    global_device_mesh, initialize_multihost, process_info)
+assert initialize_multihost(
+    coordinator="localhost:12355", num_processes=1, process_id=0)
+assert process_info() == (0, 1)
+mesh = global_device_mesh()
+assert mesh.shape["dp"] == len(jax.devices())
+assert initialize_multihost(
+    coordinator="localhost:12355", num_processes=1, process_id=0)
+print("RENDEZVOUS_OK")
+"""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120, env=env,
+    )
+    assert "RENDEZVOUS_OK" in out.stdout, out.stderr
