@@ -70,15 +70,17 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None):
     apply_platform_overrides()
-    # env-gated multi-host rendezvous (PDRNN_COORDINATOR / MASTER_ADDR):
-    # must run before the first JAX computation; no-op single-controller
-    # otherwise.  The mpirun/MASTER_ADDR analogue - SURVEY.md §5.
+    # parse first (no JAX computation happens there) so --help and bad
+    # command lines fail fast instead of blocking on a rendezvous
+    args = build_parser().parse_args(argv)
+    # env-gated multi-host rendezvous (PDRNN_COORDINATOR, or MASTER_ADDR
+    # under PDRNN_MULTIHOST=1): must run before the first JAX computation;
+    # no-op single-controller otherwise.  The mpirun analogue - SURVEY.md §5.
     from pytorch_distributed_rnn_tpu.parallel.multihost import (
         initialize_multihost,
     )
 
     initialize_multihost()
-    args = build_parser().parse_args(argv)
     return args.func(args)
 
 

@@ -326,3 +326,207 @@ def lstm_layer_fused(params, x, h0=None, c0=None, *, block_b=None):
     )
     outputs = jnp.swapaxes(h_all, 0, 1)[:batch]
     return outputs, (h_T[:batch], c_T[:batch])
+
+
+# ---------------------------------------------------------------------------
+# GRU: fused forward + backward kernels
+# ---------------------------------------------------------------------------
+
+
+def _gru_fwd_kernel(x_proj_ref, h0_ref, w_hh_t_ref, b_hh_ref, h_all_ref,
+                    h_scr):
+    """One grid step = one timestep of one batch tile.  Unlike the LSTM,
+    the hidden-side bias CANNOT fold into ``x_proj``: torch GRU semantics
+    put ``b_hn`` inside the ``r *`` product, so ``h_proj`` carries it."""
+    t = pl.program_id(1)
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[:] = h0_ref[:].astype(jnp.float32)
+
+    h = h_scr[:]
+    h_proj = jnp.dot(
+        h, w_hh_t_ref[:], preferred_element_type=jnp.float32
+    ) + b_hh_ref[:]
+    xr, xz, xn = jnp.split(x_proj_ref[0], 3, axis=-1)
+    hr, hz, hn = jnp.split(h_proj, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    n = jnp.tanh(xn + r * hn)
+    h = (1.0 - z) * n + z * h
+    h_scr[:] = h
+    h_all_ref[0] = h.astype(h_all_ref.dtype)
+
+
+def _gru_fwd_pallas(x_proj, h0, w_hh_t, b_hh, *, block_b):
+    seq_len, batch_p, gate_dim = x_proj.shape
+    hidden = gate_dim // 3
+    grid = (batch_p // block_b, seq_len)
+    dtype = x_proj.dtype
+
+    return pl.pallas_call(
+        _gru_fwd_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_b, gate_dim), lambda b, t: (t, b, 0)),
+            pl.BlockSpec((block_b, hidden), lambda b, t: (b, 0)),
+            pl.BlockSpec((hidden, gate_dim), lambda b, t: (0, 0)),
+            pl.BlockSpec((1, gate_dim), lambda b, t: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_b, hidden), lambda b, t: (t, b, 0)),
+        out_shape=jax.ShapeDtypeStruct((seq_len, batch_p, hidden), dtype),
+        scratch_shapes=[pltpu.VMEM((block_b, hidden), jnp.float32)],
+        interpret=_interpret(),
+    )(x_proj, h0, w_hh_t, b_hh)
+
+
+def _gru_bwd_kernel(x_proj_ref, h_prev_ref, dh_all_ref, dh_T_ref,
+                    w_hh_t_ref, w_hh_ref, b_hh_ref, h0_ref,
+                    dx_proj_ref, dhgates_ref, dh0_ref, dh_scr):
+    """Reverse-time sweep; weight/bias grads are NOT accumulated here -
+    the kernel emits per-step hidden-side gate cotangents (``dhgates``)
+    and the wrapper turns them into ``dw_hh``/``db_hh`` with one big MXU
+    matmul outside (better tiling than a VMEM accumulator)."""
+    t = pl.program_id(1)
+    seq_len = pl.num_programs(1)
+    tt_is_first = t == 0           # tt == T-1
+    tt_is_last = t == seq_len - 1  # tt == 0
+
+    @pl.when(tt_is_first)
+    def _():
+        dh_scr[:] = dh_T_ref[:].astype(jnp.float32)
+
+    h_prev = jnp.where(tt_is_last, h0_ref[:], h_prev_ref[0]).astype(
+        jnp.float32
+    )
+    # recompute this step's gates (cheaper than saving 3H activations)
+    h_proj = jnp.dot(
+        h_prev, w_hh_t_ref[:], preferred_element_type=jnp.float32
+    ) + b_hh_ref[:]
+    xr, xz, xn = jnp.split(x_proj_ref[0], 3, axis=-1)
+    hr, hz, hn = jnp.split(h_proj, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    n = jnp.tanh(xn + r * hn)
+
+    dh = dh_scr[:] + dh_all_ref[0]
+    dz = dh * (h_prev - n)
+    dn = dh * (1.0 - z)
+    dn_pre = dn * (1.0 - n * n)
+    dr = dn_pre * hn
+    dz_pre = dz * z * (1.0 - z)
+    dr_pre = dr * r * (1.0 - r)
+
+    d_xgates = jnp.concatenate([dr_pre, dz_pre, dn_pre], axis=-1)
+    d_hgates = jnp.concatenate([dr_pre, dz_pre, dn_pre * r], axis=-1)
+    dx_proj_ref[0] = d_xgates.astype(dx_proj_ref.dtype)
+    dhgates_ref[0] = d_hgates.astype(dhgates_ref.dtype)
+
+    dh_prev = dh * z + jnp.dot(
+        d_hgates, w_hh_ref[:], preferred_element_type=jnp.float32
+    )
+    dh_scr[:] = dh_prev
+
+    @pl.when(tt_is_last)
+    def _():
+        dh0_ref[:] = dh_prev.astype(dh0_ref.dtype)
+
+
+def _gru_bwd_pallas(x_proj, h_all, h0, w_hh_t, b_hh, dh_all, dh_T, *,
+                    block_b):
+    seq_len, batch_p, gate_dim = x_proj.shape
+    hidden = gate_dim // 3
+    grid = (batch_p // block_b, seq_len)
+    dtype = x_proj.dtype
+    w_hh = w_hh_t.T
+
+    rev = lambda b, t: (seq_len - 1 - t, b, 0)        # noqa: E731
+    rev_prev = lambda b, t: (                          # noqa: E731
+        jnp.maximum(seq_len - 2 - t, 0), b, 0)
+
+    return pl.pallas_call(
+        _gru_bwd_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_b, gate_dim), rev),       # x_proj[tt]
+            pl.BlockSpec((1, block_b, hidden), rev_prev),    # h_all[tt-1]
+            pl.BlockSpec((1, block_b, hidden), rev),         # dh_all[tt]
+            pl.BlockSpec((block_b, hidden), lambda b, t: (b, 0)),
+            pl.BlockSpec((hidden, gate_dim), lambda b, t: (0, 0)),
+            pl.BlockSpec((gate_dim, hidden), lambda b, t: (0, 0)),
+            pl.BlockSpec((1, gate_dim), lambda b, t: (0, 0)),
+            pl.BlockSpec((block_b, hidden), lambda b, t: (b, 0)),  # h0
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_b, gate_dim), rev),
+            pl.BlockSpec((1, block_b, gate_dim), rev),
+            pl.BlockSpec((block_b, hidden), lambda b, t: (b, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((seq_len, batch_p, gate_dim), dtype),
+            jax.ShapeDtypeStruct((seq_len, batch_p, gate_dim), dtype),
+            jax.ShapeDtypeStruct((batch_p, hidden), dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_b, hidden), jnp.float32)],
+        interpret=_interpret(),
+    )(x_proj, h_all, dh_all, dh_T, w_hh_t, w_hh, b_hh, h0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def fused_gru_scan(x_proj, w_hh_t, b_hh, h0, block_b):
+    """Fused GRU time loop.  ``x_proj`` (T, Bp, 3H) carries the input
+    projection + b_ih only (b_hh stays separate - GRU semantics);
+    ``b_hh`` is (1, 3H).  Returns ``(h_all (T, Bp, H), h_T)``."""
+    h_all = _gru_fwd_pallas(x_proj, h0, w_hh_t, b_hh, block_b=block_b)
+    return h_all, h_all[-1]
+
+
+def _gru_fwd(x_proj, w_hh_t, b_hh, h0, block_b):
+    h_all = _gru_fwd_pallas(x_proj, h0, w_hh_t, b_hh, block_b=block_b)
+    return (h_all, h_all[-1]), (x_proj, h_all, h0, w_hh_t, b_hh)
+
+
+def _gru_bwd(block_b, residuals, cotangents):
+    x_proj, h_all, h0, w_hh_t, b_hh = residuals
+    dh_all, dh_T = cotangents
+    dx_proj, dhgates, dh0 = _gru_bwd_pallas(
+        x_proj, h_all, h0, w_hh_t, b_hh, dh_all, dh_T, block_b=block_b
+    )
+    # weight/bias grads as big MXU matmuls over all (t, b) at once
+    h_prev_all = jnp.concatenate([h0[None], h_all[:-1]], axis=0)
+    dw_hh = jnp.einsum("tbg,tbh->gh", dhgates, h_prev_all)  # (3H, H)
+    db_hh = jnp.sum(dhgates, axis=(0, 1))[None]             # (1, 3H)
+    return dx_proj, dw_hh.T, db_hh, dh0
+
+
+fused_gru_scan.defvjp(_gru_fwd, _gru_bwd)
+
+
+def gru_layer_fused(params, x, h0=None, *, block_b=None):
+    """Drop-in replacement for ``ops.rnn.gru_layer`` running the time loop
+    as a fused Pallas kernel.  Same params (torch layout, gate order
+    r, z, n), same results."""
+    batch, _, _ = x.shape
+    hidden = params["w_hh"].shape[1]
+    dtype = x.dtype
+
+    if block_b is None:
+        block_b = _pick_block_b(batch)
+    batch_p = _round_up(max(batch, block_b), block_b)
+
+    from pytorch_distributed_rnn_tpu.ops.rnn import gru_input_proj
+
+    # shared input projection (b_ih only; b_hh joins inside the kernel)
+    x_proj = jnp.swapaxes(gru_input_proj(params, x), 0, 1)  # (T, B, 3H)
+    if batch_p != batch:
+        x_proj = jnp.pad(x_proj, ((0, 0), (0, batch_p - batch), (0, 0)))
+
+    if h0 is None:
+        h0 = jnp.zeros((batch, hidden), dtype)
+    if batch_p != batch:
+        h0 = jnp.pad(h0, ((0, batch_p - batch), (0, 0)))
+
+    h_all, h_T = fused_gru_scan(
+        x_proj, params["w_hh"].T, params["b_hh"][None], h0, block_b
+    )
+    return jnp.swapaxes(h_all, 0, 1)[:batch], h_T[:batch]

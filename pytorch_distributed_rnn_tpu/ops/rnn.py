@@ -70,6 +70,15 @@ def lstm_input_proj(params, x):
     )
 
 
+def gru_input_proj(params, x):
+    """Every timestep's GRU input-side pre-activation as one MXU matmul:
+    ``x (B, T, in) -> (B, T, 3H)`` with ``b_ih`` folded in.  ``b_hh`` stays
+    OUT: torch GRU semantics put the hidden-side n-bias inside the ``r *``
+    product, so it joins in the recurrent step.  Shared by the scan and
+    Pallas fused paths."""
+    return jnp.einsum("bti,gi->btg", x, params["w_ih"]) + params["b_ih"]
+
+
 def lstm_step(w_hh_t, carry, xp_t):
     """One LSTM gate step: ``xp_t`` is the (B, 4H) pre-activation with input
     projection and both biases folded in, ``carry`` is ``(h, c)``.  The one
@@ -123,7 +132,7 @@ def gru_layer(params, x, h0=None, *, unroll: int = 1):
     hidden = params["w_hh"].shape[1]
     dtype = x.dtype
 
-    x_proj = jnp.einsum("bti,gi->btg", x, params["w_ih"]) + params["b_ih"]
+    x_proj = gru_input_proj(params, x)
     w_hh_t = params["w_hh"].T  # (H, 3H)
     b_hh = params["b_hh"]
 
@@ -177,11 +186,11 @@ def resolve_rnn_impl(impl: str, cell: str) -> str:
     if impl not in ("auto", "scan", "fused"):
         raise ValueError(f"unknown rnn impl {impl!r}")
     if impl == "auto":
-        if cell == "lstm" and jax.default_backend() == "tpu":
+        if cell in ("lstm", "gru") and jax.default_backend() == "tpu":
             return "fused"
         return "scan"
-    if impl == "fused" and cell != "lstm":
-        raise ValueError(f"fused impl supports cell='lstm' only, got {cell!r}")
+    if impl == "fused" and cell not in ("lstm", "gru"):
+        raise ValueError(f"fused impl supports lstm/gru only, got {cell!r}")
     return impl
 
 
@@ -206,7 +215,10 @@ def stacked_rnn(
     """
     impl = resolve_rnn_impl(impl, cell)
     if impl == "fused":
-        from pytorch_distributed_rnn_tpu.ops.pallas_rnn import lstm_layer_fused
+        from pytorch_distributed_rnn_tpu.ops.pallas_rnn import (
+            gru_layer_fused,
+            lstm_layer_fused,
+        )
 
     finals = []
     out = x
@@ -217,7 +229,10 @@ def stacked_rnn(
             else:
                 out, final = lstm_layer(layer, out, unroll=unroll)
         elif cell == "gru":
-            out, final = gru_layer(layer, out, unroll=unroll)
+            if impl == "fused":
+                out, final = gru_layer_fused(layer, out)
+            else:
+                out, final = gru_layer(layer, out, unroll=unroll)
         else:
             raise ValueError(f"unknown cell {cell!r}")
         finals.append(final)
