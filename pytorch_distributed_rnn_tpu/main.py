@@ -54,6 +54,12 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--no-validation", action="store_true")
     parser.add_argument("--cell", default="lstm", choices=["lstm", "gru"])
     parser.add_argument("--resume", default=None, type=Path)
+    parser.add_argument(
+        "--profile", default=None, type=Path, metavar="DIR",
+        help="capture a step-level device trace of the training run into "
+        "DIR (viewable in TensorBoard/Perfetto); the reference had only "
+        "whole-run wall-clock + RSS",
+    )
 
     sub_parser = parser.add_subparsers(
         title="Available commands", metavar="command [options ...]"

@@ -37,4 +37,12 @@ def add_sub_command(sub_parser):
 def execute(args):
     from pytorch_distributed_rnn_tpu.param_server.runner import run
 
+    if getattr(args, "profile", None):
+        # training happens in spawned worker processes; a parent-process
+        # trace would be empty - fail loudly instead of silently writing
+        # nothing (the other subcommands support --profile)
+        raise SystemExit(
+            "--profile is not supported by the parameter-server strategy "
+            "(training runs in spawned worker processes)"
+        )
     return run(args)

@@ -85,7 +85,21 @@ def train(args, trainer_class):
         logging.info(f"Resumed from {args.resume} at epoch {meta['epoch']}")
 
     logging.info(f"Training model for {args.epochs} epochs...")
-    _, train_history, validation_history = trainer.train(epochs=args.epochs)
+    import contextlib
+
+    profile_dir = getattr(args, "profile", None)
+    if profile_dir:
+        # step-level device tracing (new capability - the reference only
+        # had whole-run wall-clock + RSS, SURVEY.md §5 "Tracing")
+        import jax
+
+        trace_cm = jax.profiler.trace(str(profile_dir))
+    else:
+        trace_cm = contextlib.nullcontext()
+    with trace_cm:
+        _, train_history, validation_history = trainer.train(
+            epochs=args.epochs
+        )
     history = {
         "train_history": train_history,
         "validation_history": validation_history,

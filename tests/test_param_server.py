@@ -198,3 +198,15 @@ class TestMasterLogic:
         master._serve_worker(1)
         assert master.updates_applied == 2
         np.testing.assert_allclose(state["p"], -0.3 * np.ones(n), rtol=1e-6)
+
+
+def test_profile_flag_rejected():
+    """--profile with parameter-server fails loudly (training happens in
+    spawned workers; a silent empty trace would mislead)."""
+    from pytorch_distributed_rnn_tpu.main import build_parser
+
+    args = build_parser().parse_args(
+        ["--profile", "/tmp/x", "parameter-server", "--world-size", "2"]
+    )
+    with pytest.raises(SystemExit, match="not supported"):
+        args.func(args)

@@ -248,3 +248,40 @@ class _force_info_logging:
 
     def __exit__(self, *exc):
         self._root.setLevel(self._level)
+
+
+@pytest.mark.slow
+def test_profile_flag_writes_trace(tmp_path):
+    """--profile DIR captures a step-level device trace (new capability;
+    the reference only had wall-clock + RSS)."""
+    import os
+    import subprocess
+    import sys
+
+    from pytorch_distributed_rnn_tpu.data.synthetic import (
+        write_synthetic_har_dataset,
+    )
+
+    data_dir = tmp_path / "data"
+    write_synthetic_har_dataset(data_dir, num_train=128, num_test=16,
+                                seq_length=32)
+    trace_dir = tmp_path / "trace"
+    repo_root = str(__import__("pathlib").Path(__file__).resolve().parent.parent)
+    env = dict(os.environ)
+    env.update(PDRNN_PLATFORM="cpu", PDRNN_NUM_CPU_DEVICES="2")
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo_root, env.get("PYTHONPATH")) if p
+    )
+    subprocess.run(
+        [sys.executable, "-m", "pytorch_distributed_rnn_tpu.main",
+         "--epochs", "1", "--seed", "1",
+         "--dataset-path", str(data_dir),
+         "--checkpoint-directory", str(tmp_path / "models"),
+         "--batch-size", "48", "--no-validation",
+         "--profile", str(trace_dir), "local"],
+        check=True, capture_output=True, text=True, timeout=300,
+        cwd=tmp_path,
+        env=env,
+    )
+    traces = list(trace_dir.rglob("*.xplane.pb"))
+    assert traces, list(trace_dir.rglob("*"))
