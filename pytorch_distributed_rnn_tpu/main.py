@@ -55,6 +55,16 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--cell", default="lstm", choices=["lstm", "gru"])
     parser.add_argument("--resume", default=None, type=Path)
     parser.add_argument(
+        "--precision", default="f32", choices=["f32", "bf16"],
+        help="bf16: bfloat16 compute (full MXU rate, half the HBM "
+        "traffic) with f32 parameters and optimizer state",
+    )
+    parser.add_argument(
+        "--remat", action="store_true",
+        help="recompute RNN activations during backward instead of "
+        "saving them (trades FLOPs for HBM; for deep/long configs)",
+    )
+    parser.add_argument(
         "--profile", default=None, type=Path, metavar="DIR",
         help="capture a step-level device trace of the training run into "
         "DIR (viewable in TensorBoard/Perfetto); the reference had only "

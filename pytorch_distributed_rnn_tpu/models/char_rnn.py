@@ -36,6 +36,8 @@ class CharRNN:
     cell: str = "lstm"
     unroll: int = 1
     impl: str = "auto"  # "scan" | "fused" (Pallas) | "auto"
+    precision: str = "f32"  # "bf16": bf16 compute, f32 params (MXU rate)
+    remat: bool = False  # recompute activations in backward (HBM lever)
 
     def init(self, key: jax.Array):
         k_embed, k_rnn, k_head = jax.random.split(key, 3)
@@ -52,10 +54,13 @@ class CharRNN:
 
     def apply(self, params, tokens: jax.Array) -> jax.Array:
         """tokens: (B, T) int32 -> logits (B, T, vocab)."""
+        compute_dtype = jnp.bfloat16 if self.precision == "bf16" else None
         x = params["embed"][tokens]
         outputs, _ = stacked_rnn(
-            params["rnn"], x, self.cell, unroll=self.unroll, impl=self.impl
+            params["rnn"], x, self.cell, unroll=self.unroll, impl=self.impl,
+            compute_dtype=compute_dtype, remat=self.remat,
         )
+        outputs = outputs.astype(jnp.float32)
         return (
             outputs @ params["head"]["weight"].T + params["head"]["bias"]
         )
@@ -70,11 +75,15 @@ class CharRNN:
         )
 
 
-def char_rnn_50m(impl: str = "auto") -> CharRNN:
+def char_rnn_50m(impl: str = "auto", precision: str = "f32",
+                 remat: bool = False) -> CharRNN:
     """The BASELINE.json stress config: ~50M-param stacked-LSTM LM
-    (vocab 256, embed 512, 4 x 1280 hidden -> 49.9M params)."""
+    (vocab 256, embed 512, 4 x 1280 hidden -> 49.9M params).
+    ``precision="bf16"`` / ``remat=True`` are the intended levers for
+    running this preset at depth on real hardware."""
     return CharRNN(vocab_size=256, embed_dim=512, hidden_dim=1280,
-                   layer_dim=4, cell="lstm", impl=impl)
+                   layer_dim=4, cell="lstm", impl=impl,
+                   precision=precision, remat=remat)
 
 
 def num_params(params) -> int:

@@ -14,6 +14,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 import jax
+import jax.numpy as jnp
 
 from pytorch_distributed_rnn_tpu.ops.initializers import linear_init
 from pytorch_distributed_rnn_tpu.ops.rnn import init_stacked_rnn, stacked_rnn
@@ -31,6 +32,8 @@ class MotionModel:
     cell: str = "lstm"
     unroll: int = 1
     impl: str = "auto"  # "scan" | "fused" (Pallas) | "auto" (fused on TPU)
+    precision: str = "f32"  # "bf16": bf16 compute, f32 params (MXU rate)
+    remat: bool = False  # recompute activations in backward (HBM lever)
 
     def init(self, key: jax.Array):
         rnn_key, fc_key = jax.random.split(key)
@@ -43,8 +46,10 @@ class MotionModel:
 
     def apply(self, params, x: jax.Array) -> jax.Array:
         """x: (B, T, input_dim) -> logits (B, output_dim)."""
+        compute_dtype = jnp.bfloat16 if self.precision == "bf16" else None
         outputs, _ = stacked_rnn(
-            params["rnn"], x, self.cell, unroll=self.unroll, impl=self.impl
+            params["rnn"], x, self.cell, unroll=self.unroll, impl=self.impl,
+            compute_dtype=compute_dtype, remat=self.remat,
         )
-        last = outputs[:, -1, :]
+        last = outputs[:, -1, :].astype(jnp.float32)
         return last @ params["fc"]["weight"].T + params["fc"]["bias"]

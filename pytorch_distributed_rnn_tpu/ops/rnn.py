@@ -18,6 +18,8 @@ TPU-first design, deliberately NOT a translation of the reference's
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -84,13 +86,19 @@ def lstm_step(w_hh_t, carry, xp_t):
     projection and both biases folded in, ``carry`` is ``(h, c)``.  The one
     definition of the gate math (order i, f, g, o, torch semantics) shared by
     every scan-based path (``lstm_layer``, ``parallel/sp.py``); the Pallas
-    kernel mirrors it and is parity-tested against it."""
+    kernel mirrors it and is parity-tested against it.
+
+    Mixed-precision contract (matches the fused kernel's f32 VMEM scratch):
+    the carry stays f32 so cell-state rounding never compounds across T;
+    only the matmul runs in the compute dtype; the emitted per-step output
+    follows ``xp_t``'s dtype.  All casts are no-ops in pure f32.
+    """
     h, c = carry
-    gates = xp_t + h @ w_hh_t
+    gates = (xp_t + h.astype(xp_t.dtype) @ w_hh_t).astype(jnp.float32)
     i, f, g, o = jnp.split(gates, 4, axis=-1)
     c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
     h = jax.nn.sigmoid(o) * jnp.tanh(c)
-    return (h, c), h
+    return (h, c), h.astype(xp_t.dtype)
 
 
 def lstm_layer(params, x, h0=None, c0=None, *, unroll: int = 1):
@@ -106,19 +114,20 @@ def lstm_layer(params, x, h0=None, c0=None, *, unroll: int = 1):
     x_proj = lstm_input_proj(params, x)
     w_hh_t = params["w_hh"].T  # (H, 4H)
 
+    # carry lives in f32 regardless of compute dtype (lstm_step contract)
     if h0 is None:
-        h0 = jnp.zeros((batch, hidden), dtype)
+        h0 = jnp.zeros((batch, hidden), jnp.float32)
     if c0 is None:
-        c0 = jnp.zeros((batch, hidden), dtype)
+        c0 = jnp.zeros((batch, hidden), jnp.float32)
 
     # scan over time: move T to the leading axis.
     (h_t, c_t), outputs = lax.scan(
         lambda carry, xp_t: lstm_step(w_hh_t, carry, xp_t),
-        (h0, c0),
+        (h0.astype(jnp.float32), c0.astype(jnp.float32)),
         jnp.swapaxes(x_proj, 0, 1),
         unroll=unroll,
     )
-    return jnp.swapaxes(outputs, 0, 1), (h_t, c_t)
+    return jnp.swapaxes(outputs, 0, 1), (h_t.astype(dtype), c_t.astype(dtype))
 
 
 def gru_layer(params, x, h0=None, *, unroll: int = 1):
@@ -136,21 +145,24 @@ def gru_layer(params, x, h0=None, *, unroll: int = 1):
     w_hh_t = params["w_hh"].T  # (H, 3H)
     b_hh = params["b_hh"]
 
+    # carry in f32 (mixed-precision contract: matmuls in compute dtype,
+    # state accumulation in f32 - all casts no-ops in pure f32)
     if h0 is None:
-        h0 = jnp.zeros((batch, hidden), dtype)
+        h0 = jnp.zeros((batch, hidden), jnp.float32)
 
     def step(h, xp_t):
-        h_proj = h @ w_hh_t + b_hh
-        xr, xz, xn = jnp.split(xp_t, 3, axis=-1)
+        h_proj = (h.astype(xp_t.dtype) @ w_hh_t + b_hh).astype(jnp.float32)
+        xr, xz, xn = jnp.split(xp_t.astype(jnp.float32), 3, axis=-1)
         hr, hz, hn = jnp.split(h_proj, 3, axis=-1)
         r = jax.nn.sigmoid(xr + hr)
         z = jax.nn.sigmoid(xz + hz)
         n = jnp.tanh(xn + r * hn)
         h = (1.0 - z) * n + z * h
-        return h, h
+        return h, h.astype(xp_t.dtype)
 
-    h_t, outputs = lax.scan(step, h0, jnp.swapaxes(x_proj, 0, 1), unroll=unroll)
-    return jnp.swapaxes(outputs, 0, 1), h_t
+    h_t, outputs = lax.scan(step, h0.astype(jnp.float32),
+                            jnp.swapaxes(x_proj, 0, 1), unroll=unroll)
+    return jnp.swapaxes(outputs, 0, 1), h_t.astype(dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -203,6 +215,8 @@ def stacked_rnn(
     dropout_key=None,
     unroll: int = 1,
     impl: str = "auto",
+    compute_dtype=None,
+    remat: bool = False,
 ):
     """Apply a stack of RNN layers; dropout between layers (not after the
     last), matching torch's stacked ``nn.LSTM(dropout=...)`` placement.
@@ -210,6 +224,17 @@ def stacked_rnn(
     ``dropout_key=None`` selects eval/deterministic mode (the analogue of
     torch's ``model.eval()``): dropout is skipped even when ``dropout > 0``.
     Pass a PRNG key to enable train-mode dropout.
+
+    TPU levers (both default off, numerics unchanged):
+
+    - ``compute_dtype`` (e.g. ``jnp.bfloat16``): params and activations are
+      cast for the layer compute - bf16 matmuls run at full MXU rate and
+      halve HBM traffic; params stay stored in their own dtype, so the
+      optimizer update remains full precision (standard mixed precision).
+      Outputs come back in ``compute_dtype``; cast at the loss if needed.
+    - ``remat``: wrap each layer in ``jax.checkpoint`` - activations are
+      recomputed during backward instead of saved, trading FLOPs for HBM
+      (the lever for deep stacks / long sequences like the 50M LM preset).
 
     Returns (outputs (B, T, H), list of per-layer final carries).
     """
@@ -220,21 +245,30 @@ def stacked_rnn(
             lstm_layer_fused,
         )
 
+        lstm_fn = lstm_layer_fused
+        gru_fn = gru_layer_fused
+    else:
+        lstm_fn = partial(lstm_layer, unroll=unroll)
+        gru_fn = partial(gru_layer, unroll=unroll)
+    if cell == "lstm":
+        layer_fn = lstm_fn
+    elif cell == "gru":
+        layer_fn = gru_fn
+    else:
+        raise ValueError(f"unknown cell {cell!r}")
+    if remat:
+        layer_fn = jax.checkpoint(layer_fn)
+
     finals = []
     out = x
+    if compute_dtype is not None:
+        out = out.astype(compute_dtype)
     for idx, layer in enumerate(layers):
-        if cell == "lstm":
-            if impl == "fused":
-                out, final = lstm_layer_fused(layer, out)
-            else:
-                out, final = lstm_layer(layer, out, unroll=unroll)
-        elif cell == "gru":
-            if impl == "fused":
-                out, final = gru_layer_fused(layer, out)
-            else:
-                out, final = gru_layer(layer, out, unroll=unroll)
-        else:
-            raise ValueError(f"unknown cell {cell!r}")
+        if compute_dtype is not None:
+            layer = jax.tree.map(
+                lambda p: p.astype(compute_dtype), layer
+            )
+        out, final = layer_fn(layer, out)
         finals.append(final)
         if dropout > 0.0 and dropout_key is not None and idx < len(layers) - 1:
             dropout_key, sub = jax.random.split(dropout_key)

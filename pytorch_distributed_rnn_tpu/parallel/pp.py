@@ -60,9 +60,9 @@ def _run_layer(stacked, l, acts, *, unroll: int = 1):
     b = lax.dynamic_index_in_dim(stacked["b"], l, keepdims=False)
     x_proj = jnp.einsum("bti,gi->btg", acts, w_ih) + b
     batch, hidden = acts.shape[0], w_hh_t.shape[0]
-    carry0 = (
-        jnp.zeros((batch, hidden), acts.dtype),
-        jnp.zeros((batch, hidden), acts.dtype),
+    carry0 = (  # f32 per the lstm_step mixed-precision contract
+        jnp.zeros((batch, hidden), jnp.float32),
+        jnp.zeros((batch, hidden), jnp.float32),
     )
     _, out = lax.scan(
         lambda c, xp: lstm_step(w_hh_t, c, xp),

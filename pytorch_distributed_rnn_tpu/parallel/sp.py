@@ -116,14 +116,14 @@ def sp_lstm_layer(params, x_local, axis: str, *, unroll: int = 1):
     n = lax.axis_size(axis)
     batch = x_local.shape[0]
     hidden = params["w_hh"].shape[1]
-    dtype = x_local.dtype
 
     # Fully parallel across time shards: the big MXU matmul.
     x_proj = lstm_input_proj(params, x_local)
     w_hh_t = params["w_hh"].T
 
-    h0 = jnp.zeros((batch, hidden), dtype)
-    c0 = jnp.zeros((batch, hidden), dtype)
+    # f32 carry per the lstm_step mixed-precision contract
+    h0 = jnp.zeros((batch, hidden), jnp.float32)
+    c0 = jnp.zeros((batch, hidden), jnp.float32)
 
     final, outputs = _relay(
         axis, n, (h0, c0),
@@ -190,9 +190,9 @@ def sp_stacked_lstm_wavefront(layers, x_local, axis: str, *,
     def select(active, new, old):
         return jax.tree.map(lambda a, b: jnp.where(active, a, b), new, old)
 
-    zero_carry = (
-        jnp.zeros((batch, hidden), dtype),
-        jnp.zeros((batch, hidden), dtype),
+    zero_carry = (  # f32 per the lstm_step mixed-precision contract
+        jnp.zeros((batch, hidden), jnp.float32),
+        jnp.zeros((batch, hidden), jnp.float32),
     )
 
     def wavefront(state, w):
@@ -243,9 +243,9 @@ def sp_stacked_lstm_wavefront(layers, x_local, axis: str, *,
 
     outs = jnp.zeros((batch, t_local, hidden), dtype)
     acts0 = jnp.zeros((batch, t_local, hidden), dtype)
-    finals_buf = (
-        jnp.zeros((L, batch, hidden), dtype),
-        jnp.zeros((L, batch, hidden), dtype),
+    finals_buf = (  # carries are f32 (lstm_step contract)
+        jnp.zeros((L, batch, hidden), jnp.float32),
+        jnp.zeros((L, batch, hidden), jnp.float32),
     )
     (_, _, outs, finals_buf), _ = lax.scan(
         wavefront,

@@ -67,6 +67,8 @@ def train(args, trainer_class):
         layer_dim=args.stacked_layer,
         output_dim=len(MotionDataset.LABELS),
         cell=getattr(args, "cell", "lstm"),
+        precision=getattr(args, "precision", "f32"),
+        remat=getattr(args, "remat", False),
     )
 
     trainer = trainer_class(
