@@ -38,6 +38,17 @@ def add_sub_commands(sub_parser):
         parser = sub_parser.add_parser(name)
         parser.set_defaults(func=lambda args, cls=cls: train(args, cls))
 
+    # process-per-rank DDP over the native TCP collectives (the mpirun
+    # analogue); world topology from MASTER_ADDR/PORT/RANK/WORLD_SIZE env
+    native = sub_parser.add_parser("distributed-native")
+
+    def _native(args):
+        from pytorch_distributed_rnn_tpu.training.native_ddp import execute
+
+        return execute(args)
+
+    native.set_defaults(func=_native)
+
 
 def train(args, trainer_class):
     # basicConfig (not just setLevel): module-level loggers like the

@@ -1,0 +1,269 @@
+"""Process-per-rank data parallelism over the native C++ TCP collectives.
+
+The reference's primary path is N OS processes launched by ``mpirun``, each
+holding a model replica, with torch DDP allreducing gradients over
+OpenMPI (``/root/reference/src/motion/trainer/ddp.py:18-19``,
+``fabfile.py:218-223``).  The SPMD trainers (``training/distributed.py``)
+are the TPU-native answer when one controller owns all chips; THIS module
+is the multi-process analogue for the topologies where ranks really are
+separate processes/hosts - each rank computes forward+backward locally as
+one jitted XLA program, then averages gradients through the framework's
+C++ TCP runtime (``runtime/csrc/collectives.cpp``, the MPI-replacement
+transport that also backs the parameter-server strategy), and applies the
+optimizer locally.  Identical updates from identical averaged gradients
+keep replicas in lockstep - the DDP invariant, checked by the rank-parity
+tests.
+
+Reference semantics kept: rank-0-only evaluation/checkpointing
+(``distributed.py:20-22,60-62``), per-rank batch = batch_size //
+world_size (``distributed.py:48-49``), rank-tagged log lines and per-rank
+perf line, parameter broadcast from rank 0 before training (the
+DDP-construction broadcast, ``example_ddp.py:46``).
+
+Launch: ``MASTER_ADDR``/``MASTER_PORT``/``RANK``/``WORLD_SIZE`` env (the
+``mpirun`` analogue - one process per rank), subcommand
+``distributed-native``; or :func:`launch_world` spawns a local world (the
+docker-compose fake-cluster analogue).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.flatten_util import ravel_pytree
+
+from pytorch_distributed_rnn_tpu.data.sampler import DistributedSampler
+from pytorch_distributed_rnn_tpu.training.base import Trainer
+from pytorch_distributed_rnn_tpu.training.formatter import TrainingMessageFormatter
+
+log = logging.getLogger(__name__)
+
+
+class NativeDDPTrainer(Trainer):
+    """One rank of a process-per-rank DDP world."""
+
+    # gradients cross the host TCP transport every step, so the host must
+    # act per batch (no scanned device-resident epoch program)
+    DEVICE_DATA = False
+
+    def __init__(
+        self,
+        comm,
+        model,
+        training_set,
+        batch_size: int,
+        learning_rate: float,
+        validation_set=None,
+        test_set=None,
+        checkpoint_dir=None,
+        seed: int | None = None,
+    ):
+        rank = comm.rank
+        world = comm.world_size
+        sampler = DistributedSampler(
+            len(training_set), num_replicas=world, rank=rank, seed=seed or 0
+        )
+        super().__init__(
+            model=model,
+            training_set=training_set,
+            # global-batch semantics (reference distributed.py:48-49)
+            batch_size=max(1, batch_size // world),
+            learning_rate=learning_rate,
+            # rank-0-only evaluation and checkpointing (distributed.py:20-22)
+            validation_set=validation_set if rank == 0 else None,
+            test_set=test_set if rank == 0 else None,
+            checkpoint_dir=checkpoint_dir if rank == 0 else None,
+            sampler=sampler,
+            seed=seed,
+        )
+        self.comm = comm
+        self.rank = rank
+        self.world_size = world
+
+        # parameter broadcast from rank 0: the DDP-construction broadcast
+        # (reference example_ddp.py:46) - afterwards every replica is
+        # bit-identical and stays so via identical averaged updates
+        flat, self._unravel = ravel_pytree(self.params)
+        flat = self.comm.broadcast(
+            np.asarray(flat, np.float32).copy(), root=0
+        )
+        self.params = self._unravel(jnp.asarray(flat))
+
+    def _get_formatter(self, epochs):
+        return TrainingMessageFormatter(epochs, self.rank)
+
+    def _build_train_step(self):
+        grad_fn = jax.jit(
+            jax.value_and_grad(self._loss_and_metrics, has_aux=True)
+        )
+
+        @jax.jit
+        def apply_update(params, opt_state, grads):
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params
+            )
+            return optax.apply_updates(params, updates), opt_state
+
+        def step(params, opt_state, batch):
+            (loss, metrics), grads = grad_fn(params, batch)
+            flat, unravel = ravel_pytree(grads)
+            # the DDP reducer analogue: one averaged allreduce over TCP.
+            # .copy() is load-bearing: on CPU np.asarray is a zero-copy
+            # view of the XLA buffer and the native allreduce writes
+            # in place through a raw pointer
+            summed = self.comm.allreduce(
+                np.asarray(flat, np.float32).copy()
+            )
+            grads = unravel(jnp.asarray(summed / self.world_size))
+            params, opt_state = apply_update(params, opt_state, grads)
+            return params, opt_state, loss, metrics
+
+        return step
+
+
+def run_rank(comm, args, model, datasets):
+    """Train this rank's replica; returns the trainer (rank 0 writes
+    ``history.json``, every rank logs its perf line)."""
+    training_set, validation_set, test_set = datasets
+    trainer = NativeDDPTrainer(
+        comm=comm,
+        model=model,
+        training_set=training_set,
+        validation_set=validation_set,
+        test_set=test_set,
+        batch_size=args.batch_size,
+        learning_rate=args.learning_rate,
+        checkpoint_dir=args.checkpoint_directory,
+        seed=args.seed,
+    )
+    if getattr(args, "resume", None):
+        meta = trainer.resume_from(args.resume)
+        log.info(f"Resumed from {args.resume} at epoch {meta['epoch']}")
+    _, train_history, validation_history = trainer.train(epochs=args.epochs)
+    # the rank-parity observable (reference example_ddp.py:92 prints the
+    # same quantity): identical on every rank iff replicas stayed in sync
+    flat, _ = ravel_pytree(trainer.params)
+    log.info(
+        f"{comm.rank}: parameters: "
+        f"{float(np.asarray(flat, np.float64).sum()):.10f}"
+    )
+    if comm.rank == 0:
+        with open("history.json", "w") as file:
+            json.dump(
+                {
+                    "train_history": train_history,
+                    "validation_history": validation_history,
+                },
+                file,
+            )
+    return trainer
+
+
+def launch_world(world_size: int, cli_args, *, master_port: int = 29533,
+                 cwd=None, timeout: float = 600):
+    """Spawn a local ``world_size``-process DDP world (the reference's
+    docker-compose two-container fake cluster, as plain processes): each
+    rank runs ``python -m pytorch_distributed_rnn_tpu.main <cli_args>
+    distributed-native`` with the env rendezvous set.  Returns the list of
+    ``CompletedProcess``-like results in rank order; raises if any rank
+    fails."""
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo_root = str(Path(__file__).resolve().parent.parent.parent)
+    procs = []
+    for rank in range(world_size):
+        env = dict(os.environ)
+        env.update(
+            MASTER_ADDR="127.0.0.1",
+            MASTER_PORT=str(master_port),
+            RANK=str(rank),
+            WORLD_SIZE=str(world_size),
+            PDRNN_PLATFORM="cpu",
+        )
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in (repo_root, env.get("PYTHONPATH")) if p
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-m", "pytorch_distributed_rnn_tpu.main",
+                 *map(str, cli_args), "distributed-native"],
+                env=env, cwd=cwd, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True,
+            )
+        )
+    # drain every rank's pipes CONCURRENTLY: a rank blocked on a full
+    # stderr pipe stops participating in the collectives and would
+    # deadlock the whole world if ranks were drained one at a time
+    import threading
+
+    results = [None] * world_size
+    errors = [None] * world_size
+
+    def drain(rank, proc):
+        try:
+            out, err = proc.communicate(timeout=timeout)
+            results[rank] = (proc.returncode, out, err)
+        except subprocess.TimeoutExpired as e:
+            errors[rank] = e
+            proc.kill()
+            proc.communicate()
+
+    threads = [
+        threading.Thread(target=drain, args=(rank, proc))
+        for rank, proc in enumerate(procs)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    timed_out = [r for r, e in enumerate(errors) if e is not None]
+    if timed_out:
+        raise RuntimeError(f"ranks timed out after {timeout}s: {timed_out}")
+    failed = [
+        (rank, res[2][-2000:])
+        for rank, res in enumerate(results)
+        if res[0] != 0
+    ]
+    if failed:
+        raise RuntimeError(f"ranks failed: {failed}")
+    return results
+
+
+def execute(args):
+    """CLI entry for one rank (``distributed-native`` subcommand): world
+    topology from MASTER_ADDR/MASTER_PORT/RANK/WORLD_SIZE env - exactly how
+    mpirun-launched ranks discovered theirs in the reference."""
+    from pytorch_distributed_rnn_tpu.data import MotionDataset
+    from pytorch_distributed_rnn_tpu.models import MotionModel
+    from pytorch_distributed_rnn_tpu.runtime.native import init_from_env
+
+    logging.basicConfig(level=args.log)
+    logging.getLogger().setLevel(args.log)
+
+    datasets = MotionDataset.load(
+        args.dataset_path,
+        output_path=args.output_path,
+        validation_fraction=args.validation_fraction,
+        seed=args.seed,
+    )
+    if args.no_validation:
+        datasets = (datasets[0], None, None)
+    model = MotionModel(
+        input_dim=datasets[0].num_features,
+        hidden_dim=args.hidden_units,
+        layer_dim=args.stacked_layer,
+        output_dim=len(MotionDataset.LABELS),
+        cell=getattr(args, "cell", "lstm"),
+        precision=getattr(args, "precision", "f32"),
+        remat=getattr(args, "remat", False),
+    )
+    with init_from_env() as comm:
+        return run_rank(comm, args, model, datasets)
