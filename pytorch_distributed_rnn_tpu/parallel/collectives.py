@@ -15,11 +15,16 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def psum_tree(tree, axis: str):
+def psum_tree(tree, axis):
+    """``axis`` may be one mesh-axis name or a tuple of names - a tuple
+    reduces over their product, which is how hierarchical data parallelism
+    (inner axis over ICI within a slice, outer axis over DCN across
+    slices) expresses a global allreduce: XLA decomposes the multi-axis
+    reduction into the per-network stages."""
     return jax.tree.map(lambda x: lax.psum(x, axis), tree)
 
 
-def pmean_tree(tree, axis: str):
+def pmean_tree(tree, axis):
     return jax.tree.map(lambda x: lax.pmean(x, axis), tree)
 
 
