@@ -38,6 +38,7 @@ class CharRNN:
     impl: str = "auto"  # "scan" | "fused" (Pallas) | "auto"
     precision: str = "f32"  # "bf16": bf16 compute, f32 params (MXU rate)
     remat: bool = False  # recompute activations in backward (HBM lever)
+    dropout: float = 0.0  # inter-layer dropout (train mode only)
 
     def init(self, key: jax.Array):
         k_embed, k_rnn, k_head = jax.random.split(key, 3)
@@ -52,23 +53,27 @@ class CharRNN:
             "head": linear_init(k_head, self.hidden_dim, self.vocab_size),
         }
 
-    def apply(self, params, tokens: jax.Array) -> jax.Array:
-        """tokens: (B, T) int32 -> logits (B, T, vocab)."""
+    def apply(self, params, tokens: jax.Array, dropout_key=None) -> jax.Array:
+        """tokens: (B, T) int32 -> logits (B, T, vocab).
+
+        ``dropout_key=None`` = eval/deterministic; pass a key for
+        train-mode inter-layer dropout."""
         compute_dtype = jnp.bfloat16 if self.precision == "bf16" else None
         x = params["embed"][tokens]
         outputs, _ = stacked_rnn(
             params["rnn"], x, self.cell, unroll=self.unroll, impl=self.impl,
             compute_dtype=compute_dtype, remat=self.remat,
+            dropout=self.dropout, dropout_key=dropout_key,
         )
         outputs = outputs.astype(jnp.float32)
         return (
             outputs @ params["head"]["weight"].T + params["head"]["bias"]
         )
 
-    def loss(self, params, tokens: jax.Array) -> jax.Array:
+    def loss(self, params, tokens: jax.Array, dropout_key=None) -> jax.Array:
         """Next-token cross entropy: predict tokens[:, 1:] from
         tokens[:, :-1], mean over all positions."""
-        logits = self.apply(params, tokens[:, :-1])
+        logits = self.apply(params, tokens[:, :-1], dropout_key=dropout_key)
         targets = tokens[:, 1:]
         return cross_entropy_loss(
             logits.reshape(-1, self.vocab_size), targets.reshape(-1)

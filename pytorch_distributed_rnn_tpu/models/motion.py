@@ -34,6 +34,10 @@ class MotionModel:
     impl: str = "auto"  # "scan" | "fused" (Pallas) | "auto" (fused on TPU)
     precision: str = "f32"  # "bf16": bf16 compute, f32 params (MXU rate)
     remat: bool = False  # recompute activations in backward (HBM lever)
+    dropout: float = 0.0  # inter-layer dropout; the reference parses but
+    # never uses --dropout (/root/reference/src/motion/main.py:26) - here
+    # the flag is real (conscious fix, PARITY.md): train mode passes a
+    # dropout_key, eval passes none and stays deterministic
 
     def init(self, key: jax.Array):
         rnn_key, fc_key = jax.random.split(key)
@@ -44,12 +48,17 @@ class MotionModel:
             "fc": linear_init(fc_key, self.hidden_dim, self.output_dim),
         }
 
-    def apply(self, params, x: jax.Array) -> jax.Array:
-        """x: (B, T, input_dim) -> logits (B, output_dim)."""
+    def apply(self, params, x: jax.Array, dropout_key=None) -> jax.Array:
+        """x: (B, T, input_dim) -> logits (B, output_dim).
+
+        ``dropout_key=None`` = eval/deterministic mode; pass a PRNG key for
+        train-mode inter-layer dropout (torch ``nn.LSTM(dropout=...)``
+        placement)."""
         compute_dtype = jnp.bfloat16 if self.precision == "bf16" else None
         outputs, _ = stacked_rnn(
             params["rnn"], x, self.cell, unroll=self.unroll, impl=self.impl,
             compute_dtype=compute_dtype, remat=self.remat,
+            dropout=self.dropout, dropout_key=dropout_key,
         )
         last = outputs[:, -1, :].astype(jnp.float32)
         return last @ params["fc"]["weight"].T + params["fc"]["bias"]

@@ -109,6 +109,7 @@ def make_spmd_train_step(
     axis: str = "dp",
     sync: str = "backward",
     donate: bool = True,
+    with_key: bool = False,
 ):
     """Build a jitted SPMD data-parallel train step.
 
@@ -118,19 +119,26 @@ def make_spmd_train_step(
     sharded along ``axis`` on its leading dim and params/opt_state
     replicated; it returns ``(params, opt_state, loss, metrics)`` where
     ``loss`` is the global mean and ``metrics`` are globally summed.
+
+    ``with_key=True`` adds a trailing replicated per-step PRNG key argument
+    forwarded to the loss fn (train-mode dropout; the loss fn folds the
+    rank in so each shard draws an independent mask).
     """
     grad_step = _make_grad_step(loss_and_metrics, optimizer, axis, sync)
     rep = P()
+    key_specs = (rep,) if with_key else ()
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(rep, rep, P(axis)),
+        in_specs=(rep, rep, P(axis)) + key_specs,
         out_specs=(rep, rep, rep, rep),
         check_vma=False,
     )
-    def _step(params, opt_state, batch):
-        params, opt_state, loss, metrics = grad_step(params, opt_state, batch)
+    def _step(params, opt_state, batch, *extra):
+        params, opt_state, loss, metrics = grad_step(
+            params, opt_state, batch, *extra
+        )
         return (
             params,
             opt_state,
@@ -148,6 +156,7 @@ def make_spmd_idx_train_step(
     axis: str = "dp",
     sync: str = "backward",
     donate: bool = True,
+    with_key: bool = False,
 ):
     """Like :func:`make_spmd_train_step` but the batch is selected ON
     DEVICE: ``step(params, opt_state, features, labels, idx)`` gathers
@@ -162,17 +171,20 @@ def make_spmd_idx_train_step(
     """
     grad_step = _make_grad_step(loss_and_metrics, optimizer, axis, sync)
     rep = P()
+    key_specs = (rep,) if with_key else ()
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(rep, rep, rep, rep, P(axis)),
+        in_specs=(rep, rep, rep, rep, P(axis)) + key_specs,
         out_specs=(rep, rep, rep, rep),
         check_vma=False,
     )
-    def _step(params, opt_state, features, labels, idx):
+    def _step(params, opt_state, features, labels, idx, *extra):
         batch = (features[idx], labels[idx])
-        params, opt_state, loss, metrics = grad_step(params, opt_state, batch)
+        params, opt_state, loss, metrics = grad_step(
+            params, opt_state, batch, *extra
+        )
         return (
             params,
             opt_state,
@@ -190,6 +202,7 @@ def make_spmd_epoch_fn(
     axis: str = "dp",
     sync: str = "backward",
     donate: bool = True,
+    with_key: bool = False,
 ):
     """Whole-epoch SPMD program: ``lax.scan`` over the epoch's batch-index
     matrix, one device dispatch per epoch.
@@ -202,28 +215,35 @@ def make_spmd_epoch_fn(
     quantity the reference accumulates, ``base.py:123-128``).  Eliminates
     per-step dispatch/transfer latency entirely - the TPU-native answer to
     the reference's per-batch Python loop.
+
+    ``with_key=True`` adds a trailing replicated (num_batches, 2) per-step
+    key matrix riding the scan (train-mode dropout).
     """
     grad_step = _make_grad_step(loss_and_metrics, optimizer, axis, sync)
     rep = P()
+    key_specs = (P(None),) if with_key else ()
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(rep, rep, rep, rep, P(None, axis)),
+        in_specs=(rep, rep, rep, rep, P(None, axis)) + key_specs,
         out_specs=(rep, rep, rep, rep),
         check_vma=False,
     )
-    def _epoch(params, opt_state, features, labels, idx_mat):
-        def body(carry, idx):
+    def _epoch(params, opt_state, features, labels, idx_mat, *key_mat):
+        def body(carry, step_in):
             params, opt_state = carry
+            idx = step_in[0] if with_key else step_in
+            extra = (step_in[1],) if with_key else ()
             batch = (features[idx], labels[idx])
             params, opt_state, loss, metrics = grad_step(
-                params, opt_state, batch
+                params, opt_state, batch, *extra
             )
             return (params, opt_state), (loss, metrics)
 
+        xs = (idx_mat, key_mat[0]) if with_key else idx_mat
         (params, opt_state), (losses, metrics) = jax.lax.scan(
-            body, (params, opt_state), idx_mat
+            body, (params, opt_state), xs
         )
         # pmean is linear: one scalar AllReduce after the scan instead of
         # one per step
@@ -243,6 +263,7 @@ def make_spmd_run_fn(
     axis: str = "dp",
     sync: str = "backward",
     donate: bool = True,
+    with_key: bool = False,
 ):
     """The whole multi-epoch training run as ONE SPMD program: scan over
     every (weight-masked) batch of every epoch.
@@ -257,26 +278,32 @@ def make_spmd_run_fn(
     """
     grad_step = _make_grad_step(weighted_loss_and_metrics, optimizer, axis, sync)
     rep = P()
+    key_specs = (P(None),) if with_key else ()
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(rep, rep, rep, rep, P(None, axis), P(None, axis)),
+        in_specs=(rep, rep, rep, rep, P(None, axis), P(None, axis))
+        + key_specs,
         out_specs=(rep, rep, rep, rep),
         check_vma=False,
     )
-    def _run(params, opt_state, features, labels, idx_mat, w_mat):
+    def _run(params, opt_state, features, labels, idx_mat, w_mat, *key_mat):
         def body(carry, step_in):
             params, opt_state = carry
-            idx, w = step_in
+            idx, w = step_in[0], step_in[1]
+            extra = (step_in[2],) if with_key else ()
             batch = (features[idx], labels[idx])
             params, opt_state, loss, metrics = grad_step(
-                params, opt_state, batch, w
+                params, opt_state, batch, w, *extra
             )
             return (params, opt_state), (loss, metrics["correct"])
 
+        xs = (
+            (idx_mat, w_mat, key_mat[0]) if with_key else (idx_mat, w_mat)
+        )
         (params, opt_state), (losses, correct) = jax.lax.scan(
-            body, (params, opt_state), (idx_mat, w_mat)
+            body, (params, opt_state), xs
         )
         # pmean/psum are linear: one vector collective each after the scan
         # instead of one per step

@@ -35,6 +35,7 @@ def _build_model_and_flat_params(args, num_features: int, seed):
         layer_dim=args.stacked_layer,
         output_dim=len(MotionDataset.LABELS),
         cell=getattr(args, "cell", "lstm"),
+        dropout=getattr(args, "dropout", 0.0) or 0.0,
     )
     params = model.init(jax.random.PRNGKey(seed if seed is not None else 0))
     flat, unravel = ravel_pytree(params)

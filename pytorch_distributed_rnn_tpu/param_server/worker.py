@@ -83,14 +83,18 @@ class ParameterServerWorkerTrainer(Trainer):
     def _get_formatter(self, epochs):
         return TrainingMessageFormatter(epochs, self.worker_rank)
 
+    def _fold_rank(self, key):
+        # each PS worker draws its own dropout mask
+        return jax.random.fold_in(key, self.worker_rank)
+
     def _build_train_step(self):
         """Local fused forward+backward; the update is remote."""
         grad_fn = jax.jit(
             jax.value_and_grad(self._loss_and_metrics, has_aux=True)
         )
 
-        def step(params, opt_state, batch):
-            (loss, metrics), grads = grad_fn(params, batch)
+        def step(params, opt_state, batch, *extra):
+            (loss, metrics), grads = grad_fn(params, batch, *extra)
             flat_grads, _ = ravel_pytree(grads)
             protocol.send_request(
                 self.comm, protocol.OP_PUSH, grads=np.asarray(flat_grads)

@@ -80,6 +80,9 @@ def train(args, trainer_class):
         cell=getattr(args, "cell", "lstm"),
         precision=getattr(args, "precision", "f32"),
         remat=getattr(args, "remat", False),
+        # real (train-mode) dropout - the reference parses but never uses
+        # --dropout (/root/reference/src/motion/main.py:26)
+        dropout=getattr(args, "dropout", 0.0) or 0.0,
     )
 
     trainer = trainer_class(

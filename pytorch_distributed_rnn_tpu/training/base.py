@@ -91,6 +91,16 @@ class Trainer:
         self.optimizer = self._get_optimizer(learning_rate)
         self.opt_state = self.optimizer.init(self.params)
 
+        # train-mode dropout: real here, unlike the reference's dead
+        # --dropout flag (/root/reference/src/motion/main.py:26 - parsed,
+        # never used; conscious fix, PARITY.md).  Per-step keys are threaded
+        # as a trailing arg only when dropout is on, so the no-dropout
+        # compiled programs are unchanged.
+        self._dropout = float(getattr(model, "dropout", 0.0) or 0.0)
+        self._dropout_key = jax.random.fold_in(
+            jax.random.PRNGKey(seed if seed is not None else 0), 0x5EED
+        )
+
         self._train_step_fn = None
         self._eval_step_fn = None
         self._idx_step_fn = None
@@ -99,6 +109,7 @@ class Trainer:
         self._device_data = None
         self._eval_data_cache = {}
         self._resume_best_loss = None
+        self._epoch = 0
 
     # -- subclass hooks ------------------------------------------------------
 
@@ -108,21 +119,33 @@ class Trainer:
     def _get_formatter(self, epochs: int) -> TrainingMessageFormatter:
         return TrainingMessageFormatter(epochs)
 
-    def _loss_and_metrics(self, params, batch):
+    def _fold_rank(self, key):
+        """Hook: SPMD subclasses fold the data-parallel rank into the
+        dropout key so each shard draws an independent mask (matching
+        torch DDP, where every rank has its own RNG stream)."""
+        return key
+
+    def _apply_model(self, params, x, key=None):
+        """Model forward; threads the dropout key in train mode only."""
+        if key is None or self._dropout <= 0.0:
+            return self.model.apply(params, x)
+        return self.model.apply(params, x, dropout_key=self._fold_rank(key))
+
+    def _loss_and_metrics(self, params, batch, key=None):
         x, y = batch
-        logits = self.model.apply(params, x)
+        logits = self._apply_model(params, x, key)
         loss = cross_entropy_loss(logits, y)
         correct = jnp.sum(jnp.argmax(logits, axis=1) == y)
         return loss, {"correct": correct}
 
-    def _weighted_loss_and_metrics(self, params, batch, w):
+    def _weighted_loss_and_metrics(self, params, batch, w, key=None):
         """Masked variant used by the fused whole-run program: ``w`` is a
         0/1 weight per example.  With all-ones weights this equals
         ``_loss_and_metrics`` exactly; with a zero-padded tail it equals
         the reference's smaller final batch's mean (``base.py:46-51``).
         Override together with ``_loss_and_metrics``."""
         x, y = batch
-        logits = self.model.apply(params, x)
+        logits = self._apply_model(params, x, key)
         nll = cross_entropy_loss(logits, y, reduction="none")
         loss = jnp.sum(nll * w) / jnp.sum(w)
         correct = jnp.sum((jnp.argmax(logits, axis=1) == y) * (w > 0))
@@ -153,29 +176,37 @@ class Trainer:
         return jax.jit(self._loss_and_metrics)
 
     def _build_idx_train_step(self):
-        """Train step taking (params, opt_state, features, labels, idx):
-        the batch is gathered on device from resident arrays."""
+        """Train step taking (params, opt_state, features, labels, idx,
+        [key]): the batch is gathered on device from resident arrays; the
+        trailing per-step dropout key is passed only when dropout is on."""
         grad_step = self._make_grad_step(self._loss_and_metrics)
 
-        def step(params, opt_state, features, labels, idx):
-            return grad_step(params, opt_state, (features[idx], labels[idx]))
+        def step(params, opt_state, features, labels, idx, *extra):
+            return grad_step(
+                params, opt_state, (features[idx], labels[idx]), *extra
+            )
 
         return jax.jit(step, donate_argnums=(0, 1))
 
     def _build_epoch_fn(self):
         """Whole-epoch program: ``lax.scan`` over the epoch's (num_batches,
-        batch) index matrix - one dispatch per epoch."""
+        batch) index matrix - one dispatch per epoch.  With dropout on, a
+        (num_batches, 2) per-step key matrix rides the scan."""
         grad_step = self._make_grad_step(self._loss_and_metrics)
+        with_key = self._dropout > 0.0
 
-        def epoch(params, opt_state, features, labels, idx_mat):
-            def body(carry, idx):
+        def epoch(params, opt_state, features, labels, idx_mat, key_mat=None):
+            def body(carry, step_in):
+                idx = step_in[0] if with_key else step_in
+                extra = (step_in[1],) if with_key else ()
                 params, opt_state, loss, metrics = grad_step(
-                    *carry, (features[idx], labels[idx])
+                    *carry, (features[idx], labels[idx]), *extra
                 )
                 return (params, opt_state), (loss, metrics)
 
+            xs = (idx_mat, key_mat) if with_key else idx_mat
             (params, opt_state), (losses, metrics) = jax.lax.scan(
-                body, (params, opt_state), idx_mat
+                body, (params, opt_state), xs
             )
             metrics_sum = jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics)
             return params, opt_state, jnp.sum(losses), metrics_sum
@@ -188,21 +219,38 @@ class Trainer:
         batch keeps reference semantics), returning per-step losses and
         correct-counts for the host to fold into per-epoch history."""
         grad_step = self._make_grad_step(self._weighted_loss_and_metrics)
+        with_key = self._dropout > 0.0
 
-        def run(params, opt_state, features, labels, idx_mat, w_mat):
+        def run(params, opt_state, features, labels, idx_mat, w_mat,
+                key_mat=None):
             def body(carry, step_in):
-                idx, w = step_in
+                idx, w = step_in[0], step_in[1]
+                extra = (step_in[2],) if with_key else ()
                 params, opt_state, loss, metrics = grad_step(
-                    *carry, (features[idx], labels[idx]), w
+                    *carry, (features[idx], labels[idx]), w, *extra
                 )
                 return (params, opt_state), (loss, metrics["correct"])
 
+            xs = (idx_mat, w_mat, key_mat) if with_key else (idx_mat, w_mat)
             (params, opt_state), (losses, correct) = jax.lax.scan(
-                body, (params, opt_state), (idx_mat, w_mat)
+                body, (params, opt_state), xs
             )
             return params, opt_state, losses, correct
 
         return jax.jit(run, donate_argnums=(0, 1))
+
+    # -- dropout keys --------------------------------------------------------
+
+    def _epoch_dropout_keys(self, epoch: int, num_batches: int):
+        """Per-step dropout keys for one epoch, derived deterministically
+        from (seed, epoch, batch index) so the batched scan path and the
+        per-batch logging path produce identical numerics."""
+        ekey = jax.random.fold_in(self._dropout_key, epoch)
+        return np.asarray(
+            jax.vmap(lambda i: jax.random.fold_in(ekey, i))(
+                jnp.arange(num_batches)
+            )
+        )
 
     # -- data ----------------------------------------------------------------
 
@@ -247,6 +295,12 @@ class Trainer:
             for start in range(0, len(indices), self.batch_size)
         ]
 
+    def _has_partial_batch(self) -> bool:
+        """Whether epochs end in a smaller final batch (batch sizes are
+        epoch-invariant; only the order shuffles)."""
+        batches = self._epoch_index_batches()
+        return len(batches) > 1 and len(batches[-1]) != len(batches[0])
+
     def _pad_batch(self, b, full_size):
         """Pad an index batch to ``full_size`` with zero-weighted dummy
         examples (index 0, weight 0) for the fused fixed-shape run."""
@@ -282,6 +336,11 @@ class Trainer:
             and self.validation_set is None
             and epochs > 0
             and not logging.getLogger().isEnabledFor(logging.INFO)
+            # with dropout on, a partial final batch would draw its mask
+            # over the fused path's zero-padded batch shape and diverge
+            # from the per-epoch path's unpadded draw; keep the two paths
+            # bit-identical by taking the per-epoch path in that case
+            and not (self._dropout > 0.0 and self._has_partial_batch())
         )
 
         def train_inner():
@@ -293,6 +352,7 @@ class Trainer:
             best_loss = self._resume_best_loss
             for epoch in range(epochs):
                 self.sampler.set_epoch(epoch)
+                self._epoch = epoch
                 logging.info(formatter.epoch_start_message(epoch))
                 train_loss, train_acc = self._train_epoch(formatter)
                 training_history.append(train_loss)
@@ -323,7 +383,7 @@ class Trainer:
             self._run_fn = self._build_run_fn()
         features, labels = self._device_train_data()
 
-        idx_rows, w_rows = [], []
+        idx_rows, w_rows, key_rows = [], [], []
         num_batches = None
         for epoch in range(epochs):
             self.sampler.set_epoch(epoch)
@@ -334,11 +394,15 @@ class Trainer:
                 idx, w = self._pad_batch(b, full_size)
                 idx_rows.append(idx)
                 w_rows.append(w)
+            if self._dropout > 0.0:
+                key_rows.append(self._epoch_dropout_keys(epoch, len(batches)))
         idx_mat = np.stack(idx_rows)
         w_mat = np.stack(w_rows)
+        extra = (np.concatenate(key_rows),) if self._dropout > 0.0 else ()
 
         self.params, self.opt_state, losses, correct = self._run_fn(
-            self.params, self.opt_state, features, labels, idx_mat, w_mat
+            self.params, self.opt_state, features, labels, idx_mat, w_mat,
+            *extra,
         )
         losses = np.asarray(losses).reshape(epochs, num_batches)
         n = len(self.training_set)
@@ -355,6 +419,11 @@ class Trainer:
         log_progress = logging.getLogger().isEnabledFor(logging.DEBUG)
         features, labels = self._device_train_data()
         batches = self._epoch_index_batches()
+        keys = (
+            self._epoch_dropout_keys(self._epoch, len(batches))
+            if self._dropout > 0.0
+            else None
+        )
         total_loss = jnp.zeros(())
         total_correct = jnp.zeros((), jnp.int32)
 
@@ -362,8 +431,9 @@ class Trainer:
             # per-batch progress needs values on host each step: dispatch
             # batch-by-batch (still device-gathered, only indices transfer)
             for batch_idx, idx in enumerate(batches):
+                extra = (keys[batch_idx],) if keys is not None else ()
                 self.params, self.opt_state, loss, metrics = self._idx_step_fn(
-                    self.params, self.opt_state, features, labels, idx
+                    self.params, self.opt_state, features, labels, idx, *extra
                 )
                 total_loss = total_loss + loss
                 total_correct = total_correct + metrics["correct"]
@@ -385,19 +455,23 @@ class Trainer:
                 full, remainder = batches[:-1], batches[-1]
             if full:
                 idx_mat = np.stack(full)
+                extra = (keys[: len(full)],) if keys is not None else ()
                 (
                     self.params,
                     self.opt_state,
                     loss_sum,
                     metrics_sum,
                 ) = self._epoch_fn(
-                    self.params, self.opt_state, features, labels, idx_mat
+                    self.params, self.opt_state, features, labels, idx_mat,
+                    *extra,
                 )
                 total_loss = total_loss + loss_sum
                 total_correct = total_correct + metrics_sum["correct"]
             if remainder is not None:
+                extra = (keys[-1],) if keys is not None else ()
                 self.params, self.opt_state, loss, metrics = self._idx_step_fn(
-                    self.params, self.opt_state, features, labels, remainder
+                    self.params, self.opt_state, features, labels, remainder,
+                    *extra,
                 )
                 total_loss = total_loss + loss
                 total_correct = total_correct + metrics["correct"]
@@ -415,10 +489,16 @@ class Trainer:
         total_correct = jnp.zeros((), jnp.int32)
         loader = self._train_loader()
         num_batches = len(loader)
+        keys = (
+            self._epoch_dropout_keys(self._epoch, num_batches)
+            if self._dropout > 0.0
+            else None
+        )
         for batch_idx, (features, labels) in enumerate(loader):
             batch = self._prepare_batch(features, labels)
+            extra = (keys[batch_idx],) if keys is not None else ()
             self.params, self.opt_state, loss, metrics = self._train_step_fn(
-                self.params, self.opt_state, batch
+                self.params, self.opt_state, batch, *extra
             )
             total_loss = total_loss + loss
             total_correct = total_correct + metrics["correct"]

@@ -79,6 +79,13 @@ class SpmdTrainer(Trainer):
     def _get_formatter(self, epochs):
         return TrainingMessageFormatter(epochs, self.rank)
 
+    def _fold_rank(self, key):
+        # independent dropout mask per dp shard (torch DDP has one RNG
+        # stream per rank); the grad pmean keeps params identical anyway
+        import jax
+
+        return jax.random.fold_in(key, jax.lax.axis_index(self.axis))
+
     def _build_train_step(self):
         return make_spmd_train_step(
             self._loss_and_metrics,
@@ -86,6 +93,7 @@ class SpmdTrainer(Trainer):
             self.mesh,
             axis=self.axis,
             sync=self.SYNC,
+            with_key=self._dropout > 0.0,
         )
 
     def _build_idx_train_step(self):
@@ -95,6 +103,7 @@ class SpmdTrainer(Trainer):
             self.mesh,
             axis=self.axis,
             sync=self.SYNC,
+            with_key=self._dropout > 0.0,
         )
 
     def _build_epoch_fn(self):
@@ -104,6 +113,7 @@ class SpmdTrainer(Trainer):
             self.mesh,
             axis=self.axis,
             sync=self.SYNC,
+            with_key=self._dropout > 0.0,
         )
 
     def _build_run_fn(self):
@@ -113,6 +123,7 @@ class SpmdTrainer(Trainer):
             self.mesh,
             axis=self.axis,
             sync=self.SYNC,
+            with_key=self._dropout > 0.0,
         )
 
     def _data_sharding(self):

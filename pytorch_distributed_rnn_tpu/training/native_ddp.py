@@ -97,6 +97,11 @@ class NativeDDPTrainer(Trainer):
     def _get_formatter(self, epochs):
         return TrainingMessageFormatter(epochs, self.rank)
 
+    def _fold_rank(self, key):
+        # per-process rank known at trace time: each rank draws its own
+        # dropout mask (torch DDP per-rank RNG analogue)
+        return jax.random.fold_in(key, self.rank)
+
     def _build_train_step(self):
         grad_fn = jax.jit(
             jax.value_and_grad(self._loss_and_metrics, has_aux=True)
@@ -109,8 +114,8 @@ class NativeDDPTrainer(Trainer):
             )
             return optax.apply_updates(params, updates), opt_state
 
-        def step(params, opt_state, batch):
-            (loss, metrics), grads = grad_fn(params, batch)
+        def step(params, opt_state, batch, *extra):
+            (loss, metrics), grads = grad_fn(params, batch, *extra)
             flat, unravel = ravel_pytree(grads)
             # the DDP reducer analogue: one averaged allreduce over TCP.
             # .copy() is load-bearing: on CPU np.asarray is a zero-copy
