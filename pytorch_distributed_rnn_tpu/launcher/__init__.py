@@ -16,8 +16,10 @@ from pytorch_distributed_rnn_tpu.launcher.bench import (
     BENCHMARK_RUN,
     DEBUG_RUN,
     NETWORK_RULES,
+    SLOTS_RUN,
     execute_run,
     expand_run_configs,
+    launch_jax_world,
     load_results,
     preflight,
     run_benchmark,
@@ -32,8 +34,10 @@ __all__ = [
     "BENCHMARK_RUN",
     "DEBUG_RUN",
     "NETWORK_RULES",
+    "SLOTS_RUN",
     "execute_run",
     "expand_run_configs",
+    "launch_jax_world",
     "load_results",
     "preflight",
     "run_benchmark",

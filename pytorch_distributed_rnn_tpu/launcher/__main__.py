@@ -8,7 +8,13 @@ Tasks (mirroring ``/root/reference/fabfile.py`` Fabric tasks):
                     when the real UCI HAR download is absent)
   run-debug         single seeded 1-epoch run (``run_debug``)
   run-all           full shuffled benchmark sweep (``run_all``)
+  run-slots         real multi-slot sweep (processes-per-host dimension)
   run-network-test  delay/loss perturbation sweep (``run_network_test``)
+  run-world         stand up one N-process world: ``--transport native`` =
+                    process-per-rank DDP over the TCP collectives (the
+                    mpirun analogue); ``--transport jax`` = N processes
+                    rendezvous through a jax.distributed coordinator into
+                    one global-mesh SPMD world.  CLI flags after ``--``.
   show-commands     print synthesized commands without running
 
 Example:
@@ -62,7 +68,32 @@ def main(argv=None):
     p.add_argument("--devices", type=int, default=2)
     p.add_argument("--batch-size", type=int, default=1440)
 
+    p = sub.add_parser("run-slots")
+    _add_common(p)
+
+    p = sub.add_parser("run-world")
+    p.add_argument("--transport", choices=["native", "jax"], default="native")
+    p.add_argument("--world-size", type=int, default=2,
+                   help="native transport: process-per-rank world size")
+    p.add_argument("--num-processes", type=int, default=2,
+                   help="jax transport: controller process count")
+    p.add_argument("--devices-per-process", type=int, default=1)
+    p.add_argument("--trainer", default="distributed",
+                   choices=["distributed", "horovod"])
+    p.add_argument("--master-port", type=int, default=29533)
+    p.add_argument("--coordinator-port", type=int, default=29601)
+    p.add_argument("--timeout", type=float, default=600)
+    p.add_argument(
+        "--backend", choices=["cpu", "native"], default="cpu",
+        help="cpu: virtual-device ranks; native: ambient accelerator",
+    )
+    p.add_argument("cli", nargs=argparse.REMAINDER,
+                   help="main.py flags after --")
+
     args = parser.parse_args(argv)
+
+    if args.task == "run-world":
+        return _run_world(args)
 
     if args.task == "preflight":
         for ident in bench.preflight(args.world_size):
@@ -90,6 +121,8 @@ def main(argv=None):
         run = bench.DEBUG_RUN
     elif args.task == "run-all":
         run = bench.BENCHMARK_RUN
+    elif args.task == "run-slots":
+        run = bench.SLOTS_RUN
     elif args.task == "run-network-test":
         executed = bench.run_network_test(
             args.results,
@@ -108,6 +141,37 @@ def main(argv=None):
         configs, args.results, timeout=args.timeout
     )
     return _report(executed, args.results)
+
+
+def _run_world(args) -> int:
+    """One N-process world; every rank's stderr is forwarded to ours so the
+    sweep's stderr capture (and the notebooks' rank-0 perf-line regex)
+    keeps working through the extra process layer."""
+    cli = [a for a in args.cli if a != "--"]
+    if args.transport == "native":
+        from pytorch_distributed_rnn_tpu.training.native_ddp import (
+            launch_world,
+        )
+
+        results = launch_world(
+            args.world_size, cli, master_port=args.master_port,
+            timeout=args.timeout, backend=args.backend,
+        )
+    else:
+        results = bench.launch_jax_world(
+            args.num_processes, cli,
+            devices_per_process=args.devices_per_process,
+            trainer=args.trainer,
+            coordinator_port=args.coordinator_port,
+            timeout=args.timeout, backend=args.backend,
+        )
+    for rank, (rc, out, err) in enumerate(results):
+        if out:
+            sys.stdout.write(out)
+        if err:
+            sys.stderr.write(err)
+    print(f"world of {len(results)} rank(s) completed")
+    return 0
 
 
 def _report(executed, results_path) -> int:

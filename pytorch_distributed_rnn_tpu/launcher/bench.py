@@ -37,10 +37,28 @@ from pytorch_distributed_rnn_tpu.launcher.commands import (
 # reference's host counts {1,2,4,8,12}; 8 is the canonical TPU-slice/virtual
 # CPU mesh size here.
 BENCHMARK_RUN = {
-    "trainers": ["local", "distributed", "horovod"],
+    "trainers": ["local", "distributed", "horovod", "distributed-native"],
     "devices": [1, 2, 4, 8],
     "slots": [1],
     "batch_sizes": [480, 960, 1440],
+    "parameters": {
+        "epochs": 1,
+        "seed": 123456789,
+        "learning-rate": 0.0025,
+        "no-validation": True,
+        "log": "INFO",
+    },
+}
+
+# Real multi-slot topologies (the reference's processes-per-host dimension,
+# slots 1/2/4 in its results data): `slots` OS processes per run -
+# `distributed` rendezvouses them into one jax.distributed world,
+# `distributed-native` runs process-per-rank over the TCP collectives.
+SLOTS_RUN = {
+    "trainers": ["distributed", "distributed-native"],
+    "devices": [1, 2, 4],
+    "slots": [2],
+    "batch_sizes": [1440],
     "parameters": {
         "epochs": 1,
         "seed": 123456789,
@@ -233,6 +251,53 @@ def run_network_test(
         configs, results_path, shuffle_seed=None, timeout=timeout,
         executor=executor, log=log,
     )
+
+
+def launch_jax_world(
+    num_processes: int,
+    cli_args,
+    *,
+    devices_per_process: int = 1,
+    trainer: str = "distributed",
+    coordinator_port: int = 29601,
+    timeout: float = 600.0,
+    cwd=None,
+    backend: str = "cpu",
+):
+    """Stand up a ``num_processes``-process multi-controller JAX world.
+
+    Each process runs ``python -m pytorch_distributed_rnn_tpu.main
+    <cli_args> <trainer>`` with ``PDRNN_COORDINATOR`` set, so they
+    rendezvous through ``jax.distributed`` into ONE global mesh of
+    ``num_processes * devices_per_process`` devices - the mpirun-world
+    analogue over DCN instead of MPI (``/root/reference/fabfile.py:
+    216-223``).  ``backend="cpu"`` gives each rank a virtual CPU platform;
+    ``"native"`` keeps the ambient (accelerator) platform.  Returns
+    per-rank ``(returncode, stdout, stderr)`` in rank order; raises if any
+    rank fails or times out."""
+    from pytorch_distributed_rnn_tpu.utils.worlds import spawn_world
+
+    repo_root = str(Path(__file__).resolve().parents[2])
+    rank_cmds = []
+    for pid in range(num_processes):
+        env = dict(os.environ)
+        env.update(
+            PDRNN_COORDINATOR=f"127.0.0.1:{coordinator_port}",
+            PDRNN_NUM_PROCESSES=str(num_processes),
+            PDRNN_PROCESS_ID=str(pid),
+        )
+        if backend == "cpu":
+            env["PDRNN_PLATFORM"] = "cpu"
+            env["PDRNN_NUM_CPU_DEVICES"] = str(devices_per_process)
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in (repo_root, env.get("PYTHONPATH")) if p
+        )
+        rank_cmds.append((
+            [sys.executable, "-m", "pytorch_distributed_rnn_tpu.main",
+             *map(str, cli_args), trainer],
+            env,
+        ))
+    return spawn_world(rank_cmds, timeout=timeout, cwd=cwd)
 
 
 def preflight(world_size: int = 2, master_port: int = 29531) -> list:

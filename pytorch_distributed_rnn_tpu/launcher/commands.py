@@ -65,26 +65,51 @@ def get_command(config: RunConfig, python: str | None = None):
     device count, fault injection) — the caller merges it over ``os.environ``.
     """
     python = python or sys.executable
-    argv = [python, "-m", "pytorch_distributed_rnn_tpu.main"]
 
+    flag_argv = []
     for flag, value in config.parameters:
         if value is True:
-            argv.append(f"--{flag}")
+            flag_argv.append(f"--{flag}")
         elif value is False or value is None:
             continue
         else:
-            argv.extend([f"--{flag}", str(value)])
+            flag_argv.extend([f"--{flag}", str(value)])
 
     env: dict[str, str] = {}
     world = config.world_size
 
-    if config.trainer in ("local", "distributed", "horovod"):
-        argv.append(config.trainer)
+    if config.trainer in ("distributed", "horovod") and config.slots > 1:
+        # REAL multi-slot topology (the reference's processes-per-host,
+        # fabfile.py:51,203-206): `slots` OS processes rendezvous through a
+        # jax.distributed coordinator into ONE multi-controller world, each
+        # contributing `devices` chips to the global mesh
+        argv = [
+            python, "-m", "pytorch_distributed_rnn_tpu.launcher",
+            "run-world", "--transport", "jax",
+            "--num-processes", str(config.slots),
+            "--devices-per-process", str(config.devices),
+            "--trainer", config.trainer,
+            "--backend", config.backend, "--", *flag_argv,
+        ]
+    elif config.trainer in ("local", "distributed", "horovod"):
+        argv = [python, "-m", "pytorch_distributed_rnn_tpu.main",
+                *flag_argv, config.trainer]
         if config.trainer != "local" and config.backend == "cpu":
             env["PDRNN_PLATFORM"] = "cpu"
             env["PDRNN_NUM_CPU_DEVICES"] = str(world)
+    elif config.trainer == "distributed-native":
+        # process-per-rank DDP over the native TCP collectives (the
+        # mpirun analogue): world = devices x slots OS processes
+        argv = [
+            python, "-m", "pytorch_distributed_rnn_tpu.launcher",
+            "run-world", "--transport", "native",
+            "--world-size", str(world),
+            "--backend", config.backend, "--", *flag_argv,
+        ]
     elif config.trainer == "parameter-server":
-        argv.extend(["parameter-server", "--world-size", str(world + 1)])
+        argv = [python, "-m", "pytorch_distributed_rnn_tpu.main",
+                *flag_argv, "parameter-server", "--world-size",
+                str(world + 1)]
         if config.backend == "cpu":
             env["PDRNN_PLATFORM"] = "cpu"
     else:

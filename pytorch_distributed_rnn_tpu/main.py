@@ -8,13 +8,12 @@ Subcommands: ``local``, ``distributed``, ``horovod``,
 Consciously fixed vs the reference (see PARITY.md): ``--validation-fraction``
 is actually forwarded to the dataset split (the reference parses it but the
 processor default silently governs); ``--seed`` seeds model init and the
-sampler (there is no global mutable RNG in JAX to seed).  New flags:
+sampler (there is no global mutable RNG in JAX to seed); ``--dropout`` is
+REAL train-mode inter-layer dropout threaded through the models (the
+reference parsed it but never used it, ``main.py:26``).  New flags:
 ``--cell {lstm,gru}`` and ``--resume PATH`` (checkpoint resume; reference
-checkpoints were write-only).  ``--num-threads`` and ``--dropout`` are
-accepted for CLI compatibility; ``--dropout`` is threaded to the model stack
-only when non-zero training dropout is requested via ``--cell`` models that
-support it (the reference parsed both but used neither,
-``main.py:26``/``trainer/__init__.py:44-52``).
+checkpoints were write-only).  ``--num-threads`` is accepted for CLI
+compatibility only.
 
 Run:
   python -m pytorch_distributed_rnn_tpu.main --epochs 2 --seed 123456789 local

@@ -40,9 +40,19 @@ from pytorch_distributed_rnn_tpu.parallel.multihost import (
     initialize_multihost,
     process_info,
 )
+from pytorch_distributed_rnn_tpu.parallel.strategy import (
+    make_char_mesh_train_step,
+    make_motion_mesh_loss_fn,
+    parse_mesh_spec,
+    validate_rnn_mesh,
+)
 
 __all__ = [
     "make_mesh",
+    "make_char_mesh_train_step",
+    "make_motion_mesh_loss_fn",
+    "parse_mesh_spec",
+    "validate_rnn_mesh",
     "batch_sharding",
     "replicated_sharding",
     "allgather_tree",

@@ -1,0 +1,339 @@
+"""Mesh strategies: TP/SP/PP as first-class *training* strategies.
+
+The reference's key inversion is "strategy = CLI subcommand mapped onto one
+shared loop" (``/root/reference/src/motion/trainer/__init__.py:10-18``);
+its only axis is data parallelism.  Round 1 shipped tensor/sequence/
+pipeline parallelism as forward-only library factories (``parallel/
+{tp,sp,pp}.py``); this module promotes them to trainable strategies behind
+a mesh spec like ``dp=2,sp=4``:
+
+- the *loss body* here runs INSIDE the data-parallel ``shard_map`` programs
+  built by ``parallel/dp.py`` (the trainers' epoch/run factories), where
+  every mesh axis name is bound - so the same factories, batch plumbing,
+  and checkpointing drive any composed mesh, and ``jax.grad`` transposes
+  the sp/tp/pp collectives into the exact backward exchanges
+  (ppermute -> reverse hop, psum -> broadcast, ...);
+- batch rows shard over ``dp`` exactly as before; ``sp`` shards the time
+  axis (wavefront relay), ``tp`` shards LSTM gates + head rows
+  (Megatron-style), ``pp`` stages the layer stack (GPipe schedule).
+
+Supported RNN meshes: ``dp`` composed with AT MOST one of ``sp``/``tp``/
+``pp`` (the LSTM cell kernels do not compose sp x tp in one program; the
+attention family covers the full dp x sp x tp composition via
+``parallel/combined.py``).  Cells: LSTM (the sp/tp/pp kernels are
+LSTM-specific).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from pytorch_distributed_rnn_tpu.ops.losses import cross_entropy_loss
+from pytorch_distributed_rnn_tpu.parallel.collectives import broadcast_from
+from pytorch_distributed_rnn_tpu.parallel.pp import pp_stacked_lstm
+from pytorch_distributed_rnn_tpu.parallel.sp import (
+    sp_stacked_lstm,
+    sp_stacked_lstm_wavefront,
+)
+from pytorch_distributed_rnn_tpu.parallel.tp import (
+    row_parallel_head,
+    tp_stacked_lstm,
+)
+
+MODEL_AXES = ("sp", "tp", "pp")
+
+
+def parse_mesh_spec(spec: str) -> dict[str, int]:
+    """``"dp=2,sp=4"`` -> ``{"dp": 2, "sp": 4}``.  Axis names are
+    validated; sizes are ints (-1 = all remaining devices, as in
+    :func:`~pytorch_distributed_rnn_tpu.parallel.mesh.make_mesh`)."""
+    axes: dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"bad mesh axis {part!r} (want name=size)")
+        name, _, size = part.partition("=")
+        name = name.strip()
+        if name in axes:
+            raise ValueError(f"duplicate mesh axis {name!r}")
+        if name not in ("dp",) + MODEL_AXES:
+            raise ValueError(
+                f"unknown mesh axis {name!r} (known: dp, sp, tp, pp)"
+            )
+        axes[name] = int(size)
+    if not axes:
+        raise ValueError(f"empty mesh spec {spec!r}")
+    return axes
+
+
+def validate_rnn_mesh(axes: dict[str, int], cell: str = "lstm"):
+    """Reject mesh specs the RNN kernels cannot run."""
+    model_axes = [a for a in MODEL_AXES if axes.get(a, 1) > 1]
+    if len(model_axes) > 1:
+        raise ValueError(
+            f"RNN meshes support dp plus at most ONE of sp/tp/pp, got "
+            f"{model_axes} (the attention family composes dp x sp x tp, "
+            f"see parallel/combined.py)"
+        )
+    if model_axes and cell != "lstm":
+        raise ValueError(
+            f"sp/tp/pp RNN kernels are LSTM-specific, got cell={cell!r}"
+        )
+    return model_axes[0] if model_axes else None
+
+
+def mesh_rnn_forward(params, x, *, sp=None, tp=None, pp=None,
+                     schedule: str = "wavefront", num_microbatches: int = 4,
+                     unroll: int = 1):
+    """Motion-model forward (stacked LSTM -> last-step head) for use INSIDE
+    a ``shard_map`` program where the named axes are bound.
+
+    ``x`` (B_local, T, in) arrives dp-local and replicated over the model
+    axes; logits (B_local, out) return replicated over the model axes (so
+    the caller's dp-only loss/metric collectives stay correct).
+    """
+    if sum(a is not None for a in (sp, tp, pp)) > 1:
+        raise ValueError("compose dp with at most one of sp/tp/pp")
+
+    if sp is not None:
+        n = lax.axis_size(sp)
+        k = lax.axis_index(sp)
+        t = x.shape[1]
+        if t % n != 0:
+            raise ValueError(f"seq len {t} not divisible by sp={n}")
+        t_local = t // n
+        x_loc = lax.dynamic_slice_in_dim(x, k * t_local, t_local, axis=1)
+        stack = (
+            sp_stacked_lstm_wavefront if schedule == "wavefront"
+            else sp_stacked_lstm
+        )
+        out_local, _ = stack(params["rnn"], x_loc, sp, unroll=unroll)
+        last = out_local[:, -1, :]  # true last step on shard n-1 only
+        logits = last @ params["fc"]["weight"].T + params["fc"]["bias"]
+        return broadcast_from(logits, sp, n - 1)
+
+    if tp is not None:
+        out, _ = tp_stacked_lstm(params["rnn"], x, tp, unroll=unroll)
+        return row_parallel_head(params["fc"], out[:, -1, :], tp)
+
+    if pp is not None:
+        out = pp_stacked_lstm(
+            params["rnn"], x, pp, num_microbatches=num_microbatches,
+            unroll=unroll,
+        )
+        last = out[:, -1, :]
+        return last @ params["fc"]["weight"].T + params["fc"]["bias"]
+
+    from pytorch_distributed_rnn_tpu.ops.rnn import stacked_rnn
+
+    out, _ = stacked_rnn(params["rnn"], x, "lstm", unroll=unroll,
+                         impl="scan")
+    return out[:, -1, :] @ params["fc"]["weight"].T + params["fc"]["bias"]
+
+
+# ---------------------------------------------------------------------------
+# Char-LM mesh training step (per-timestep head; the long-context story)
+# ---------------------------------------------------------------------------
+
+def char_mesh_loss(params, tokens, *, sp=None, tp=None, pp=None,
+                   schedule: str = "wavefront", num_microbatches: int = 4,
+                   unroll: int = 1, dp: str = "dp"):
+    """Next-token loss for a CharRNN params tree inside a mesh program.
+
+    ``tokens`` (B_local, T) int32, replicated over the model axes.  With
+    ``sp``, the time axis is sharded: each shard embeds + runs its chunk
+    through the relay stack, computes logits for its positions, and scores
+    them against the (replicated) next tokens; the weighted psum over sp
+    reassembles exactly the global mean over the T-1 predicted positions.
+    """
+    if sum(a is not None for a in (sp, tp, pp)) > 1:
+        raise ValueError("compose dp with at most one of sp/tp/pp")
+    head_w, head_b = params["head"]["weight"], params["head"]["bias"]
+    t = tokens.shape[1]
+
+    if sp is not None:
+        n = lax.axis_size(sp)
+        k = lax.axis_index(sp)
+        if t % n != 0:
+            raise ValueError(f"seq len {t} not divisible by sp={n}")
+        t_local = t // n
+        tok_loc = lax.dynamic_slice_in_dim(tokens, k * t_local, t_local,
+                                           axis=1)
+        x_loc = params["embed"][tok_loc]
+        stack = (
+            sp_stacked_lstm_wavefront if schedule == "wavefront"
+            else sp_stacked_lstm
+        )
+        out_local, _ = stack(params["rnn"], x_loc, sp, unroll=unroll)
+        logits = out_local @ head_w.T + head_b  # (B, t_local, V)
+        # targets: global position p predicts token p+1; the final global
+        # position is padding (weight 0).  tokens are replicated, so the
+        # shifted slice is local arithmetic - no boundary exchange needed.
+        shifted = jnp.concatenate(
+            [tokens[:, 1:], tokens[:, -1:]], axis=1
+        )
+        tgt_loc = lax.dynamic_slice_in_dim(shifted, k * t_local, t_local,
+                                           axis=1)
+        pos = k * t_local + jnp.arange(t_local)
+        w = (pos < t - 1).astype(jnp.float32)[None, :]  # (1, t_local)
+        nll = cross_entropy_loss(
+            logits.reshape(-1, head_w.shape[0]),
+            tgt_loc.reshape(-1),
+            reduction="none",
+        ).reshape(tgt_loc.shape)
+        local_sum = jnp.sum(nll * w)
+        loss = lax.psum(local_sum, sp) / (tokens.shape[0] * (t - 1))
+        return lax.pmean(loss, dp)
+
+    x = params["embed"][tokens[:, :-1]]
+    if tp is not None:
+        out, _ = tp_stacked_lstm(params["rnn"], x, tp, unroll=unroll)
+        # row-parallel per-timestep head: shard the hidden dim, one psum
+        ntp = lax.axis_size(tp)
+        ktp = lax.axis_index(tp)
+        hidden = head_w.shape[1]
+        if hidden % ntp != 0:
+            raise ValueError(f"hidden {hidden} not divisible by tp={ntp}")
+        per = hidden // ntp
+        w_local = lax.dynamic_slice_in_dim(head_w, ktp * per, per, axis=1)
+        h_local = lax.dynamic_slice_in_dim(out, ktp * per, per, axis=2)
+        logits = lax.psum(
+            jnp.einsum("bth,vh->btv", h_local, w_local), tp
+        ) + head_b
+    elif pp is not None:
+        out = pp_stacked_lstm(
+            params["rnn"], x, pp, num_microbatches=num_microbatches,
+            unroll=unroll,
+        )
+        logits = out @ head_w.T + head_b
+    else:
+        from pytorch_distributed_rnn_tpu.ops.rnn import stacked_rnn
+
+        out, _ = stacked_rnn(params["rnn"], x, "lstm", unroll=unroll,
+                             impl="scan")
+        logits = out @ head_w.T + head_b
+
+    targets = tokens[:, 1:]
+    loss = cross_entropy_loss(
+        logits.reshape(-1, head_w.shape[0]), targets.reshape(-1)
+    )
+    return lax.pmean(loss, dp)
+
+
+def _axis_kwargs(axes: dict[str, int]):
+    """{"sp": "sp" or None, ...} for the single active model axis."""
+    model_axis = validate_rnn_mesh(axes)
+    return {a: (a if a == model_axis else None) for a in MODEL_AXES}
+
+
+def make_char_mesh_train_step(optimizer, mesh, axes: dict[str, int], *,
+                              schedule: str = "wavefront",
+                              num_microbatches: int = 4, unroll: int = 1,
+                              donate: bool = True):
+    """Jitted char-LM training step over a composed mesh.
+
+    ``step(params, opt_state, tokens)`` with ``tokens`` (B, T) sharded
+    ``P("dp")`` on batch; params/opt replicated.  The model axis (sp, tp,
+    or pp - at most one) comes from ``axes``.
+
+    The gradient is taken OUTSIDE the ``shard_map`` (like
+    ``parallel/combined.py``): differentiating the replicated-scalar loss
+    lets jax insert exactly the right backward collectives and the psums
+    that re-reduce replicated-parameter cotangents - taking grad inside
+    would double-count replicated pieces and drop cross-shard terms.
+    """
+    kw = _axis_kwargs(axes)
+
+    from functools import partial as _partial
+
+    @_partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P("dp")),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def loss_fn(params, tokens):
+        return char_mesh_loss(
+            params, tokens, schedule=schedule,
+            num_microbatches=num_microbatches, unroll=unroll, **kw,
+        )
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+
+# ---------------------------------------------------------------------------
+# Motion-model mesh factories (drive the shared Trainer loop)
+# ---------------------------------------------------------------------------
+
+def make_motion_mesh_loss_fn(mesh, axes: dict[str, int], *,
+                             schedule: str = "wavefront",
+                             num_microbatches: int = 4, unroll: int = 1,
+                             weighted: bool = False):
+    """Shard_mapped ``loss_fn(params, x, y[, w]) -> (loss, metrics)`` for
+    the motion model over a composed mesh: ``x``/``y`` (and ``w``) shard
+    their batch dim over ``dp``; the scalar loss and summed metrics come
+    back replicated.  Grad is meant to be taken OUTSIDE (see
+    :func:`make_char_mesh_train_step` for why)."""
+    kw = _axis_kwargs(axes)
+
+    from functools import partial as _partial
+
+    batch_specs = (P("dp"), P("dp")) + ((P("dp"),) if weighted else ())
+
+    @_partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(),) + batch_specs,
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    def loss_fn(params, x, y, *w):
+        logits = mesh_rnn_forward(
+            params, x, schedule=schedule,
+            num_microbatches=num_microbatches, unroll=unroll, **kw,
+        )
+        if weighted:
+            nll = cross_entropy_loss(logits, y, reduction="none")
+            local = jnp.sum(nll * w[0]) / jnp.maximum(jnp.sum(w[0]), 1.0)
+            correct = jnp.sum(
+                (jnp.argmax(logits, axis=1) == y) * (w[0] > 0)
+            )
+        else:
+            local = cross_entropy_loss(logits, y)
+            correct = jnp.sum(jnp.argmax(logits, axis=1) == y)
+        return (
+            lax.pmean(local, "dp"),
+            {"correct": lax.psum(correct, "dp")},
+        )
+
+    return loss_fn
+
+
+def make_mesh_grad_step(loss_fn, optimizer, *, weighted: bool = False):
+    """``step(params, opt_state, batch[, w]) -> (params, opt_state, loss,
+    metrics)`` with grad outside the shard_mapped ``loss_fn``."""
+
+    def step(params, opt_state, batch, *extra):
+        x, y = batch
+        (loss, metrics), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params, x, y, *extra)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, metrics
+
+    return step

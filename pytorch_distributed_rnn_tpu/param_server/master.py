@@ -35,13 +35,18 @@ log = logging.getLogger(__name__)
 
 
 class ParameterServerMaster:
-    def __init__(self, comm, flat_params: np.ndarray, apply_update, sync_mode=False):
+    def __init__(self, comm, flat_params: np.ndarray, apply_update,
+                 sync_mode=False, sync_timeout: float = 300.0):
         """``apply_update(flat_grads) -> flat_params`` advances the owned
-        state by one optimizer step and returns the new flat params."""
+        state by one optimizer step and returns the new flat params.
+        ``sync_timeout`` bounds how long a sync-mode round waits for
+        stragglers before erroring (the reference's RPC timeout analogue,
+        ``/root/reference/src/motion/param_server/master.py:56``)."""
         self.comm = comm
         self.params = flat_params.astype(np.float32)
         self.apply_update = apply_update
         self.sync_mode = sync_mode
+        self.sync_timeout = float(sync_timeout)
         self.lock = threading.Lock()
         self.num_params = int(flat_params.size)
         self.updates_applied = 0
@@ -127,6 +132,16 @@ class ParameterServerMaster:
             else:
                 self._waiting.add(worker)
                 generation = self.updates_applied
-                self._sync_cv.wait_for(
-                    lambda: self.updates_applied > generation, timeout=300
+                completed = self._sync_cv.wait_for(
+                    lambda: self.updates_applied > generation,
+                    timeout=self.sync_timeout,
                 )
+                if not completed:
+                    # a straggler never delivered: fail loudly instead of
+                    # silently proceeding with stale parameters
+                    raise RuntimeError(
+                        f"sync-mode round timed out after "
+                        f"{self.sync_timeout}s waiting on "
+                        f"{num_workers - len(self._pending)} missing "
+                        f"gradient(s) (worker {worker} was waiting)"
+                    )

@@ -137,11 +137,7 @@ bool resolve(const char* host, sockaddr_in* out) {
   return true;
 }
 
-int dial(const char* host, uint16_t port) {
-  sockaddr_in addr{};
-  addr.sin_family = AF_INET;
-  addr.sin_port = htons(port);
-  if (!resolve(host, &addr)) return -1;
+int dial_addr(sockaddr_in addr) {
   for (int attempt = 0; attempt < kMaxRetries; ++attempt) {
     int fd = socket(AF_INET, SOCK_STREAM, 0);
     if (fd < 0) return -1;
@@ -154,6 +150,71 @@ int dial(const char* host, uint16_t port) {
   }
   return -1;
 }
+
+int dial(const char* host, uint16_t port) {
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  if (!resolve(host, &addr)) return -1;
+  return dial_addr(addr);
+}
+
+int dial_ip(uint32_t addr_be, uint16_t port) {
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  addr.sin_addr.s_addr = addr_be;
+  return dial_addr(addr);
+}
+
+// -- element types for the dtype-generic ring allreduce ----------------------
+
+inline float bf16_to_f32(uint16_t v) {
+  uint32_t u = static_cast<uint32_t>(v) << 16;
+  float f;
+  std::memcpy(&f, &u, 4);
+  return f;
+}
+
+inline uint16_t f32_to_bf16(float f) {
+  uint32_t u;
+  std::memcpy(&u, &f, 4);
+  u += 0x7FFFu + ((u >> 16) & 1u);  // round to nearest even
+  return static_cast<uint16_t>(u >> 16);
+}
+
+template <typename T>
+struct Elem {
+  static void accumulate(T* dst, const T* src, int64_t n) {
+    for (int64_t i = 0; i < n; ++i) dst[i] += src[i];
+  }
+  static void scale(T* dst, int64_t n, double s) {
+    for (int64_t i = 0; i < n; ++i)
+      dst[i] = static_cast<T>(dst[i] * s);
+  }
+};
+
+// bf16 rides the wire at 2 bytes/element (half the gradient traffic of
+// f32 - the point of --precision bf16 over a slow link); each hop's
+// accumulate runs in f32 and rounds back, the same per-hop rounding a
+// bf16 ring in Horovod/NCCL performs.
+struct Bf16 {
+  uint16_t bits;
+};
+
+template <>
+struct Elem<Bf16> {
+  static void accumulate(Bf16* dst, const Bf16* src, int64_t n) {
+    for (int64_t i = 0; i < n; ++i)
+      dst[i].bits =
+          f32_to_bf16(bf16_to_f32(dst[i].bits) + bf16_to_f32(src[i].bits));
+  }
+  static void scale(Bf16* dst, int64_t n, double s) {
+    for (int64_t i = 0; i < n; ++i)
+      dst[i].bits = f32_to_bf16(
+          static_cast<float>(bf16_to_f32(dst[i].bits) * s));
+  }
+};
 
 }  // namespace
 
@@ -177,10 +238,18 @@ Comm* pdrnn_init(const char* master_addr, int master_port, int rank,
       pdrnn_destroy(c);
       return nullptr;
     }
-    // collect every worker's (rank, listen_port)
+    // collect every worker's (rank, listen_port); the worker's address is
+    // read off the accepted connection (getpeername), so the table works
+    // across hosts - a worker need not know its own externally-visible
+    // address (the reference's mpirun host file plays this role,
+    // fabfile.py:218-223)
     std::vector<uint16_t> ports(world, 0);
+    std::vector<uint32_t> addrs(world, 0);  // network byte order
     for (int i = 1; i < world; ++i) {
-      int fd = accept(c->listen_fd, nullptr, nullptr);
+      sockaddr_in peer_sa{};
+      socklen_t sa_len = sizeof(peer_sa);
+      int fd = accept(c->listen_fd,
+                      reinterpret_cast<sockaddr*>(&peer_sa), &sa_len);
       if (fd < 0) {
         pdrnn_destroy(c);
         return nullptr;
@@ -194,10 +263,12 @@ Comm* pdrnn_init(const char* master_addr, int master_port, int rank,
       }
       c->peer_fd[peer_rank] = fd;
       ports[peer_rank] = peer_port;
+      addrs[peer_rank] = peer_sa.sin_addr.s_addr;
     }
-    // share the port table with everyone
+    // share the port + address tables with everyone
     for (int r = 1; r < world; ++r)
-      if (!send_all(c, c->peer_fd[r], ports.data(), ports.size() * 2)) {
+      if (!send_all(c, c->peer_fd[r], ports.data(), ports.size() * 2) ||
+          !send_all(c, c->peer_fd[r], addrs.data(), addrs.size() * 4)) {
         pdrnn_destroy(c);
         return nullptr;
       }
@@ -221,15 +292,16 @@ Comm* pdrnn_init(const char* master_addr, int master_port, int rank,
     }
     c->peer_fd[0] = fd;
     std::vector<uint16_t> ports(world, 0);
-    if (!recv_all(fd, ports.data(), ports.size() * 2)) {
+    std::vector<uint32_t> addrs(world, 0);
+    if (!recv_all(fd, ports.data(), ports.size() * 2) ||
+        !recv_all(fd, addrs.data(), addrs.size() * 4)) {
       pdrnn_destroy(c);
       return nullptr;
     }
-    // full mesh among workers: lower rank dials higher rank's listener.
-    // NOTE: workers all share master_addr here (single-host layout); for
-    // true multi-host the port table would carry addresses too.
+    // full mesh among workers: lower rank dials higher rank's listener at
+    // the address rank 0 observed for it - spans hosts
     for (int r = 1; r < rank; ++r) {
-      int pfd = dial(master_addr, ports[r]);
+      int pfd = dial_ip(addrs[r], ports[r]);
       if (pfd < 0) {
         pdrnn_destroy(c);
         return nullptr;
@@ -288,9 +360,14 @@ int pdrnn_broadcast(Comm* c, int root, void* data, int64_t nbytes) {
   return pdrnn_recv(c, root, data, nbytes);
 }
 
-// Ring allreduce over float32: reduce-scatter then allgather.
-// op: 0 = sum, 1 = mean.
-int pdrnn_allreduce_f32(Comm* c, float* data, int64_t count, int op) {
+}  // extern "C"
+
+namespace {
+
+// Ring allreduce (reduce-scatter then allgather), generic over the wire
+// element type.  op: 0 = sum, 1 = mean.
+template <typename T>
+int ring_allreduce(Comm* c, T* data, int64_t count, int op) {
   const int world = c->world;
   if (world == 1) return 0;
   const int next = (c->rank + 1) % world;
@@ -304,7 +381,7 @@ int pdrnn_allreduce_f32(Comm* c, float* data, int64_t count, int op) {
     begin[i + 1] = begin[i] + base + (i < rem ? 1 : 0);
   auto chunk_len = [&](int i) { return begin[i + 1] - begin[i]; };
 
-  std::vector<float> inbox(base + 1);
+  std::vector<T> inbox(static_cast<size_t>(base + 1));
 
   // reduce-scatter: after step s, rank r owns the fully-reduced chunk
   // (r+1) mod world ... progressing so rank r ends owning chunk (r+1).
@@ -314,15 +391,14 @@ int pdrnn_allreduce_f32(Comm* c, float* data, int64_t count, int op) {
     bool ok_send = false;
     std::thread sender([&] {
       ok_send = send_all(c, c->peer_fd[next], data + begin[send_idx],
-                         chunk_len(send_idx) * sizeof(float));
+                         chunk_len(send_idx) * sizeof(T));
     });
     bool ok_recv = recv_all(c->peer_fd[prev], inbox.data(),
-                            chunk_len(recv_idx) * sizeof(float));
+                            chunk_len(recv_idx) * sizeof(T));
     sender.join();
     if (!ok_send || !ok_recv) return -1;
-    float* dst = data + begin[recv_idx];
-    const int64_t n = chunk_len(recv_idx);
-    for (int64_t i = 0; i < n; ++i) dst[i] += inbox[i];
+    Elem<T>::accumulate(data + begin[recv_idx], inbox.data(),
+                        chunk_len(recv_idx));
   }
 
   // allgather: circulate the reduced chunks
@@ -332,19 +408,38 @@ int pdrnn_allreduce_f32(Comm* c, float* data, int64_t count, int op) {
     bool ok_send = false;
     std::thread sender([&] {
       ok_send = send_all(c, c->peer_fd[next], data + begin[send_idx],
-                         chunk_len(send_idx) * sizeof(float));
+                         chunk_len(send_idx) * sizeof(T));
     });
     bool ok_recv = recv_all(c->peer_fd[prev], data + begin[recv_idx],
-                            chunk_len(recv_idx) * sizeof(float));
+                            chunk_len(recv_idx) * sizeof(T));
     sender.join();
     if (!ok_send || !ok_recv) return -1;
   }
 
-  if (op == 1) {
-    const float inv = 1.0f / static_cast<float>(world);
-    for (int64_t i = 0; i < count; ++i) data[i] *= inv;
-  }
+  if (op == 1) Elem<T>::scale(data, count, 1.0 / world);
   return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// dtype: 0 = f32, 1 = f64, 2 = bf16 (raw uint16 bits).
+int pdrnn_allreduce(Comm* c, void* data, int64_t count, int dtype, int op) {
+  switch (dtype) {
+    case 0:
+      return ring_allreduce(c, static_cast<float*>(data), count, op);
+    case 1:
+      return ring_allreduce(c, static_cast<double*>(data), count, op);
+    case 2:
+      return ring_allreduce(c, static_cast<Bf16*>(data), count, op);
+  }
+  return -1;
+}
+
+// kept for ABI stability with existing callers
+int pdrnn_allreduce_f32(Comm* c, float* data, int64_t count, int op) {
+  return pdrnn_allreduce(c, data, count, 0, op);
 }
 
 int pdrnn_allgather(Comm* c, const void* input, int64_t nbytes, void* output) {

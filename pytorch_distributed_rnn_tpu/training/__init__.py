@@ -18,12 +18,14 @@ from pytorch_distributed_rnn_tpu.training.distributed import (
     HorovodTrainer,
     SpmdTrainer,
 )
+from pytorch_distributed_rnn_tpu.training.mesh import MeshTrainer
 
 __all__ = [
     "Trainer",
     "SpmdTrainer",
     "DDPTrainer",
     "HorovodTrainer",
+    "MeshTrainer",
     "add_sub_commands",
     "train",
 ]
@@ -48,6 +50,31 @@ def add_sub_commands(sub_parser):
         return execute(args)
 
     native.set_defaults(func=_native)
+
+    # composed-mesh strategy: dp plus one of sp/tp/pp on the same shared
+    # loop (new capability; the reference's only axis is DP - SURVEY §2
+    # parallelism checklist)
+    mesh_p = sub_parser.add_parser("mesh")
+    mesh_p.add_argument(
+        "--mesh", default="dp=-1", metavar="SPEC",
+        help="mesh axes, e.g. dp=2,sp=4 (sp: time-sharded wavefront LSTM; "
+        "tp: Megatron gate/head sharding; pp: GPipe stages; -1 = all "
+        "remaining devices)",
+    )
+    mesh_p.add_argument(
+        "--sp-schedule", choices=["wavefront", "sequential"],
+        default="wavefront",
+    )
+    mesh_p.add_argument("--num-microbatches", type=int, default=4)
+
+    def _mesh(args):
+        from pytorch_distributed_rnn_tpu.training.mesh import (
+            mesh_trainer_factory,
+        )
+
+        return train(args, mesh_trainer_factory(args))
+
+    mesh_p.set_defaults(func=_mesh)
 
 
 def train(args, trainer_class):
@@ -120,6 +147,9 @@ def train(args, trainer_class):
         "train_history": train_history,
         "validation_history": validation_history,
     }
-    with open("history.json", "w") as file:
-        json.dump(history, file)
+    import jax
+
+    if jax.process_index() == 0:  # rank-0-only output in a world
+        with open("history.json", "w") as file:
+            json.dump(history, file)
     return trainer

@@ -22,6 +22,7 @@ the reference's rank-0-only evaluation (``distributed.py:20-22``).
 
 from __future__ import annotations
 
+import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -74,16 +75,27 @@ class SpmdTrainer(Trainer):
             seed=seed,
         )
         self.world_size = world_size
-        self.rank = 0  # single controller reports as rank 0
+        # single controller: one process reports as rank 0.  In a
+        # multi-controller world (PDRNN_COORDINATOR set, mesh spanning
+        # processes) each process tags its logs with its process index and
+        # only process 0 checkpoints / writes history - the reference's
+        # rank-0-only convention (distributed.py:60-62).  Every process
+        # MUST still execute the identical device-program sequence (the
+        # collectives are global), so datasets are not dropped on
+        # non-zero ranks; host-side evaluation is process-local.
+        self.rank = jax.process_index()
 
     def _get_formatter(self, epochs):
         return TrainingMessageFormatter(epochs, self.rank)
 
+    def _save_checkpoint(self, epoch, loss, best=False):
+        if self.rank != 0:
+            return
+        super()._save_checkpoint(epoch, loss, best=best)
+
     def _fold_rank(self, key):
         # independent dropout mask per dp shard (torch DDP has one RNG
         # stream per rank); the grad pmean keeps params identical anyway
-        import jax
-
         return jax.random.fold_in(key, jax.lax.axis_index(self.axis))
 
     def _build_train_step(self):

@@ -170,20 +170,23 @@ def run_rank(comm, args, model, datasets):
 
 
 def launch_world(world_size: int, cli_args, *, master_port: int = 29533,
-                 cwd=None, timeout: float = 600):
+                 cwd=None, timeout: float = 600, backend: str = "cpu"):
     """Spawn a local ``world_size``-process DDP world (the reference's
     docker-compose two-container fake cluster, as plain processes): each
     rank runs ``python -m pytorch_distributed_rnn_tpu.main <cli_args>
-    distributed-native`` with the env rendezvous set.  Returns the list of
-    ``CompletedProcess``-like results in rank order; raises if any rank
-    fails."""
+    distributed-native`` with the env rendezvous set.  ``backend="cpu"``
+    forces each rank onto the CPU platform (the no-hardware path);
+    ``"native"`` leaves the ambient platform (attached accelerator) alone.
+    Returns ``(returncode, stdout, stderr)`` per rank in rank order;
+    raises if any rank fails."""
     import os
-    import subprocess
     import sys
     from pathlib import Path
 
+    from pytorch_distributed_rnn_tpu.utils.worlds import spawn_world
+
     repo_root = str(Path(__file__).resolve().parent.parent.parent)
-    procs = []
+    rank_cmds = []
     for rank in range(world_size):
         env = dict(os.environ)
         env.update(
@@ -191,55 +194,18 @@ def launch_world(world_size: int, cli_args, *, master_port: int = 29533,
             MASTER_PORT=str(master_port),
             RANK=str(rank),
             WORLD_SIZE=str(world_size),
-            PDRNN_PLATFORM="cpu",
         )
+        if backend == "cpu":
+            env["PDRNN_PLATFORM"] = "cpu"
         env["PYTHONPATH"] = os.pathsep.join(
             p for p in (repo_root, env.get("PYTHONPATH")) if p
         )
-        procs.append(
-            subprocess.Popen(
-                [sys.executable, "-m", "pytorch_distributed_rnn_tpu.main",
-                 *map(str, cli_args), "distributed-native"],
-                env=env, cwd=cwd, stdout=subprocess.PIPE,
-                stderr=subprocess.PIPE, text=True,
-            )
-        )
-    # drain every rank's pipes CONCURRENTLY: a rank blocked on a full
-    # stderr pipe stops participating in the collectives and would
-    # deadlock the whole world if ranks were drained one at a time
-    import threading
-
-    results = [None] * world_size
-    errors = [None] * world_size
-
-    def drain(rank, proc):
-        try:
-            out, err = proc.communicate(timeout=timeout)
-            results[rank] = (proc.returncode, out, err)
-        except subprocess.TimeoutExpired as e:
-            errors[rank] = e
-            proc.kill()
-            proc.communicate()
-
-    threads = [
-        threading.Thread(target=drain, args=(rank, proc))
-        for rank, proc in enumerate(procs)
-    ]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    timed_out = [r for r, e in enumerate(errors) if e is not None]
-    if timed_out:
-        raise RuntimeError(f"ranks timed out after {timeout}s: {timed_out}")
-    failed = [
-        (rank, res[2][-2000:])
-        for rank, res in enumerate(results)
-        if res[0] != 0
-    ]
-    if failed:
-        raise RuntimeError(f"ranks failed: {failed}")
-    return results
+        rank_cmds.append((
+            [sys.executable, "-m", "pytorch_distributed_rnn_tpu.main",
+             *map(str, cli_args), "distributed-native"],
+            env,
+        ))
+    return spawn_world(rank_cmds, timeout=timeout, cwd=cwd)
 
 
 def execute(args):

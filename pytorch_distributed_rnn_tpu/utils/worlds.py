@@ -1,0 +1,67 @@
+"""Spawn-and-drain helper for multi-process rank worlds.
+
+One implementation shared by the native-transport DDP launcher
+(``training/native_ddp.py``) and the jax.distributed world launcher
+(``launcher/bench.py``) - the spawn/drain/timeout/failure machinery is
+identical; only each rank's argv/env differ.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+
+
+def spawn_world(rank_cmds, *, timeout: float = 600.0, cwd=None):
+    """Run one process per ``(argv, env)`` in ``rank_cmds``; returns
+    ``[(returncode, stdout, stderr)]`` in rank order.
+
+    Pipes are drained CONCURRENTLY: a rank blocked on a full stderr pipe
+    stops participating in collectives and would deadlock the world if
+    ranks were drained one at a time.  On error, ranks that FAILED are
+    reported before ranks that timed out - a crashed rank is usually the
+    root cause of its peers' hangs, so its stderr is what the operator
+    needs first.
+    """
+    procs = [
+        subprocess.Popen(
+            argv, env=env, cwd=cwd, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True,
+        )
+        for argv, env in rank_cmds
+    ]
+
+    results = [None] * len(procs)
+    errors = [None] * len(procs)
+
+    def drain(rank, proc):
+        try:
+            out, err = proc.communicate(timeout=timeout)
+            results[rank] = (proc.returncode, out, err)
+        except subprocess.TimeoutExpired as e:
+            errors[rank] = e
+            proc.kill()
+            proc.communicate()
+
+    threads = [
+        threading.Thread(target=drain, args=(rank, proc))
+        for rank, proc in enumerate(procs)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    failed = [
+        (rank, res[2][-2000:])
+        for rank, res in enumerate(results)
+        if res is not None and res[0] != 0
+    ]
+    if failed:
+        raise RuntimeError(f"world ranks failed: {failed}")
+    timed_out = [r for r, e in enumerate(errors) if e is not None]
+    if timed_out:
+        raise RuntimeError(
+            f"world ranks timed out after {timeout}s: {timed_out}"
+        )
+    return results

@@ -38,11 +38,39 @@ def test_get_command_local():
 
 
 def test_get_command_distributed_cpu_sim_sets_virtual_devices():
-    config = make_config("distributed", devices=4, slots=2)
+    config = make_config("distributed", devices=4)
     argv, env = get_command(config)
     assert argv[-1] == "distributed"
-    assert env["PDRNN_NUM_CPU_DEVICES"] == "8"  # devices x slots
+    assert env["PDRNN_NUM_CPU_DEVICES"] == "4"
     assert env["PDRNN_PLATFORM"] == "cpu"
+
+
+def test_get_command_multi_slot_is_a_real_process_world():
+    """slots > 1 = real OS processes (the reference's --map-by slot,
+    fabfile.py:203-206), not extra virtual devices in one process."""
+    config = make_config("distributed", devices=4, slots=2)
+    argv, env = get_command(config, python="python")
+    assert "run-world" in argv
+    assert argv[argv.index("--transport") + 1] == "jax"
+    assert argv[argv.index("--num-processes") + 1] == "2"
+    assert argv[argv.index("--devices-per-process") + 1] == "4"
+
+
+def test_get_command_distributed_native_spawns_tcp_world():
+    config = make_config("distributed-native", devices=2, slots=2)
+    argv, _ = get_command(config, python="python")
+    assert "run-world" in argv
+    assert argv[argv.index("--transport") + 1] == "native"
+    assert argv[argv.index("--world-size") + 1] == "4"
+
+
+def test_run_world_commands_forward_backend():
+    """backend=native must survive into the run-world command so a TPU
+    sweep row does not silently measure virtual CPU ranks."""
+    for trainer in ("distributed", "distributed-native"):
+        config = make_config(trainer, devices=2, slots=2, backend="native")
+        argv, _ = get_command(config, python="python")
+        assert argv[argv.index("--backend") + 1] == "native"
 
 
 def test_get_command_native_backend_has_no_platform_override():
@@ -80,9 +108,9 @@ def test_command_string_distinguishes_topology_and_fault():
 
 def test_expand_benchmark_sweep():
     configs = expand_run_configs(BENCHMARK_RUN)
-    # local only at 1 device (3 batch sizes); distributed+horovod at
-    # {1,2,4,8} devices x 3 batch sizes
-    assert len(configs) == 3 + 2 * 4 * 3
+    # local only at 1 device (3 batch sizes); distributed + horovod +
+    # distributed-native at {1,2,4,8} devices x 3 batch sizes
+    assert len(configs) == 3 + 3 * 4 * 3
     assert all(
         c.devices == 1 for c in configs if c.trainer == "local"
     )
@@ -157,6 +185,44 @@ def test_preflight_two_ranks():
     identities = preflight(world_size=2, master_port=29541)
     assert len(identities) == 2
     assert all(":" in ident for ident in identities)
+
+
+@pytest.mark.slow
+def test_end_to_end_jax_world(tmp_path):
+    """A real 2-process jax.distributed world through the launcher: both
+    controller processes train the SPMD program over one global mesh and
+    emit rank-tagged perf lines (rank-0-only history/checkpoints)."""
+    from pytorch_distributed_rnn_tpu.launcher import launch_jax_world
+
+    data_dir = tmp_path / "data"
+    subprocess.run(
+        [sys.executable, "-m", "pytorch_distributed_rnn_tpu.launcher",
+         "prepare-data", "--dataset-path", str(data_dir),
+         "--num-train", "192", "--num-test", "32"],
+        check=True, capture_output=True, text=True,
+    )
+    results = launch_jax_world(
+        2,
+        ["--dataset-path", str(data_dir),
+         "--checkpoint-directory", str(tmp_path / "models"),
+         "--epochs", "1", "--batch-size", "48", "--seed", "123456789",
+         "--no-validation", "--log", "INFO"],
+        devices_per_process=1,
+        coordinator_port=29611,
+        timeout=300,
+        cwd=tmp_path,
+    )
+    assert len(results) == 2
+    import re
+
+    for pid, (rc, out, err) in enumerate(results):
+        assert rc == 0
+        assert re.search(
+            rf"{pid}: Memory Usage: \d+\.\d+, Training Duration: \d+\.\d+",
+            err,
+        ), err[-2000:]
+    # rank-0-only history write
+    assert (tmp_path / "history.json").exists()
 
 
 @pytest.mark.slow

@@ -64,6 +64,58 @@ def test_rendezvous_after_backend_init_raises_clearly():
                              num_processes=1, process_id=0)
 
 
+def test_two_process_world_spmd_sum():
+    """A REAL 2-process jax.distributed CPU world: both processes
+    rendezvous through the coordinator, build one global mesh spanning
+    both processes' (2 local each -> 4 global) devices, and jit a psum
+    whose result proves the collective crossed the process boundary."""
+    import subprocess
+    import sys
+
+    code = """
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+from pytorch_distributed_rnn_tpu.parallel.multihost import (
+    global_device_mesh, initialize_multihost, process_info)
+assert initialize_multihost()  # spec from PDRNN_* env
+rank, world = process_info()
+assert world == 2
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+mesh = global_device_mesh()
+n = mesh.shape["dp"]
+assert n == 4, n  # 2 processes x 2 virtual devices
+sharding = NamedSharding(mesh, P("dp"))
+# global array [0, 1, 2, 3] sharded one element per device across hosts
+arr = jax.make_array_from_callback(
+    (n,), sharding, lambda idx: np.arange(n, dtype=np.float32)[idx])
+total = jax.jit(
+    lambda x: jnp.sum(x), out_shardings=NamedSharding(mesh, P()))(arr)
+# the sum spans shards owned by BOTH processes
+assert float(total) == 6.0, float(total)
+print(f"WORLD_OK rank={rank}")
+"""
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        env["PDRNN_COORDINATOR"] = "localhost:12356"
+        env["PDRNN_NUM_PROCESSES"] = "2"
+        env["PDRNN_PROCESS_ID"] = str(pid)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", code], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            )
+        )
+    outs = [p.communicate(timeout=180) for p in procs]
+    for pid, (p, (out, err)) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {pid} failed:\n{err}"
+        assert f"WORLD_OK rank={pid}" in out
+
+
 def test_single_process_rendezvous_and_global_mesh():
     """A real 1-process rendezvous through jax.distributed, then a global
     mesh over the (virtual 8-device) world - in a clean interpreter,

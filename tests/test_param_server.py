@@ -210,3 +210,22 @@ def test_profile_flag_rejected():
     )
     with pytest.raises(SystemExit, match="not supported"):
         args.func(args)
+
+
+class TestSyncTimeout:
+    def test_sync_mode_round_timeout_raises(self):
+        """A straggler past sync_timeout must error loudly, not proceed
+        with stale params (VERDICT r1 weak #7)."""
+        from pytorch_distributed_rnn_tpu.param_server.master import (
+            ParameterServerMaster,
+        )
+
+        class FakeComm:
+            world_size = 3  # two workers; only one will ever push
+
+        master = ParameterServerMaster(
+            FakeComm(), np.zeros(4, np.float32), lambda g: g,
+            sync_mode=True, sync_timeout=0.2,
+        )
+        with pytest.raises(RuntimeError, match="timed out"):
+            master._push_sync(1, np.zeros(4, np.float32))

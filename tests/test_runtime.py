@@ -100,6 +100,35 @@ def _body_fault_delay(rank, world, port, delay_ms):
         return time.perf_counter() - start
 
 
+def _body_fault_loss(rank, world, port, loss_prob):
+    """Simulated packet loss must slow the collective down, never corrupt
+    it (the reference's tc-netem loss sweep shows up as pure slowdown,
+    fabfile.py:130-191)."""
+    with Communicator("127.0.0.1", port, rank, world) as comm:
+        data = np.full(257, float(rank + 1), np.float32)
+        comm.allreduce(data.copy())  # warm path
+        comm.set_fault(loss_prob=loss_prob)
+        start = time.perf_counter()
+        out = comm.allreduce(data)
+        return time.perf_counter() - start, out.copy()
+
+
+def _body_allreduce_f64(rank, world, port):
+    with Communicator("127.0.0.1", port, rank, world) as comm:
+        data = np.full(101, float(rank + 1), np.float64)
+        comm.allreduce(data)
+        return data.copy()
+
+
+def _body_allreduce_bf16(rank, world, port):
+    import ml_dtypes
+
+    with Communicator("127.0.0.1", port, rank, world) as comm:
+        data = np.full(130, float(rank + 1), ml_dtypes.bfloat16)
+        comm.allreduce(data, op="mean")
+        return np.asarray(data, np.float32)
+
+
 class TestNativeCollectives:
     def test_library_builds(self):
         assert build_native_library().exists()
@@ -155,3 +184,35 @@ class TestNativeCollectives:
         results = _run_ranks(_body_fault_delay, 2, PORT + 6, extra=(50.0,))
         # 2 ranks -> 2 ring steps, each delayed >=50ms on the send side
         assert max(results.values()) >= 0.05
+
+    def test_fault_injection_loss_slows_but_never_corrupts(self):
+        world = 2
+        results = _run_ranks(_body_fault_loss, world, PORT + 7, extra=(0.9,))
+        expected = np.full(257, float(sum(range(1, world + 1))), np.float32)
+        slowest = 0.0
+        for rank in range(world):
+            elapsed, out = results[rank]
+            np.testing.assert_allclose(out, expected)
+            slowest = max(slowest, elapsed)
+        # p=0.9 loss costs >=1 RTO (200ms) on most sends
+        assert slowest >= 0.1
+
+    def test_allreduce_f64(self):
+        world = 3
+        results = _run_ranks(_body_allreduce_f64, world, PORT + 8)
+        expected = np.full(101, float(sum(range(1, world + 1))), np.float64)
+        for rank in range(world):
+            np.testing.assert_allclose(results[rank], expected)
+
+    def test_allreduce_bf16_mean(self):
+        world = 4
+        results = _run_ranks(_body_allreduce_bf16, world, PORT + 9)
+        # mean of 1..4 = 2.5, exactly representable in bf16
+        expected = np.full(130, 2.5, np.float32)
+        for rank in range(world):
+            np.testing.assert_allclose(results[rank], expected)
+
+    def test_allreduce_rejects_unsupported_dtype(self):
+        with Communicator(world_size=1) as comm:
+            with pytest.raises(TypeError):
+                comm.allreduce(np.ones(4, np.int32))
