@@ -289,6 +289,15 @@ def launch_jax_world(
         if backend == "cpu":
             env["PDRNN_PLATFORM"] = "cpu"
             env["PDRNN_NUM_CPU_DEVICES"] = str(devices_per_process)
+        else:
+            # native: partition the host's TPU chips between ranks so each
+            # controller owns devices_per_process chips (libtpu allows one
+            # owner per chip; without this every rank would claim - and
+            # fight over - the full ambient device set)
+            first = pid * devices_per_process
+            env["TPU_VISIBLE_DEVICES"] = ",".join(
+                str(first + i) for i in range(devices_per_process)
+            )
         env["PYTHONPATH"] = os.pathsep.join(
             p for p in (repo_root, env.get("PYTHONPATH")) if p
         )

@@ -54,6 +54,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--cell", default="lstm", choices=["lstm", "gru"])
     parser.add_argument("--resume", default=None, type=Path)
     parser.add_argument(
+        "--checkpoint-every", default=0, type=int, metavar="N",
+        help="also write checkpoint-epoch-N.ckpt every N epochs "
+        "(0 = best-model-only, the reference's trigger)",
+    )
+    parser.add_argument(
         "--precision", default="f32", choices=["f32", "bf16"],
         help="bf16: bfloat16 compute (full MXU rate, half the HBM "
         "traffic) with f32 parameters and optimizer state",

@@ -46,6 +46,13 @@ from pytorch_distributed_rnn_tpu.parallel.strategy import (
     parse_mesh_spec,
     validate_rnn_mesh,
 )
+from pytorch_distributed_rnn_tpu.parallel.zero import (
+    init_sharded,
+    init_sharded_opt_state,
+    make_fsdp_train_step,
+    per_device_bytes,
+    sharded_specs,
+)
 
 __all__ = [
     "make_mesh",
@@ -53,6 +60,11 @@ __all__ = [
     "make_motion_mesh_loss_fn",
     "parse_mesh_spec",
     "validate_rnn_mesh",
+    "init_sharded",
+    "init_sharded_opt_state",
+    "make_fsdp_train_step",
+    "per_device_bytes",
+    "sharded_specs",
     "batch_sharding",
     "replicated_sharding",
     "allgather_tree",

@@ -91,7 +91,8 @@ def validate_rnn_mesh(axes: dict[str, int], cell: str = "lstm"):
 
 def mesh_rnn_forward(params, x, *, sp=None, tp=None, pp=None,
                      schedule: str = "wavefront", num_microbatches: int = 4,
-                     unroll: int = 1):
+                     unroll: int = 1, dropout: float = 0.0,
+                     dropout_key=None):
     """Motion-model forward (stacked LSTM -> last-step head) for use INSIDE
     a ``shard_map`` program where the named axes are bound.
 
@@ -134,7 +135,8 @@ def mesh_rnn_forward(params, x, *, sp=None, tp=None, pp=None,
     from pytorch_distributed_rnn_tpu.ops.rnn import stacked_rnn
 
     out, _ = stacked_rnn(params["rnn"], x, "lstm", unroll=unroll,
-                         impl="scan")
+                         impl="scan", dropout=dropout,
+                         dropout_key=dropout_key)
     return out[:, -1, :] @ params["fc"]["weight"].T + params["fc"]["bias"]
 
 
@@ -282,35 +284,47 @@ def make_char_mesh_train_step(optimizer, mesh, axes: dict[str, int], *,
 def make_motion_mesh_loss_fn(mesh, axes: dict[str, int], *,
                              schedule: str = "wavefront",
                              num_microbatches: int = 4, unroll: int = 1,
-                             weighted: bool = False):
-    """Shard_mapped ``loss_fn(params, x, y[, w]) -> (loss, metrics)`` for
-    the motion model over a composed mesh: ``x``/``y`` (and ``w``) shard
-    their batch dim over ``dp``; the scalar loss and summed metrics come
-    back replicated.  Grad is meant to be taken OUTSIDE (see
-    :func:`make_char_mesh_train_step` for why)."""
+                             weighted: bool = False, dropout: float = 0.0):
+    """Shard_mapped ``loss_fn(params, x, y[, w][, key]) -> (loss,
+    metrics)`` for the motion model over a composed mesh: ``x``/``y`` (and
+    ``w``) shard their batch dim over ``dp``; the scalar loss and summed
+    metrics come back replicated.  Grad is meant to be taken OUTSIDE (see
+    :func:`make_char_mesh_train_step` for why).
+
+    ``dropout > 0`` (dp-only meshes; the trainer guards the model axes)
+    appends a trailing replicated per-step PRNG key argument; each dp
+    shard folds its rank in for an independent mask."""
     kw = _axis_kwargs(axes)
 
     from functools import partial as _partial
 
     batch_specs = (P("dp"), P("dp")) + ((P("dp"),) if weighted else ())
+    key_specs = (P(),) if dropout > 0.0 else ()
 
     @_partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(),) + batch_specs,
+        in_specs=(P(),) + batch_specs + key_specs,
         out_specs=(P(), P()),
         check_vma=False,
     )
-    def loss_fn(params, x, y, *w):
+    def loss_fn(params, x, y, *extra):
+        if dropout > 0.0:
+            key = jax.random.fold_in(extra[-1], lax.axis_index("dp"))
+            extra = extra[:-1]
+        else:
+            key = None
         logits = mesh_rnn_forward(
             params, x, schedule=schedule,
-            num_microbatches=num_microbatches, unroll=unroll, **kw,
+            num_microbatches=num_microbatches, unroll=unroll,
+            dropout=dropout, dropout_key=key, **kw,
         )
         if weighted:
+            w = extra[0]
             nll = cross_entropy_loss(logits, y, reduction="none")
-            local = jnp.sum(nll * w[0]) / jnp.maximum(jnp.sum(w[0]), 1.0)
+            local = jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)
             correct = jnp.sum(
-                (jnp.argmax(logits, axis=1) == y) * (w[0] > 0)
+                (jnp.argmax(logits, axis=1) == y) * (w > 0)
             )
         else:
             local = cross_entropy_loss(logits, y)
@@ -323,9 +337,10 @@ def make_motion_mesh_loss_fn(mesh, axes: dict[str, int], *,
     return loss_fn
 
 
-def make_mesh_grad_step(loss_fn, optimizer, *, weighted: bool = False):
-    """``step(params, opt_state, batch[, w]) -> (params, opt_state, loss,
-    metrics)`` with grad outside the shard_mapped ``loss_fn``."""
+def make_mesh_grad_step(loss_fn, optimizer):
+    """``step(params, opt_state, batch, *extra) -> (params, opt_state,
+    loss, metrics)`` with grad outside the shard_mapped ``loss_fn``;
+    ``*extra`` (weight column and/or dropout key) is forwarded in order."""
 
     def step(params, opt_state, batch, *extra):
         x, y = batch

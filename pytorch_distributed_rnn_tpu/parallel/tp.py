@@ -24,8 +24,9 @@ Sharding scheme for an LSTM layer (Megatron-style, adapted to recurrence):
 Params stay replicated in HBM and each shard *slices* its piece inside the
 SPMD program; XLA keeps the slice fused into the consuming matmul, and the
 single replicated copy is the same memory the DP strategies already pay.
-(A from-construction sharded-parameter variant is a natural follow-on; the
-compute path - where TP matters - is identical.)
+When the PARAMETER footprint itself is the constraint, use
+``parallel/zero.py``: from-construction sharded params + optimizer state
+(ZeRO/FSDP layout), which composes with this module's compute sharding.
 """
 
 from __future__ import annotations

@@ -1,0 +1,129 @@
+"""ZeRO/FSDP-style parameter + optimizer-state sharding.
+
+Fills the one empty row of SURVEY's parallelism checklist (the reference
+keeps a full replica per rank, ``/root/reference/src/motion/trainer/
+ddp.py:19``; ZeRO/FSDP absent).  TPU-native design: there is no wrapper
+class and no hand-written gather/scatter schedule - parameters and
+optimizer state are simply *constructed* with a sharded ``NamedSharding``
+layout (each big tensor split along its largest divisible dimension over
+the ``dp`` axis), and the train step is jit-compiled with those shardings
+pinned on inputs and outputs.  XLA's SPMD partitioner then inserts the
+FSDP communication pattern itself: all-gather weights where a matmul needs
+them, reduce-scatter the gradients, update each parameter shard locally
+(ZeRO-1's "every rank owns 1/n of the optimizer state") - and overlaps the
+collectives with compute.  ``jax.checkpoint``/remat compose orthogonally.
+
+Per-chip parameter + optimizer bytes drop to ~1/n of the replicated
+layout, which is what makes the 50M-param LM family trainable at depth on
+a small slice; tests verify the byte accounting per shard and the exact
+numerical equivalence with replicated training.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def shard_rule(shape, axis_size: int, axis: str = "dp",
+               min_shard_elems: int = 1024):
+    """The one shape->PartitionSpec rule used for params AND optimizer
+    state (shape-based, so Adam's mu/nu land on their parameter's layout).
+
+    Shards the largest dimension divisible by ``axis_size``; tensors too
+    small to matter (or with no divisible dim) stay replicated - biases
+    and scalars cost nothing to replicate and sharding them would only
+    add collective latency.
+    """
+    if math.prod(shape) < min_shard_elems * axis_size:
+        return P()
+    dims = sorted(
+        range(len(shape)), key=lambda d: shape[d], reverse=True
+    )
+    for d in dims:
+        if shape[d] % axis_size == 0:
+            spec = [None] * len(shape)
+            spec[d] = axis
+            return P(*spec)
+    return P()
+
+
+def sharded_specs(tree, mesh, axis: str = "dp",
+                  min_shard_elems: int = 1024):
+    """NamedShardings for every leaf of ``tree`` (arrays or ShapeDtype
+    structs) under :func:`shard_rule`."""
+    n = mesh.shape[axis]
+    return jax.tree.map(
+        lambda leaf: NamedSharding(
+            mesh, shard_rule(leaf.shape, n, axis, min_shard_elems)
+        ),
+        tree,
+    )
+
+
+def init_sharded(model, key, mesh, axis: str = "dp"):
+    """Construct model parameters DIRECTLY into the sharded layout: the
+    initializer is jit-compiled with ``out_shardings``, so no host ever
+    materializes (or transfers) a full replica - the point of
+    from-construction sharding for models near the HBM limit."""
+    shapes = jax.eval_shape(model.init, key)
+    shardings = sharded_specs(shapes, mesh, axis)
+    return jax.jit(model.init, out_shardings=shardings)(key), shardings
+
+
+def init_sharded_opt_state(optimizer, params, mesh, axis: str = "dp"):
+    """Optimizer state in the sharded layout (ZeRO-1: each rank owns 1/n
+    of mu/nu; the shape-based rule makes them follow their parameter)."""
+    shapes = jax.eval_shape(optimizer.init, params)
+    shardings = sharded_specs(shapes, mesh, axis)
+    return jax.jit(optimizer.init, out_shardings=shardings)(params), shardings
+
+
+def make_fsdp_train_step(loss_fn, optimizer, mesh, param_shardings,
+                         opt_shardings, axis: str = "dp",
+                         donate: bool = True):
+    """Jitted FSDP training step.
+
+    ``loss_fn(params, batch) -> loss`` is the plain single-device loss on
+    the GLOBAL batch; ``batch`` arrives sharded on ``axis``.  Sharding
+    annotations alone produce the FSDP schedule: XLA all-gathers each
+    weight where consumed, reduce-scatters its gradient, and updates the
+    local optimizer-state shard.  Output shardings are pinned so updated
+    params/opt state stay in the sharded layout step over step.
+    """
+    batch_sharding = NamedSharding(mesh, P(axis))
+    rep = NamedSharding(mesh, P())
+
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(
+        step,
+        in_shardings=(param_shardings, opt_shardings, batch_sharding),
+        out_shardings=(param_shardings, opt_shardings, rep),
+        donate_argnums=(0, 1) if donate else (),
+    )
+
+
+def per_device_bytes(tree) -> int:
+    """Max bytes any single device holds for ``tree`` (the per-chip
+    memory the sharding actually buys; replicated leaves count fully)."""
+    totals: dict = {}
+    for leaf in jax.tree.leaves(tree):
+        if not hasattr(leaf, "addressable_shards"):
+            continue
+        seen = set()
+        for shard in leaf.addressable_shards:
+            d = shard.device
+            if d in seen:
+                continue
+            seen.add(d)
+            totals[d] = totals.get(d, 0) + int(np.prod(shard.data.shape)) * leaf.dtype.itemsize
+    return max(totals.values()) if totals else 0

@@ -263,7 +263,13 @@ Comm* pdrnn_init(const char* master_addr, int master_port, int rank,
       }
       c->peer_fd[peer_rank] = fd;
       ports[peer_rank] = peer_port;
-      addrs[peer_rank] = peer_sa.sin_addr.s_addr;
+      // a loopback peer address means the worker shares rank 0's host:
+      // advertise sentinel 0, and dialers fall back to master_addr (which
+      // reaches this host from anywhere) - otherwise a remote worker
+      // would dial ITS OWN loopback
+      uint32_t a = peer_sa.sin_addr.s_addr;
+      addrs[peer_rank] =
+          ((ntohl(a) >> 24) == 127) ? 0 : a;
     }
     // share the port + address tables with everyone
     for (int r = 1; r < world; ++r)
@@ -299,9 +305,11 @@ Comm* pdrnn_init(const char* master_addr, int master_port, int rank,
       return nullptr;
     }
     // full mesh among workers: lower rank dials higher rank's listener at
-    // the address rank 0 observed for it - spans hosts
+    // the address rank 0 observed for it - spans hosts.  Sentinel 0 =
+    // peer is on rank 0's host, reachable via master_addr.
     for (int r = 1; r < rank; ++r) {
-      int pfd = dial_ip(addrs[r], ports[r]);
+      int pfd = addrs[r] == 0 ? dial(master_addr, ports[r])
+                              : dial_ip(addrs[r], ports[r]);
       if (pfd < 0) {
         pdrnn_destroy(c);
         return nullptr;

@@ -121,6 +121,7 @@ def train(args, trainer_class):
         learning_rate=args.learning_rate,
         checkpoint_dir=args.checkpoint_directory,
         seed=args.seed,
+        checkpoint_every=getattr(args, "checkpoint_every", 0),
     )
 
     if getattr(args, "resume", None):

@@ -72,9 +72,13 @@ class Trainer:
         checkpoint_dir=None,
         sampler=None,
         seed: int | None = None,
+        checkpoint_every: int = 0,
     ):
         self.model = model
         self.checkpoint_dir = Path(checkpoint_dir) if checkpoint_dir else None
+        # periodic epoch checkpoints (checkpoint-epoch-N.ckpt) in addition
+        # to best-model.ckpt; 0 = best-only (reference trigger, base.py:88-91)
+        self.checkpoint_every = int(checkpoint_every or 0)
         self.rank = 0
         self.world_size = 1
 
@@ -341,6 +345,8 @@ class Trainer:
             # from the per-epoch path's unpadded draw; keep the two paths
             # bit-identical by taking the per-epoch path in that case
             and not (self._dropout > 0.0 and self._has_partial_batch())
+            # periodic checkpointing needs the host at epoch boundaries
+            and not (self.checkpoint_every and self.checkpoint_dir)
         )
 
         def train_inner():
@@ -356,6 +362,12 @@ class Trainer:
                 logging.info(formatter.epoch_start_message(epoch))
                 train_loss, train_acc = self._train_epoch(formatter)
                 training_history.append(train_loss)
+
+                if (
+                    self.checkpoint_every
+                    and (epoch + 1) % self.checkpoint_every == 0
+                ):
+                    self._save_checkpoint(epoch, train_loss, best=False)
 
                 if self.validation_set is not None:
                     validation_loss, _ = self._evaluate(

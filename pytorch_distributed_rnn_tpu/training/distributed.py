@@ -55,6 +55,7 @@ class SpmdTrainer(Trainer):
         seed: int | None = None,
         mesh=None,
         axis: str = "dp",
+        checkpoint_every: int = 0,
     ):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.axis = axis
@@ -73,6 +74,7 @@ class SpmdTrainer(Trainer):
             checkpoint_dir=checkpoint_dir,
             sampler=sampler,
             seed=seed,
+            checkpoint_every=checkpoint_every,
         )
         self.world_size = world_size
         # single controller: one process reports as rank 0.  In a

@@ -62,6 +62,7 @@ class MeshTrainer(SpmdTrainer):
         return make_motion_mesh_loss_fn(
             self.mesh, self.mesh_axes, schedule=self.schedule,
             num_microbatches=self.num_microbatches, weighted=weighted,
+            dropout=self._dropout,
         )
 
     def _build_train_step(self):
@@ -86,16 +87,21 @@ class MeshTrainer(SpmdTrainer):
         grad_step = make_mesh_grad_step(
             self._mesh_loss_fn(weighted=False), self.optimizer
         )
+        with_key = self._dropout > 0.0
 
-        def epoch(params, opt_state, features, labels, idx_mat):
-            def body(carry, idx):
+        def epoch(params, opt_state, features, labels, idx_mat,
+                  key_mat=None):
+            def body(carry, step_in):
+                idx = step_in[0] if with_key else step_in
+                extra = (step_in[1],) if with_key else ()
                 params, opt_state, loss, metrics = grad_step(
-                    *carry, (features[idx], labels[idx])
+                    *carry, (features[idx], labels[idx]), *extra
                 )
                 return (params, opt_state), (loss, metrics)
 
+            xs = (idx_mat, key_mat) if with_key else idx_mat
             (params, opt_state), (losses, metrics) = jax.lax.scan(
-                body, (params, opt_state), idx_mat
+                body, (params, opt_state), xs
             )
             metrics_sum = jax.tree.map(
                 lambda m: jax.numpy.sum(m, axis=0), metrics
@@ -106,20 +112,23 @@ class MeshTrainer(SpmdTrainer):
 
     def _build_run_fn(self):
         grad_step = make_mesh_grad_step(
-            self._mesh_loss_fn(weighted=True), self.optimizer,
-            weighted=True,
+            self._mesh_loss_fn(weighted=True), self.optimizer
         )
+        with_key = self._dropout > 0.0
 
-        def run(params, opt_state, features, labels, idx_mat, w_mat):
+        def run(params, opt_state, features, labels, idx_mat, w_mat,
+                key_mat=None):
             def body(carry, step_in):
-                idx, w = step_in
+                idx, w = step_in[0], step_in[1]
+                extra = (step_in[2],) if with_key else ()
                 params, opt_state, loss, metrics = grad_step(
-                    *carry, (features[idx], labels[idx]), w
+                    *carry, (features[idx], labels[idx]), w, *extra
                 )
                 return (params, opt_state), (loss, metrics["correct"])
 
+            xs = (idx_mat, w_mat, key_mat) if with_key else (idx_mat, w_mat)
             (params, opt_state), (losses, correct) = jax.lax.scan(
-                body, (params, opt_state), (idx_mat, w_mat)
+                body, (params, opt_state), xs
             )
             return params, opt_state, losses, correct
 

@@ -112,6 +112,24 @@ class TestMeshTrainerEquivalence:
         )
         assert history == pytest.approx(ref_history, rel=1e-4)
 
+    def test_dp_only_mesh_supports_dropout(self, datasets):
+        """The CLI-default --dropout 0.1 must work on a dp-only mesh
+        (regression: the run/epoch builders used to reject the trailing
+        dropout-key argument the base loop passes)."""
+        model = MotionModel(input_dim=9, hidden_dim=8, layer_dim=2,
+                            output_dim=6, impl="scan", dropout=0.1)
+        trainer = MeshTrainer(
+            mesh_axes={"dp": 2}, model=model, training_set=datasets,
+            batch_size=24, learning_rate=2.5e-3, seed=SEED,
+        )
+        params, history, _ = trainer.train(epochs=2)
+        assert len(history) == 2 and np.isfinite(history[-1])
+        # dropout actually changes training vs the no-dropout mesh run
+        bparams, _ = _train({"mesh_axes": {"dp": 2}}, datasets)
+        assert leaves_sum(params) != pytest.approx(
+            leaves_sum(bparams), abs=1e-9
+        )
+
     def test_dropout_rejected_on_model_axes(self, datasets):
         model = MotionModel(input_dim=9, hidden_dim=8, layer_dim=2,
                             output_dim=6, impl="scan", dropout=0.5)

@@ -56,6 +56,26 @@ class TestLocalTrainer:
             r"0: Memory Usage: \d+(\.\d+)?, Training Duration: \d+(\.\d+)?", perf[0]
         )
 
+    def test_periodic_epoch_checkpoints(self, datasets, tmp_path):
+        """--checkpoint-every N writes checkpoint-epoch-N.ckpt at epoch
+        boundaries (reachable non-best path) and they resume."""
+        train, _, _ = datasets
+        trainer = Trainer(
+            small_model(), train, batch_size=48, learning_rate=2.5e-3,
+            seed=SEED, checkpoint_dir=tmp_path, checkpoint_every=2,
+        )
+        trainer.train(epochs=4)
+        assert (tmp_path / "checkpoint-epoch-2.ckpt").exists()
+        assert (tmp_path / "checkpoint-epoch-4.ckpt").exists()
+        assert not (tmp_path / "checkpoint-epoch-3.ckpt").exists()
+
+        resumed = Trainer(
+            small_model(), train, batch_size=48, learning_rate=2.5e-3,
+            seed=0,
+        )
+        meta = resumed.resume_from(tmp_path / "checkpoint-epoch-4.ckpt")
+        assert meta["epoch"] == 4
+
     def test_checkpoint_saved_and_resume_round_trips(self, datasets, tmp_path):
         train, valid, _ = datasets
         trainer = Trainer(

@@ -1,0 +1,164 @@
+"""ZeRO/FSDP-style sharded params + optimizer state (parallel/zero.py).
+
+The reference holds a full replica per rank (``/root/reference/src/motion/
+trainer/ddp.py:19``); these tests pin what the sharded layout buys and
+that it costs nothing in numerics:
+
+1. from-construction sharding: big tensors land split over dp, per-device
+   bytes ~ 1/n of the replicated footprint (counted from actual shards);
+2. the FSDP step trains bit-compatibly with the replicated step;
+3. optimizer state (Adam mu/nu) follows its parameter's layout.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from pytorch_distributed_rnn_tpu.models import CharRNN, num_params
+from pytorch_distributed_rnn_tpu.parallel.mesh import make_mesh
+from pytorch_distributed_rnn_tpu.parallel.zero import (
+    init_sharded,
+    init_sharded_opt_state,
+    make_fsdp_train_step,
+    per_device_bytes,
+    shard_rule,
+    sharded_specs,
+)
+
+N_DEV = 8
+
+
+def small_lm():
+    # hidden 64 -> gate dim 256 divides 8; embed 32
+    return CharRNN(vocab_size=64, embed_dim=32, hidden_dim=64,
+                   layer_dim=2, impl="scan")
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh({"dp": N_DEV})
+
+
+class TestShardRule:
+    def test_big_matrix_shards_largest_divisible_dim(self):
+        from jax.sharding import PartitionSpec as P
+
+        assert shard_rule((256, 64), N_DEV) == P("dp", None)
+        assert shard_rule((64, 256), N_DEV) == P(None, "dp")
+
+    def test_small_or_indivisible_stays_replicated(self):
+        from jax.sharding import PartitionSpec as P
+
+        assert shard_rule((64,), N_DEV) == P()  # bias: too small
+        assert shard_rule((), N_DEV) == P()  # scalar (Adam count)
+        assert shard_rule((1023, 3), 8, min_shard_elems=1) == P()  # indivisible
+
+
+class TestShardedConstruction:
+    def test_per_device_bytes_shrink(self, mesh):
+        model = small_lm()
+        params, shardings = init_sharded(model, jax.random.PRNGKey(0), mesh)
+        total = sum(
+            int(np.prod(p.shape)) * p.dtype.itemsize
+            for p in jax.tree.leaves(params)
+        )
+        per_dev = per_device_bytes(params)
+        # big tensors dominate this model; per-device should be well under
+        # the replicated footprint and approach total/n + small-replicated
+        assert per_dev < total / 2
+        assert per_dev < total / N_DEV * 3
+
+    def test_opt_state_follows_param_layout(self, mesh):
+        model = small_lm()
+        params, param_shardings = init_sharded(
+            model, jax.random.PRNGKey(0), mesh
+        )
+        opt = optax.adam(1e-3)
+        opt_state, _ = init_sharded_opt_state(opt, params, mesh)
+        mu = opt_state[0].mu
+        flat_p = jax.tree.leaves(params)
+        flat_mu = jax.tree.leaves(mu)
+        for p, m in zip(flat_p, flat_mu):
+            assert p.sharding == m.sharding
+
+    def test_gate_matrices_actually_distributed(self, mesh):
+        model = small_lm()
+        params, _ = init_sharded(model, jax.random.PRNGKey(0), mesh)
+        w_ih = params["rnn"][0]["w_ih"]  # (4H=256, 32): sharded dim 0
+        shard_shapes = {s.data.shape for s in w_ih.addressable_shards}
+        assert shard_shapes == {(256 // N_DEV, 32)}
+        # embed (64, 32) = 2k elems sits under the min-shard threshold:
+        # replicating it is the right call (collective latency > memory)
+        embed = params["embed"]
+        assert {s.data.shape for s in embed.addressable_shards} == {(64, 32)}
+
+
+class TestFsdpTraining:
+    def test_matches_replicated_training(self, mesh):
+        model = small_lm()
+        opt = optax.adam(1e-2)
+
+        params_s, p_shard = init_sharded(model, jax.random.PRNGKey(0), mesh)
+        opt_s, o_shard = init_sharded_opt_state(opt, params_s, mesh)
+        step = make_fsdp_train_step(
+            model.loss, opt, mesh, p_shard, o_shard, donate=False
+        )
+
+        # replicated baseline: identical init (same key), plain jit
+        params_r = model.init(jax.random.PRNGKey(0))
+        opt_r = opt.init(params_r)
+
+        def rep_step(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(model.loss)(params, batch)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        rep_step = jax.jit(rep_step)
+
+        rng = np.random.RandomState(0)
+        tokens = jnp.asarray(rng.randint(0, 64, size=(16, 12)), jnp.int32)
+        losses_s, losses_r = [], []
+        for _ in range(5):
+            params_s, opt_s, loss_s = step(params_s, opt_s, tokens)
+            params_r, opt_r, loss_r = rep_step(params_r, opt_r, tokens)
+            losses_s.append(float(loss_s))
+            losses_r.append(float(loss_r))
+        assert losses_s == pytest.approx(losses_r, rel=1e-4)
+        # final params agree leaf-by-leaf (tolerance covers the f32
+        # reduction-order difference between reduce-scatter and the
+        # replicated sum)
+        for a, b in zip(jax.tree.leaves(params_s), jax.tree.leaves(params_r)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-5
+            )
+
+    def test_updated_state_stays_sharded(self, mesh):
+        model = small_lm()
+        opt = optax.adam(1e-2)
+        params, p_shard = init_sharded(model, jax.random.PRNGKey(0), mesh)
+        opt_state, o_shard = init_sharded_opt_state(opt, params, mesh)
+        step = make_fsdp_train_step(
+            model.loss, opt, mesh, p_shard, o_shard, donate=False
+        )
+        tokens = jnp.zeros((8, 12), jnp.int32)
+        params, opt_state, _ = step(params, opt_state, tokens)
+        w_ih = params["rnn"][0]["w_ih"]
+        assert {s.data.shape for s in w_ih.addressable_shards} == {
+            (256 // N_DEV, 32)
+        }
+
+
+def test_50m_preset_shards():
+    """The 50M stress preset constructs sharded without ever holding a
+    replica; per-device param bytes ~ 1/8 of the 200MB replicated f32."""
+    from pytorch_distributed_rnn_tpu.models import char_rnn_50m
+
+    mesh = make_mesh({"dp": N_DEV})
+    model = char_rnn_50m(impl="scan")
+    params, _ = init_sharded(model, jax.random.PRNGKey(0), mesh)
+    total_mb = num_params(params) * 4 / 1e6
+    per_dev_mb = per_device_bytes(params) / 1e6
+    assert total_mb > 190  # ~50M params
+    assert per_dev_mb < total_mb / 4  # well below replicated
