@@ -94,7 +94,10 @@ def get_command(config: RunConfig, python: str | None = None):
     elif config.trainer in ("local", "distributed", "horovod"):
         argv = [python, "-m", "pytorch_distributed_rnn_tpu.main",
                 *flag_argv, config.trainer]
-        if config.trainer != "local" and config.backend == "cpu":
+        if config.backend == "cpu":
+            # local rows too: the whole study must run on ONE platform,
+            # like the reference's local row running on the same Pi
+            # hardware as its distributed rows (fabfile.py:48-66)
             env["PDRNN_PLATFORM"] = "cpu"
             env["PDRNN_NUM_CPU_DEVICES"] = str(world)
     elif config.trainer == "distributed-native":

@@ -130,18 +130,17 @@ def _lstm_fwd_pallas(x_proj, h0, c0, w_hh_t, *, block_b):
 def _lstm_bwd_kernel(x_proj_ref, h_prev_ref, c_prev_ref, c_t_ref,
                      dh_all_ref, dh_T_ref, dc_T_ref, w_hh_t_ref, w_hh_ref,
                      h0_ref, c0_ref,
-                     dx_proj_ref, dw_hh_ref, dh0_ref, dc0_ref,
-                     dh_scr, dc_scr, dw_scr):
-    b = pl.program_id(0)
+                     dx_proj_ref, dh0_ref, dc0_ref,
+                     dh_scr, dc_scr):
+    """Reverse-time sweep; the weight grad is NOT accumulated here - a
+    (4H, H) f32 VMEM accumulator is 26MB at H=1280, over the scoped-vmem
+    limit.  Like the GRU backward, the kernel emits per-step gate
+    cotangents (``dx_proj`` doubles as them) and the wrapper forms
+    ``dw_hh`` with one big MXU matmul outside - better tiling anyway."""
     t = pl.program_id(1)
-    nb = pl.num_programs(0)
     seq_len = pl.num_programs(1)
     tt_is_first = t == 0          # tt == T-1: start of backward sweep
     tt_is_last = t == seq_len - 1  # tt == 0: end of backward sweep
-
-    @pl.when(jnp.logical_and(b == 0, tt_is_first))
-    def _():
-        dw_scr[:] = jnp.zeros_like(dw_scr)
 
     @pl.when(tt_is_first)
     def _():
@@ -183,9 +182,6 @@ def _lstm_bwd_kernel(x_proj_ref, h_prev_ref, c_prev_ref, c_t_ref,
     )
 
     dx_proj_ref[0] = d_gates.astype(dx_proj_ref.dtype)
-    dw_scr[:] += jnp.dot(
-        d_gates.T, h_prev, preferred_element_type=jnp.float32
-    )
 
     dh_prev = jnp.dot(d_gates, w_hh_ref[:], preferred_element_type=jnp.float32)
     dc_prev = dc * f
@@ -196,10 +192,6 @@ def _lstm_bwd_kernel(x_proj_ref, h_prev_ref, c_prev_ref, c_t_ref,
     def _():
         dh0_ref[:] = dh_prev.astype(dh0_ref.dtype)
         dc0_ref[:] = dc_prev.astype(dc0_ref.dtype)
-
-    @pl.when(jnp.logical_and(b == nb - 1, tt_is_last))
-    def _():
-        dw_hh_ref[:] = dw_scr[:].astype(dw_hh_ref.dtype)
 
 
 def _lstm_bwd_pallas(x_proj, h_all, c_all, h0, c0, w_hh_t,
@@ -215,7 +207,7 @@ def _lstm_bwd_pallas(x_proj, h_all, c_all, h0, c0, w_hh_t,
     rev_prev = lambda b, t: (                          # noqa: E731
         jnp.maximum(seq_len - 2 - t, 0), b, 0)
 
-    dx_proj, dw_hh, dh0, dc0 = pl.pallas_call(
+    dx_proj, dh0, dc0 = pl.pallas_call(
         _lstm_bwd_kernel,
         grid=grid,
         in_specs=[
@@ -233,24 +225,21 @@ def _lstm_bwd_pallas(x_proj, h_all, c_all, h0, c0, w_hh_t,
         ],
         out_specs=[
             pl.BlockSpec((1, block_b, gate_dim), rev),
-            pl.BlockSpec((gate_dim, hidden), lambda b, t: (0, 0)),
             pl.BlockSpec((block_b, hidden), lambda b, t: (b, 0)),
             pl.BlockSpec((block_b, hidden), lambda b, t: (b, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((seq_len, batch_p, gate_dim), dtype),
-            jax.ShapeDtypeStruct((gate_dim, hidden), dtype),
             jax.ShapeDtypeStruct((batch_p, hidden), dtype),
             jax.ShapeDtypeStruct((batch_p, hidden), dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_b, hidden), jnp.float32),
             pltpu.VMEM((block_b, hidden), jnp.float32),
-            pltpu.VMEM((gate_dim, hidden), jnp.float32),
         ],
         interpret=_interpret(),
     )(x_proj, h_all, c_all, c_all, dh_all, dh_T, dc_T, w_hh_t, w_hh, h0, c0)
-    return dx_proj, dw_hh, dh0, dc0
+    return dx_proj, dh0, dc0
 
 
 # ---------------------------------------------------------------------------
@@ -279,10 +268,18 @@ def _fused_fwd(x_proj, w_hh_t, h0, c0, block_b):
 def _fused_bwd(block_b, residuals, cotangents):
     x_proj, h_all, c_all, h0, c0, w_hh_t = residuals
     dh_all, (dh_T, dc_T) = cotangents
-    dx_proj, dw_hh, dh0, dc0 = _lstm_bwd_pallas(
+    dx_proj, dh0, dc0 = _lstm_bwd_pallas(
         x_proj, h_all, c_all, h0, c0, w_hh_t,
         dh_all, dh_T, dc_T, block_b=block_b,
     )
+    # weight grad as one big MXU matmul over all (t, b) at once: for the
+    # LSTM the emitted gate cotangents ARE dx_proj, so
+    # dw_hh = sum_t d_gates[t]^T h_prev[t]  ->  (4H, H), f32 accumulate
+    h_prev_all = jnp.concatenate([h0[None], h_all[:-1]], axis=0)
+    dw_hh = jnp.einsum(
+        "tbg,tbh->gh", dx_proj, h_prev_all,
+        preferred_element_type=jnp.float32,
+    ).astype(x_proj.dtype)
     return dx_proj, dw_hh.T, dh0, dc0
 
 

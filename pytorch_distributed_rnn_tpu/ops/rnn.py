@@ -187,18 +187,26 @@ def init_stacked_rnn(
     ]
 
 
-def resolve_rnn_impl(impl: str, cell: str) -> str:
+def resolve_rnn_impl(impl: str, cell: str, hidden: int | None = None) -> str:
     """Resolve the recurrent-step implementation.
 
     ``"scan"`` = portable ``lax.scan`` path; ``"fused"`` = Pallas fused
     time-loop kernel (``ops/pallas_rnn.py``); ``"auto"`` picks the fused
-    kernel on TPU where it is the performance path, and the scan path
-    elsewhere (off-TPU the kernel runs in the slow interpreter).
+    kernel on TPU *for small hidden sizes* - the regime where per-step
+    loop overhead dominates (the motion model's H=32) and the kernel's
+    VMEM working set fits comfortably.  At large H (the 50M LM's H=1280)
+    each scan step is already a substantial MXU matmul and the fused
+    region's (T, B, 4H) buffers press the scoped-VMEM budget, so auto
+    takes the scan path there.  Explicit ``"fused"`` is always honored.
     """
     if impl not in ("auto", "scan", "fused"):
         raise ValueError(f"unknown rnn impl {impl!r}")
     if impl == "auto":
-        if cell in ("lstm", "gru") and jax.default_backend() == "tpu":
+        if (
+            cell in ("lstm", "gru")
+            and jax.default_backend() == "tpu"
+            and (hidden is None or hidden <= 512)
+        ):
             return "fused"
         return "scan"
     if impl == "fused" and cell not in ("lstm", "gru"):
@@ -238,7 +246,9 @@ def stacked_rnn(
 
     Returns (outputs (B, T, H), list of per-layer final carries).
     """
-    impl = resolve_rnn_impl(impl, cell)
+    impl = resolve_rnn_impl(
+        impl, cell, hidden=layers[0]["w_hh"].shape[1] if layers else None
+    )
     if impl == "fused":
         from pytorch_distributed_rnn_tpu.ops.pallas_rnn import (
             gru_layer_fused,

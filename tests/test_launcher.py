@@ -34,7 +34,10 @@ def test_get_command_local():
     assert argv[:3] == ["python", "-m", "pytorch_distributed_rnn_tpu.main"]
     assert argv[-1] == "local"
     assert "--epochs" in argv and "--no-validation" in argv
-    assert env == {}
+    # local rows run on the study platform too (cpu backend is the default)
+    assert env == {"PDRNN_PLATFORM": "cpu", "PDRNN_NUM_CPU_DEVICES": "1"}
+    _, env_native = get_command(make_config("local", backend="native"))
+    assert env_native == {}
 
 
 def test_get_command_distributed_cpu_sim_sets_virtual_devices():
