@@ -9,6 +9,9 @@ Tasks (mirroring ``/root/reference/fabfile.py`` Fabric tasks):
   run-debug         single seeded 1-epoch run (``run_debug``)
   run-all           full shuffled benchmark sweep (``run_all``)
   run-slots         real multi-slot sweep (processes-per-host dimension)
+  run-hosts         multi-host jax.distributed world over SSH
+                    (--hosts h1:2,h2:2; the mpirun --host analogue;
+                    --dry-run prints the synthesized commands)
   run-network-test  delay/loss perturbation sweep (``run_network_test``)
   run-world         stand up one N-process world: ``--transport native`` =
                     process-per-rank DDP over the TCP collectives (the
@@ -71,6 +74,21 @@ def main(argv=None):
     p = sub.add_parser("run-slots")
     _add_common(p)
 
+    p = sub.add_parser("run-hosts")
+    p.add_argument("--hosts", required=True,
+                   help="host:slots list, e.g. h1:2,h2:2 (the mpirun "
+                   "--host analogue); host 0 is the coordinator")
+    p.add_argument("--trainer", default="distributed",
+                   choices=["distributed", "horovod"])
+    p.add_argument("--coordinator-port", type=int, default=29601)
+    p.add_argument("--python", default="python3")
+    p.add_argument("--repo-dir", default="~/pytorch_distributed_rnn_tpu")
+    p.add_argument("--dry-run", action="store_true",
+                   help="print the per-host SSH commands without running")
+    p.add_argument("--timeout", type=float, default=1800)
+    p.add_argument("cli", nargs=argparse.REMAINDER,
+                   help="main.py flags after --")
+
     p = sub.add_parser("run-world")
     p.add_argument("--transport", choices=["native", "jax"], default="native")
     p.add_argument("--world-size", type=int, default=2,
@@ -94,6 +112,8 @@ def main(argv=None):
 
     if args.task == "run-world":
         return _run_world(args)
+    if args.task == "run-hosts":
+        return _run_hosts(args)
 
     if args.task == "preflight":
         for ident in bench.preflight(args.world_size):
@@ -171,6 +191,39 @@ def _run_world(args) -> int:
         if err:
             sys.stderr.write(err)
     print(f"world of {len(results)} rank(s) completed")
+    return 0
+
+
+def _run_hosts(args) -> int:
+    """Multi-host world over SSH (the ``fab run_all`` launch analogue):
+    one SSH invocation per process, all rendezvousing through the
+    coordinator env."""
+    import os
+    import shlex
+
+    cli = [a for a in args.cli if a != "--"]
+    commands = bench.host_world_commands(
+        bench.parse_hosts(args.hosts), cli, trainer=args.trainer,
+        coordinator_port=args.coordinator_port, python=args.python,
+        repo_dir=args.repo_dir,
+    )
+    if args.dry_run:
+        for _, cmd in commands:
+            print(cmd)
+        return 0
+
+    from pytorch_distributed_rnn_tpu.utils.worlds import spawn_world
+
+    rank_cmds = [
+        (shlex.split(cmd), dict(os.environ)) for _, cmd in commands
+    ]
+    results = spawn_world(rank_cmds, timeout=args.timeout)
+    for rank, (rc, out, err) in enumerate(results):
+        if out:
+            sys.stdout.write(out)
+        if err:
+            sys.stderr.write(err)
+    print(f"host world of {len(results)} rank(s) completed")
     return 0
 
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 import itertools
 import json
 import os
+import shlex
 import random
 import subprocess
 import sys
@@ -296,6 +297,60 @@ def launch_jax_world(
             env,
         ))
     return spawn_world(rank_cmds, timeout=timeout, cwd=cwd)
+
+
+def parse_hosts(spec: str):
+    """``"h1:2,h2:2"`` -> ``[("h1", 2), ("h2", 2)]`` (the reference's
+    mpirun host:slots strings, ``fabfile.py:51,203-206``)."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _, slots = part.partition(":")
+        out.append((host, int(slots) if slots else 1))
+    if not out:
+        raise ValueError(f"empty hosts spec {spec!r}")
+    return out
+
+
+def host_world_commands(hosts, cli_args, *, trainer: str = "distributed",
+                        coordinator_port: int = 29601,
+                        python: str = "python3",
+                        repo_dir: str = "~/pytorch_distributed_rnn_tpu"):
+    """Synthesize the per-host SSH command lines that stand up one
+    multi-host ``jax.distributed`` world - the ``fab run_all`` command
+    synthesis re-targeted from ``mpirun --host h1:s,...``
+    (``/root/reference/fabfile.py:216-223``) to coordinator-env worlds.
+
+    Host 0 is the coordinator; each host h with s slots runs s processes
+    (process ids assigned host-major), every one exporting
+    ``PDRNN_COORDINATOR/PDRNN_NUM_PROCESSES/PDRNN_PROCESS_ID``.  Returns
+    ``[(host, command_string), ...]`` - one SSH invocation per process.
+    On TPU pods this is usually unnecessary (``jax.distributed``
+    auto-discovers from the metadata service); it exists for generic
+    CPU/GPU clusters and for parity with the reference's launcher.
+    """
+    pairs = list(hosts)
+    num_processes = sum(s for _, s in pairs)
+    coordinator = f"{pairs[0][0]}:{coordinator_port}"
+    flag_str = " ".join(shlex.quote(str(a)) for a in cli_args)
+    commands = []
+    pid = 0
+    for host, slots in pairs:
+        for _ in range(slots):
+            env = (
+                f"PDRNN_COORDINATOR={coordinator} "
+                f"PDRNN_NUM_PROCESSES={num_processes} "
+                f"PDRNN_PROCESS_ID={pid}"
+            )
+            inner = (
+                f"cd {repo_dir} && {env} {python} -m "
+                f"pytorch_distributed_rnn_tpu.main {flag_str} {trainer}"
+            )
+            commands.append((host, f"ssh {host} {shlex.quote(inner)}"))
+            pid += 1
+    return commands
 
 
 def preflight(world_size: int = 2, master_port: int = 29531) -> list:

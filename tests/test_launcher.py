@@ -67,6 +67,42 @@ def test_get_command_distributed_native_spawns_tcp_world():
     assert argv[argv.index("--world-size") + 1] == "4"
 
 
+def test_host_world_command_synthesis():
+    """The SSH multi-host synthesis (mpirun --host h1:s,... analogue,
+    reference fabfile.py:216-223): host-major process ids, coordinator on
+    host 0, every process carrying the full rendezvous env."""
+    from pytorch_distributed_rnn_tpu.launcher.bench import (
+        host_world_commands,
+        parse_hosts,
+    )
+
+    hosts = parse_hosts("nodeA:2, nodeB:1")
+    assert hosts == [("nodeA", 2), ("nodeB", 1)]
+    cmds = host_world_commands(
+        hosts, ["--epochs", "1", "--no-validation"], trainer="distributed",
+        coordinator_port=29700,
+    )
+    assert [h for h, _ in cmds] == ["nodeA", "nodeA", "nodeB"]
+    for pid, (host, cmd) in enumerate(cmds):
+        assert cmd.startswith(f"ssh {host} ")
+        assert "PDRNN_COORDINATOR=nodeA:29700" in cmd
+        assert "PDRNN_NUM_PROCESSES=3" in cmd
+        assert f"PDRNN_PROCESS_ID={pid}" in cmd
+        assert "--no-validation" in cmd and cmd.rstrip("'").endswith(
+            "distributed"
+        )
+
+
+def test_run_hosts_dry_run_cli(capsys):
+    from pytorch_distributed_rnn_tpu.launcher.__main__ import main
+
+    rc = main(["run-hosts", "--hosts", "h1:1,h2:1", "--dry-run", "--",
+               "--epochs", "1"])
+    out = capsys.readouterr().out.strip().splitlines()
+    assert rc == 0 and len(out) == 2
+    assert out[0].startswith("ssh h1 ") and out[1].startswith("ssh h2 ")
+
+
 def test_run_world_commands_forward_backend():
     """backend=native must survive into the run-world command so a TPU
     sweep row does not silently measure virtual CPU ranks."""
