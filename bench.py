@@ -142,14 +142,21 @@ def main():
             except Exception as exc:  # noqa: BLE001 - headline must survive
                 extras[name] = f"error: {type(exc).__name__}: {exc}"[:200]
 
-        # fused-vs-scan A/B: measure each impl EXPLICITLY; the fused
-        # kernel is a TPU kernel (interpret mode off-TPU would benchmark
-        # the interpreter), so the A/B only runs on the real chip
-        attempt(
-            "motion_scan_seq_per_sec",
-            lambda: round(motion_throughput("scan"), 1),
-        )
-        if on_tpu:
+        # fused-vs-scan A/B.  The headline "auto" run already measured one
+        # impl (fused on TPU, scan elsewhere - resolve_rnn_impl): reuse
+        # that number and measure only the other side.  The fused kernel
+        # is a TPU kernel (interpret mode off-TPU would benchmark the
+        # interpreter), so its side only runs on the real chip.
+        from pytorch_distributed_rnn_tpu.ops.rnn import resolve_rnn_impl
+
+        auto_impl = resolve_rnn_impl("auto", "lstm", hidden=32)
+        extras[f"motion_{auto_impl}_seq_per_sec"] = round(headline, 1)
+        if auto_impl != "scan":
+            attempt(
+                "motion_scan_seq_per_sec",
+                lambda: round(motion_throughput("scan"), 1),
+            )
+        elif on_tpu:
             attempt(
                 "motion_fused_seq_per_sec",
                 lambda: round(motion_throughput("fused"), 1),

@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""ZeRO/FSDP example: train the 50M char-LM with sharded state.
+
+New capability over the reference (which holds a full replica per rank,
+``/root/reference/src/motion/trainer/ddp.py:19``): parameters AND Adam
+state are constructed directly into a sharded layout over the ``dp`` axis
+— per-chip state bytes ~ 1/n — and the train step is plain jit with those
+shardings pinned; XLA inserts the all-gather/reduce-scatter schedule.
+
+Run on 8 virtual CPU devices:
+    PDRNN_PLATFORM=cpu PDRNN_NUM_CPU_DEVICES=8 python examples/example_fsdp.py
+"""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from pytorch_distributed_rnn_tpu.utils import apply_platform_overrides
+
+apply_platform_overrides()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from pytorch_distributed_rnn_tpu.models import CharRNN, num_params
+from pytorch_distributed_rnn_tpu.parallel import make_mesh
+from pytorch_distributed_rnn_tpu.parallel.zero import (
+    init_sharded,
+    init_sharded_opt_state,
+    make_fsdp_train_step,
+    per_device_bytes,
+)
+
+
+def run():
+    mesh = make_mesh()  # one dp axis over every visible device
+    n = mesh.devices.size
+    # small preset off-TPU; swap in char_rnn_50m() on a real slice
+    model = CharRNN(vocab_size=64, embed_dim=64, hidden_dim=128,
+                    layer_dim=2, impl="scan")
+
+    params, p_shard = init_sharded(model, jax.random.PRNGKey(0), mesh)
+    opt = optax.adam(1e-2)
+    opt_state, o_shard = init_sharded_opt_state(opt, params, mesh)
+
+    total_mb = sum(
+        int(np.prod(p.shape)) * p.dtype.itemsize
+        for p in jax.tree.leaves(params)
+    ) / 1e6
+    print(f"{num_params(params) / 1e6:.1f}M params, "
+          f"replicated {total_mb:.1f}MB -> per-device "
+          f"{per_device_bytes(params) / 1e6:.1f}MB over {n} devices")
+
+    step = make_fsdp_train_step(model.loss, opt, mesh, p_shard, o_shard)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, 64, size=(16, 32)), jnp.int32)
+    for i in range(20):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        if i % 5 == 0:
+            print(f"step {i}: loss {float(loss):.4f}")
+    print(f"final loss {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    run()

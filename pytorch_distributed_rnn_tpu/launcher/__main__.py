@@ -185,12 +185,18 @@ def _run_world(args) -> int:
             coordinator_port=args.coordinator_port,
             timeout=args.timeout, backend=args.backend,
         )
-    for rank, (rc, out, err) in enumerate(results):
+    return _emit_world_results(results, "world")
+
+
+def _emit_world_results(results, label: str) -> int:
+    """Forward each rank's captured output to ours (keeps the notebooks'
+    rank-0 perf-line regex working through the launcher layer)."""
+    for _, out, err in results:
         if out:
             sys.stdout.write(out)
         if err:
             sys.stderr.write(err)
-    print(f"world of {len(results)} rank(s) completed")
+    print(f"{label} of {len(results)} rank(s) completed")
     return 0
 
 
@@ -218,13 +224,7 @@ def _run_hosts(args) -> int:
         (shlex.split(cmd), dict(os.environ)) for _, cmd in commands
     ]
     results = spawn_world(rank_cmds, timeout=args.timeout)
-    for rank, (rc, out, err) in enumerate(results):
-        if out:
-            sys.stdout.write(out)
-        if err:
-            sys.stderr.write(err)
-    print(f"host world of {len(results)} rank(s) completed")
-    return 0
+    return _emit_world_results(results, "host world")
 
 
 def _report(executed, results_path) -> int:

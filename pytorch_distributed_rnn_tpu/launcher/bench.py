@@ -308,7 +308,10 @@ def parse_hosts(spec: str):
         if not part:
             continue
         host, _, slots = part.partition(":")
-        out.append((host, int(slots) if slots else 1))
+        n = int(slots) if slots else 1
+        if n < 1:
+            raise ValueError(f"host {host!r} has non-positive slots {n}")
+        out.append((host, n))
     if not out:
         raise ValueError(f"empty hosts spec {spec!r}")
     return out
