@@ -130,6 +130,28 @@ def lstm_layer(params, x, h0=None, c0=None, *, unroll: int = 1):
     return jnp.swapaxes(outputs, 0, 1), (h_t.astype(dtype), c_t.astype(dtype))
 
 
+def gru_step(w_hh_t, b_hh, h, xp_t):
+    """One GRU gate step (torch semantics, gate order r, z, n): ``xp_t``
+    is the (B, 3H) input-side pre-activation with ``b_ih`` folded in;
+    ``b_hh`` joins the hidden-side projection here because the n-gate's
+    hidden bias sits INSIDE the ``r *`` product.  The one definition of
+    the GRU gate math shared by the scan path and the sequence-parallel
+    relay; the Pallas kernel mirrors it and is parity-tested against it.
+
+    Mixed-precision contract as :func:`lstm_step`: the carry stays f32,
+    matmuls run in the compute dtype, the emitted output follows
+    ``xp_t``'s dtype.
+    """
+    h_proj = (h.astype(xp_t.dtype) @ w_hh_t + b_hh).astype(jnp.float32)
+    xr, xz, xn = jnp.split(xp_t.astype(jnp.float32), 3, axis=-1)
+    hr, hz, hn = jnp.split(h_proj, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    n = jnp.tanh(xn + r * hn)
+    h = (1.0 - z) * n + z * h
+    return h, h.astype(xp_t.dtype)
+
+
 def gru_layer(params, x, h0=None, *, unroll: int = 1):
     """Run one GRU layer over ``x`` of shape (B, T, in).
 
@@ -150,18 +172,10 @@ def gru_layer(params, x, h0=None, *, unroll: int = 1):
     if h0 is None:
         h0 = jnp.zeros((batch, hidden), jnp.float32)
 
-    def step(h, xp_t):
-        h_proj = (h.astype(xp_t.dtype) @ w_hh_t + b_hh).astype(jnp.float32)
-        xr, xz, xn = jnp.split(xp_t.astype(jnp.float32), 3, axis=-1)
-        hr, hz, hn = jnp.split(h_proj, 3, axis=-1)
-        r = jax.nn.sigmoid(xr + hr)
-        z = jax.nn.sigmoid(xz + hz)
-        n = jnp.tanh(xn + r * hn)
-        h = (1.0 - z) * n + z * h
-        return h, h.astype(xp_t.dtype)
-
-    h_t, outputs = lax.scan(step, h0.astype(jnp.float32),
-                            jnp.swapaxes(x_proj, 0, 1), unroll=unroll)
+    h_t, outputs = lax.scan(
+        lambda h, xp_t: gru_step(w_hh_t, b_hh, h, xp_t),
+        h0.astype(jnp.float32),
+        jnp.swapaxes(x_proj, 0, 1), unroll=unroll)
     return jnp.swapaxes(outputs, 0, 1), h_t.astype(dtype)
 
 

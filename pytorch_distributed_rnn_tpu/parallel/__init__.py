@@ -18,13 +18,17 @@ from pytorch_distributed_rnn_tpu.parallel.p2p import ring_relay_from_root
 from pytorch_distributed_rnn_tpu.parallel.sp import (
     make_sp_attention_forward,
     make_sp_forward,
+    sp_gru_layer,
     sp_lstm_layer,
+    sp_stacked_gru,
     sp_stacked_lstm,
     sp_stacked_lstm_wavefront,
 )
 from pytorch_distributed_rnn_tpu.parallel.tp import (
     make_tp_forward,
+    tp_gru_layer,
     tp_lstm_layer,
+    tp_stacked_gru,
     tp_stacked_lstm,
 )
 from pytorch_distributed_rnn_tpu.parallel.pp import (
@@ -77,11 +81,15 @@ __all__ = [
     "ring_relay_from_root",
     "make_sp_forward",
     "make_sp_attention_forward",
+    "sp_gru_layer",
     "sp_lstm_layer",
+    "sp_stacked_gru",
     "sp_stacked_lstm",
     "sp_stacked_lstm_wavefront",
     "make_tp_forward",
+    "tp_gru_layer",
     "tp_lstm_layer",
+    "tp_stacked_gru",
     "tp_stacked_lstm",
     "make_pp_forward",
     "pp_stacked_lstm",

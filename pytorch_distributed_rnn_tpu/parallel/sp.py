@@ -40,7 +40,12 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 from jax import shard_map
 
-from pytorch_distributed_rnn_tpu.ops.rnn import lstm_input_proj, lstm_step
+from pytorch_distributed_rnn_tpu.ops.rnn import (
+    gru_input_proj,
+    gru_step,
+    lstm_input_proj,
+    lstm_step,
+)
 from pytorch_distributed_rnn_tpu.parallel.collectives import broadcast_from
 
 
@@ -143,6 +148,47 @@ def sp_stacked_lstm(layers, x_local, axis: str, *, unroll: int = 1):
     out = x_local
     for layer in layers:
         out, final = sp_lstm_layer(layer, out, axis, unroll=unroll)
+        finals.append(final)
+    return out, finals
+
+
+def _gru_chunk_scan(w_hh_t, b_hh, carry, x_proj_chunk, unroll: int = 1):
+    """Scan the GRU gate recurrence (the shared :func:`ops.rnn.gru_step`)
+    over one local time chunk.  ``carry``: h (B, H) f32."""
+    carry, out = lax.scan(
+        lambda h, xp_t: gru_step(w_hh_t, b_hh, h, xp_t),
+        carry,
+        jnp.swapaxes(x_proj_chunk, 0, 1),
+        unroll=unroll,
+    )
+    return carry, jnp.swapaxes(out, 0, 1)
+
+
+def sp_gru_layer(params, x_local, axis: str, *, unroll: int = 1):
+    """One GRU layer over a time-sharded sequence, inside ``shard_map``.
+    Same relay as :func:`sp_lstm_layer`; the carry is just ``h``."""
+    n = lax.axis_size(axis)
+    batch = x_local.shape[0]
+    hidden = params["w_hh"].shape[1]
+
+    x_proj = gru_input_proj(params, x_local)  # b_ih folded; b_hh in-step
+    w_hh_t = params["w_hh"].T
+    h0 = jnp.zeros((batch, hidden), jnp.float32)
+
+    final, outputs = _relay(
+        axis, n, h0,
+        partial(_gru_chunk_scan, w_hh_t, params["b_hh"],
+                x_proj_chunk=x_proj, unroll=unroll),
+    )
+    return outputs, final
+
+
+def sp_stacked_gru(layers, x_local, axis: str, *, unroll: int = 1):
+    """Layer-sequential stacked GRU over a time-sharded sequence."""
+    finals = []
+    out = x_local
+    for layer in layers:
+        out, final = sp_gru_layer(layer, out, axis, unroll=unroll)
         finals.append(final)
     return out, finals
 

@@ -18,10 +18,11 @@ a mesh spec like ``dp=2,sp=4``:
   (Megatron-style), ``pp`` stages the layer stack (GPipe schedule).
 
 Supported RNN meshes: ``dp`` composed with AT MOST one of ``sp``/``tp``/
-``pp`` (the LSTM cell kernels do not compose sp x tp in one program; the
+``pp`` (the RNN cell kernels do not compose sp x tp in one program; the
 attention family covers the full dp x sp x tp composition via
-``parallel/combined.py``).  Cells: LSTM (the sp/tp/pp kernels are
-LSTM-specific).
+``parallel/combined.py``).  Cells: LSTM on every axis; GRU on sp
+(sequential relay) and tp (gate-sharded); the GPipe pp stage runner is
+LSTM-specific.
 """
 
 from __future__ import annotations
@@ -37,11 +38,13 @@ from pytorch_distributed_rnn_tpu.ops.losses import cross_entropy_loss
 from pytorch_distributed_rnn_tpu.parallel.collectives import broadcast_from
 from pytorch_distributed_rnn_tpu.parallel.pp import pp_stacked_lstm
 from pytorch_distributed_rnn_tpu.parallel.sp import (
+    sp_stacked_gru,
     sp_stacked_lstm,
     sp_stacked_lstm_wavefront,
 )
 from pytorch_distributed_rnn_tpu.parallel.tp import (
     row_parallel_head,
+    tp_stacked_gru,
     tp_stacked_lstm,
 )
 
@@ -74,7 +77,11 @@ def parse_mesh_spec(spec: str) -> dict[str, int]:
 
 
 def validate_rnn_mesh(axes: dict[str, int], cell: str = "lstm"):
-    """Reject mesh specs the RNN kernels cannot run."""
+    """Reject mesh specs the RNN kernels cannot run.
+
+    LSTM runs on every axis; GRU on sp (sequential relay) and tp
+    (gate-sharded); the GPipe pp stage runner is LSTM-specific.
+    """
     model_axes = [a for a in MODEL_AXES if axes.get(a, 1) > 1]
     if len(model_axes) > 1:
         raise ValueError(
@@ -82,19 +89,32 @@ def validate_rnn_mesh(axes: dict[str, int], cell: str = "lstm"):
             f"{model_axes} (the attention family composes dp x sp x tp, "
             f"see parallel/combined.py)"
         )
-    if model_axes and cell != "lstm":
+    if model_axes and cell not in ("lstm", "gru"):
+        raise ValueError(f"unknown cell {cell!r}")
+    if model_axes == ["pp"] and cell != "lstm":
         raise ValueError(
-            f"sp/tp/pp RNN kernels are LSTM-specific, got cell={cell!r}"
+            f"the pp stage runner is LSTM-specific, got cell={cell!r}"
         )
     return model_axes[0] if model_axes else None
+
+
+def _sp_stack(cell: str, schedule: str):
+    """The sp relay stack for a cell: the wavefront schedule is
+    LSTM-structured, so GRU always relays layer-sequentially."""
+    if cell == "gru":
+        return sp_stacked_gru
+    return (
+        sp_stacked_lstm_wavefront if schedule == "wavefront"
+        else sp_stacked_lstm
+    )
 
 
 def mesh_rnn_forward(params, x, *, sp=None, tp=None, pp=None,
                      schedule: str = "wavefront", num_microbatches: int = 4,
                      unroll: int = 1, dropout: float = 0.0,
-                     dropout_key=None):
-    """Motion-model forward (stacked LSTM -> last-step head) for use INSIDE
-    a ``shard_map`` program where the named axes are bound.
+                     dropout_key=None, cell: str = "lstm"):
+    """Motion-model forward (stacked LSTM/GRU -> last-step head) for use
+    INSIDE a ``shard_map`` program where the named axes are bound.
 
     ``x`` (B_local, T, in) arrives dp-local and replicated over the model
     axes; logits (B_local, out) return replicated over the model axes (so
@@ -111,17 +131,16 @@ def mesh_rnn_forward(params, x, *, sp=None, tp=None, pp=None,
             raise ValueError(f"seq len {t} not divisible by sp={n}")
         t_local = t // n
         x_loc = lax.dynamic_slice_in_dim(x, k * t_local, t_local, axis=1)
-        stack = (
-            sp_stacked_lstm_wavefront if schedule == "wavefront"
-            else sp_stacked_lstm
+        out_local, _ = _sp_stack(cell, schedule)(
+            params["rnn"], x_loc, sp, unroll=unroll
         )
-        out_local, _ = stack(params["rnn"], x_loc, sp, unroll=unroll)
         last = out_local[:, -1, :]  # true last step on shard n-1 only
         logits = last @ params["fc"]["weight"].T + params["fc"]["bias"]
         return broadcast_from(logits, sp, n - 1)
 
     if tp is not None:
-        out, _ = tp_stacked_lstm(params["rnn"], x, tp, unroll=unroll)
+        stack = tp_stacked_gru if cell == "gru" else tp_stacked_lstm
+        out, _ = stack(params["rnn"], x, tp, unroll=unroll)
         return row_parallel_head(params["fc"], out[:, -1, :], tp)
 
     if pp is not None:
@@ -134,7 +153,7 @@ def mesh_rnn_forward(params, x, *, sp=None, tp=None, pp=None,
 
     from pytorch_distributed_rnn_tpu.ops.rnn import stacked_rnn
 
-    out, _ = stacked_rnn(params["rnn"], x, "lstm", unroll=unroll,
+    out, _ = stacked_rnn(params["rnn"], x, cell, unroll=unroll,
                          impl="scan", dropout=dropout,
                          dropout_key=dropout_key)
     return out[:, -1, :] @ params["fc"]["weight"].T + params["fc"]["bias"]
@@ -146,7 +165,7 @@ def mesh_rnn_forward(params, x, *, sp=None, tp=None, pp=None,
 
 def char_mesh_loss(params, tokens, *, sp=None, tp=None, pp=None,
                    schedule: str = "wavefront", num_microbatches: int = 4,
-                   unroll: int = 1, dp: str = "dp"):
+                   unroll: int = 1, dp: str = "dp", cell: str = "lstm"):
     """Next-token loss for a CharRNN params tree inside a mesh program.
 
     ``tokens`` (B_local, T) int32, replicated over the model axes.  With
@@ -169,11 +188,9 @@ def char_mesh_loss(params, tokens, *, sp=None, tp=None, pp=None,
         tok_loc = lax.dynamic_slice_in_dim(tokens, k * t_local, t_local,
                                            axis=1)
         x_loc = params["embed"][tok_loc]
-        stack = (
-            sp_stacked_lstm_wavefront if schedule == "wavefront"
-            else sp_stacked_lstm
+        out_local, _ = _sp_stack(cell, schedule)(
+            params["rnn"], x_loc, sp, unroll=unroll
         )
-        out_local, _ = stack(params["rnn"], x_loc, sp, unroll=unroll)
         logits = out_local @ head_w.T + head_b  # (B, t_local, V)
         # targets: global position p predicts token p+1; the final global
         # position is padding (weight 0).  tokens are replicated, so the
@@ -196,7 +213,8 @@ def char_mesh_loss(params, tokens, *, sp=None, tp=None, pp=None,
 
     x = params["embed"][tokens[:, :-1]]
     if tp is not None:
-        out, _ = tp_stacked_lstm(params["rnn"], x, tp, unroll=unroll)
+        stack = tp_stacked_gru if cell == "gru" else tp_stacked_lstm
+        out, _ = stack(params["rnn"], x, tp, unroll=unroll)
         # row-parallel per-timestep head: shard the hidden dim, one psum
         ntp = lax.axis_size(tp)
         ktp = lax.axis_index(tp)
@@ -218,7 +236,7 @@ def char_mesh_loss(params, tokens, *, sp=None, tp=None, pp=None,
     else:
         from pytorch_distributed_rnn_tpu.ops.rnn import stacked_rnn
 
-        out, _ = stacked_rnn(params["rnn"], x, "lstm", unroll=unroll,
+        out, _ = stacked_rnn(params["rnn"], x, cell, unroll=unroll,
                              impl="scan")
         logits = out @ head_w.T + head_b
 
@@ -229,16 +247,16 @@ def char_mesh_loss(params, tokens, *, sp=None, tp=None, pp=None,
     return lax.pmean(loss, dp)
 
 
-def _axis_kwargs(axes: dict[str, int]):
+def _axis_kwargs(axes: dict[str, int], cell: str = "lstm"):
     """{"sp": "sp" or None, ...} for the single active model axis."""
-    model_axis = validate_rnn_mesh(axes)
+    model_axis = validate_rnn_mesh(axes, cell)
     return {a: (a if a == model_axis else None) for a in MODEL_AXES}
 
 
 def make_char_mesh_train_step(optimizer, mesh, axes: dict[str, int], *,
                               schedule: str = "wavefront",
                               num_microbatches: int = 4, unroll: int = 1,
-                              donate: bool = True):
+                              donate: bool = True, cell: str = "lstm"):
     """Jitted char-LM training step over a composed mesh.
 
     ``step(params, opt_state, tokens)`` with ``tokens`` (B, T) sharded
@@ -251,7 +269,7 @@ def make_char_mesh_train_step(optimizer, mesh, axes: dict[str, int], *,
     that re-reduce replicated-parameter cotangents - taking grad inside
     would double-count replicated pieces and drop cross-shard terms.
     """
-    kw = _axis_kwargs(axes)
+    kw = _axis_kwargs(axes, cell)
 
     from functools import partial as _partial
 
@@ -265,7 +283,8 @@ def make_char_mesh_train_step(optimizer, mesh, axes: dict[str, int], *,
     def loss_fn(params, tokens):
         return char_mesh_loss(
             params, tokens, schedule=schedule,
-            num_microbatches=num_microbatches, unroll=unroll, **kw,
+            num_microbatches=num_microbatches, unroll=unroll, cell=cell,
+            **kw,
         )
 
     def step(params, opt_state, tokens):
@@ -284,7 +303,8 @@ def make_char_mesh_train_step(optimizer, mesh, axes: dict[str, int], *,
 def make_motion_mesh_loss_fn(mesh, axes: dict[str, int], *,
                              schedule: str = "wavefront",
                              num_microbatches: int = 4, unroll: int = 1,
-                             weighted: bool = False, dropout: float = 0.0):
+                             weighted: bool = False, dropout: float = 0.0,
+                             cell: str = "lstm"):
     """Shard_mapped ``loss_fn(params, x, y[, w][, key]) -> (loss,
     metrics)`` for the motion model over a composed mesh: ``x``/``y`` (and
     ``w``) shard their batch dim over ``dp``; the scalar loss and summed
@@ -294,7 +314,7 @@ def make_motion_mesh_loss_fn(mesh, axes: dict[str, int], *,
     ``dropout > 0`` (dp-only meshes; the trainer guards the model axes)
     appends a trailing replicated per-step PRNG key argument; each dp
     shard folds its rank in for an independent mask."""
-    kw = _axis_kwargs(axes)
+    kw = _axis_kwargs(axes, cell)
 
     from functools import partial as _partial
 
@@ -317,7 +337,7 @@ def make_motion_mesh_loss_fn(mesh, axes: dict[str, int], *,
         logits = mesh_rnn_forward(
             params, x, schedule=schedule,
             num_microbatches=num_microbatches, unroll=unroll,
-            dropout=dropout, dropout_key=key, **kw,
+            dropout=dropout, dropout_key=key, cell=cell, **kw,
         )
         if weighted:
             w = extra[0]

@@ -39,7 +39,10 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 from jax import shard_map
 
-from pytorch_distributed_rnn_tpu.ops.rnn import lstm_input_proj
+from pytorch_distributed_rnn_tpu.ops.rnn import (
+    gru_input_proj,
+    lstm_input_proj,
+)
 
 
 def shard_gates(w, n: int, k, num_gates: int = 4):
@@ -110,6 +113,64 @@ def tp_stacked_lstm(layers, x, axis: str, *, unroll: int = 1):
     out = x
     for layer in layers:
         out, final = tp_lstm_layer(layer, out, axis, unroll=unroll)
+        finals.append(final)
+    return out, finals
+
+
+def tp_gru_layer(params, x, axis: str, *, unroll: int = 1):
+    """One GRU layer with the hidden dimension sharded over ``axis``.
+
+    Same layout as :func:`tp_lstm_layer` with 3 gates (r, z, n): each
+    shard owns H/n rows of every gate, computes its gate slice from the
+    all-gathered full ``h`` (the one per-step collective), and emits its
+    H/n slice of the new state.  torch semantics preserved: the
+    hidden-side n-bias joins inside the ``r *`` product, sliced like the
+    weights.
+    """
+    n = lax.axis_size(axis)
+    k = lax.axis_index(axis)
+    hidden = params["w_hh"].shape[1]
+    per = hidden // n
+    batch = x.shape[0]
+    dtype = x.dtype
+
+    local = {
+        "w_ih": shard_gates(params["w_ih"], n, k, num_gates=3),
+        "w_hh": shard_gates(params["w_hh"], n, k, num_gates=3),  # (3H/n, H)
+        "b_ih": shard_gates(params["b_ih"], n, k, num_gates=3),
+        "b_hh": shard_gates(params["b_hh"], n, k, num_gates=3),
+    }
+    x_proj = gru_input_proj(local, x)                # (B, T, 3H/n)
+    w_hh_l_t = local["w_hh"].T                       # (H, 3H/n)
+    b_hh_l = local["b_hh"]
+
+    def step(h_local, xp_t):
+        h_full = lax.all_gather(h_local, axis, axis=1, tiled=True)
+        h_proj = h_full @ w_hh_l_t + b_hh_l          # (B, 3H/n)
+        xr, xz, xn = jnp.split(xp_t, 3, axis=-1)
+        hr, hz, hn = jnp.split(h_proj, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        new = jnp.tanh(xn + r * hn)
+        h_local = (1.0 - z) * new + z * h_local
+        return h_local, h_local
+
+    h0 = jnp.zeros((batch, per), dtype)
+    h_t, out_local = lax.scan(
+        step, h0, jnp.swapaxes(x_proj, 0, 1), unroll=unroll
+    )
+    out_local = jnp.swapaxes(out_local, 0, 1)        # (B, T, H/n)
+    outputs = lax.all_gather(out_local, axis, axis=2, tiled=True)
+    h_t = lax.all_gather(h_t, axis, axis=1, tiled=True)
+    return outputs, h_t
+
+
+def tp_stacked_gru(layers, x, axis: str, *, unroll: int = 1):
+    """Stack of :func:`tp_gru_layer`; returns (outputs, [finals])."""
+    finals = []
+    out = x
+    for layer in layers:
+        out, final = tp_gru_layer(layer, out, axis, unroll=unroll)
         finals.append(final)
     return out, finals
 

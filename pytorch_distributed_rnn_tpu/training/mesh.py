@@ -63,6 +63,7 @@ class MeshTrainer(SpmdTrainer):
             self.mesh, self.mesh_axes, schedule=self.schedule,
             num_microbatches=self.num_microbatches, weighted=weighted,
             dropout=self._dropout,
+            cell=getattr(self.model, "cell", "lstm"),
         )
 
     def _build_train_step(self):

@@ -52,10 +52,13 @@ class TestMeshSpec:
     def test_validate_rnn_mesh(self):
         assert validate_rnn_mesh({"dp": 2, "sp": 4}) == "sp"
         assert validate_rnn_mesh({"dp": 8}) is None
+        # GRU runs on sp (sequential relay) and tp (gate-sharded)
+        assert validate_rnn_mesh({"tp": 2}, cell="gru") == "tp"
+        assert validate_rnn_mesh({"sp": 2}, cell="gru") == "sp"
         with pytest.raises(ValueError, match="at most ONE"):
             validate_rnn_mesh({"dp": 1, "sp": 2, "tp": 2})
         with pytest.raises(ValueError, match="LSTM-specific"):
-            validate_rnn_mesh({"tp": 2}, cell="gru")
+            validate_rnn_mesh({"pp": 2}, cell="gru")
 
 
 @pytest.fixture(scope="module")
@@ -111,6 +114,49 @@ class TestMeshTrainerEquivalence:
             datasets,
         )
         assert history == pytest.approx(ref_history, rel=1e-4)
+
+    @pytest.mark.parametrize("axes", [
+        {"dp": 2, "sp": 2},
+        {"dp": 2, "tp": 2},
+    ], ids=["gru_dp_sp", "gru_dp_tp"])
+    def test_gru_mesh_matches_gru_ddp(self, datasets, axes):
+        """GRU trains on sp/tp meshes with the same numerics as GRU DDP."""
+        def gru_model():
+            return MotionModel(input_dim=9, hidden_dim=8, layer_dim=2,
+                               output_dim=6, impl="scan", cell="gru")
+
+        ref = DDPTrainer(
+            model=gru_model(), training_set=datasets, batch_size=24,
+            learning_rate=2.5e-3, seed=SEED,
+            mesh=make_mesh({"dp": 2}, devices=jax.devices()[:2]),
+        )
+        ref_params, ref_history, _ = ref.train(epochs=2)
+
+        trainer = MeshTrainer(
+            mesh_axes=axes, model=gru_model(), training_set=datasets,
+            batch_size=24, learning_rate=2.5e-3, seed=SEED,
+        )
+        params, history, _ = trainer.train(epochs=2)
+        assert history == pytest.approx(ref_history, rel=1e-4)
+        assert leaves_sum(params) == pytest.approx(
+            leaves_sum(ref_params), rel=1e-5
+        )
+
+    def test_gru_char_mesh_loss_matches_model(self):
+        model = CharRNN(vocab_size=17, embed_dim=8, hidden_dim=8,
+                        layer_dim=2, impl="scan", cell="gru")
+        params = model.init(jax.random.PRNGKey(0))
+        opt = optax.adam(1e-2)
+        axes = {"dp": 2, "sp": 2}
+        mesh = make_mesh(axes)
+        step = make_char_mesh_train_step(opt, mesh, axes, donate=False,
+                                         cell="gru")
+        rng = np.random.RandomState(0)
+        tokens = jnp.asarray(rng.randint(0, 17, size=(8, 16)), jnp.int32)
+        _, _, loss = step(params, opt.init(params), tokens)
+        assert float(loss) == pytest.approx(
+            float(model.loss(params, tokens)), rel=1e-5
+        )
 
     def test_dp_only_mesh_supports_dropout(self, datasets):
         """The CLI-default --dropout 0.1 must work on a dp-only mesh
