@@ -52,6 +52,17 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--seed", default=None, type=int)
     parser.add_argument("--no-validation", action="store_true")
     parser.add_argument("--cell", default="lstm", choices=["lstm", "gru"])
+    parser.add_argument(
+        "--model", default="rnn", choices=["rnn", "attention"],
+        help="model family: stacked RNN (reference parity) or the "
+        "attention classifier (long-context family; composes the full "
+        "dp x sp x tp mesh under the mesh strategy)",
+    )
+    parser.add_argument(
+        "--num-heads", default=4, type=int,
+        help="attention heads (--model attention; must divide "
+        "--hidden-units)",
+    )
     parser.add_argument("--resume", default=None, type=Path)
     parser.add_argument(
         "--checkpoint-every", default=0, type=int, metavar="N",

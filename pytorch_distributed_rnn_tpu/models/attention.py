@@ -96,6 +96,14 @@ class AttentionClassifier:
     output_dim: int = 6
     max_len: int = 4096
 
+    def __post_init__(self):
+        if self.dim % self.num_heads != 0:
+            raise ValueError(
+                f"dim {self.dim} must be divisible by num_heads "
+                f"{self.num_heads} (head splitting would silently "
+                f"truncate projections)"
+            )
+
     def init(self, key: jax.Array):
         ks = jax.random.split(key, self.depth + 3)
         return {

@@ -103,6 +103,20 @@ def tp_sp_block(blk, h, num_heads: int, *, sp_axis: str, tp_axis: str,
     return h + lax.psum(u @ fc2_l.T, tp_axis) + blk["fc2"]["bias"]
 
 
+def attention_mesh_logits(params, x_local, num_heads: int, *,
+                          sp_axis: str = "sp", tp_axis: str = "tp",
+                          causal: bool = False):
+    """The composed sp x tp forward for an AttentionClassifier params
+    tree, for use INSIDE a shard_map where both axes are bound (size 1 is
+    fine).  ``x_local``: this shard's (B_local, T_local, in) chunk;
+    logits return replicated over sp and tp."""
+    h = sp_embed_prologue(params, x_local, sp_axis)
+    for blk in params["blocks"]:
+        h = tp_sp_block(blk, h, num_heads, sp_axis=sp_axis,
+                        tp_axis=tp_axis, causal=causal)
+    return _linear(params["head"], sp_mean_pool(h, sp_axis))
+
+
 def make_3d_loss_fn(model, mesh, *, dp_axis: str = "dp", sp_axis: str = "sp",
                     tp_axis: str = "tp", causal: bool = False):
     """Replicated-scalar loss for an AttentionClassifier over a
@@ -117,11 +131,10 @@ def make_3d_loss_fn(model, mesh, *, dp_axis: str = "dp", sp_axis: str = "sp",
         check_vma=False,
     )
     def loss_fn(params, x_local, y_local):
-        h = sp_embed_prologue(params, x_local, sp_axis)
-        for blk in params["blocks"]:
-            h = tp_sp_block(blk, h, model.num_heads, sp_axis=sp_axis,
-                            tp_axis=tp_axis, causal=causal)
-        logits = _linear(params["head"], sp_mean_pool(h, sp_axis))
+        logits = attention_mesh_logits(
+            params, x_local, model.num_heads, sp_axis=sp_axis,
+            tp_axis=tp_axis, causal=causal,
+        )
         return lax.pmean(cross_entropy_loss(logits, y_local), dp_axis)
 
     return loss_fn

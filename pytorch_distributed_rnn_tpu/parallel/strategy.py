@@ -339,16 +339,72 @@ def make_motion_mesh_loss_fn(mesh, axes: dict[str, int], *,
             num_microbatches=num_microbatches, unroll=unroll,
             dropout=dropout, dropout_key=key, cell=cell, **kw,
         )
-        if weighted:
-            w = extra[0]
-            nll = cross_entropy_loss(logits, y, reduction="none")
-            local = jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)
-            correct = jnp.sum(
-                (jnp.argmax(logits, axis=1) == y) * (w > 0)
+        local, correct = _classifier_loss_metrics(
+            logits, y, extra[0] if weighted else None
+        )
+        return (
+            lax.pmean(local, "dp"),
+            {"correct": lax.psum(correct, "dp")},
+        )
+
+    return loss_fn
+
+
+def _classifier_loss_metrics(logits, y, w=None):
+    """The one (loss, correct) block shared by the motion and attention
+    mesh losses: local mean loss + correct count, optionally 0/1-weighted
+    (the fused whole-run path's padding mask)."""
+    if w is not None:
+        nll = cross_entropy_loss(logits, y, reduction="none")
+        local = jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)
+        correct = jnp.sum((jnp.argmax(logits, axis=1) == y) * (w > 0))
+    else:
+        local = cross_entropy_loss(logits, y)
+        correct = jnp.sum(jnp.argmax(logits, axis=1) == y)
+    return local, correct
+
+
+def make_attention_mesh_loss_fn(model, mesh, *, weighted: bool = False):
+    """Shard_mapped ``loss_fn(params, x, y[, w]) -> (loss, metrics)`` for
+    an :class:`AttentionClassifier` over a FULL dp x sp x tp mesh (any
+    axis may have size 1): batch rows shard over ``dp``, time over ``sp``
+    (ring attention rotates K/V blocks over the sp ring), heads + MLP
+    hidden over ``tp`` (Megatron column/row sharding, one psum each).
+
+    This is ``parallel/combined.py``'s composed program surfaced with the
+    trainer loss/metrics contract, so the shared Trainer loop drives the
+    full 3D composition from the CLI (``mesh --model attention --mesh
+    dp=2,sp=2,tp=2``).
+    """
+    from functools import partial as _partial
+
+    from pytorch_distributed_rnn_tpu.parallel.combined import (
+        attention_mesh_logits,
+    )
+
+    for axis in ("dp", "sp", "tp"):
+        if axis not in mesh.shape:
+            raise ValueError(
+                f"attention mesh needs axis {axis!r} (size 1 is fine); "
+                f"got {dict(mesh.shape)}"
             )
-        else:
-            local = cross_entropy_loss(logits, y)
-            correct = jnp.sum(jnp.argmax(logits, axis=1) == y)
+
+    batch_specs = (P("dp", "sp"), P("dp")) + (
+        (P("dp"),) if weighted else ()
+    )
+
+    @_partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(),) + batch_specs,
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    def loss_fn(params, x_local, y_local, *w):
+        logits = attention_mesh_logits(params, x_local, model.num_heads)
+        local, correct = _classifier_loss_metrics(
+            logits, y_local, w[0] if weighted else None
+        )
         return (
             lax.pmean(local, "dp"),
             {"correct": lax.psum(correct, "dp")},

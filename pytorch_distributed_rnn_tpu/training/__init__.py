@@ -99,18 +99,36 @@ def train(args, trainer_class):
         logging.info(f"Validation set of size {len(validation_set)}")
         logging.info(f"Test set of size {len(test_set)}")
 
-    model = MotionModel(
-        input_dim=training_set.num_features,
-        hidden_dim=args.hidden_units,
-        layer_dim=args.stacked_layer,
-        output_dim=len(MotionDataset.LABELS),
-        cell=getattr(args, "cell", "lstm"),
-        precision=getattr(args, "precision", "f32"),
-        remat=getattr(args, "remat", False),
-        # real (train-mode) dropout - the reference parses but never uses
-        # --dropout (/root/reference/src/motion/main.py:26)
-        dropout=getattr(args, "dropout", 0.0) or 0.0,
-    )
+    if getattr(args, "model", "rnn") == "attention":
+        if getattr(args, "dropout", 0.0):
+            # loud like the mesh strategies: a silently-ignored dropout
+            # flag is exactly the reference quirk PARITY.md fixes
+            raise SystemExit(
+                "--model attention has no dropout - pass --dropout 0 "
+                "(the CLI default 0.1 mirrors the reference surface)"
+            )
+        from pytorch_distributed_rnn_tpu.models import AttentionClassifier
+
+        model = AttentionClassifier(
+            input_dim=training_set.num_features,
+            dim=args.hidden_units,
+            depth=args.stacked_layer,
+            num_heads=getattr(args, "num_heads", 4),
+            output_dim=len(MotionDataset.LABELS),
+        )
+    else:
+        model = MotionModel(
+            input_dim=training_set.num_features,
+            hidden_dim=args.hidden_units,
+            layer_dim=args.stacked_layer,
+            output_dim=len(MotionDataset.LABELS),
+            cell=getattr(args, "cell", "lstm"),
+            precision=getattr(args, "precision", "f32"),
+            remat=getattr(args, "remat", False),
+            # real (train-mode) dropout - the reference parses but never
+            # uses --dropout (/root/reference/src/motion/main.py:26)
+            dropout=getattr(args, "dropout", 0.0) or 0.0,
+        )
 
     trainer = trainer_class(
         model=model,

@@ -41,9 +41,26 @@ class MeshTrainer(SpmdTrainer):
         if "dp" not in axes:
             axes = {"dp": 1, **axes}
         model = kwargs["model"]
-        self.model_axis = validate_rnn_mesh(
-            axes, getattr(model, "cell", "lstm")
-        )
+        # the attention family composes the FULL dp x sp x tp mesh (ring
+        # attention over sp, Megatron sharding over tp); RNN cells take dp
+        # plus at most one model axis
+        self.is_attention = hasattr(model, "num_heads")
+        if self.is_attention:
+            if axes.get("pp", 1) > 1:
+                raise ValueError(
+                    "the attention family has no pipeline stages; use "
+                    "sp/tp (e.g. --mesh dp=2,sp=2,tp=2)"
+                )
+            axes.pop("pp", None)
+            # every axis name must exist in the mesh for the composed
+            # program; unused axes get size 1
+            axes = {"dp": axes.get("dp", 1), "sp": axes.get("sp", 1),
+                    "tp": axes.get("tp", 1)}
+            self.model_axis = None
+        else:
+            self.model_axis = validate_rnn_mesh(
+                axes, getattr(model, "cell", "lstm")
+            )
         self.mesh_axes = axes
         self.schedule = schedule
         self.num_microbatches = num_microbatches
@@ -59,6 +76,14 @@ class MeshTrainer(SpmdTrainer):
             )
 
     def _mesh_loss_fn(self, weighted: bool):
+        if self.is_attention:
+            from pytorch_distributed_rnn_tpu.parallel.strategy import (
+                make_attention_mesh_loss_fn,
+            )
+
+            return make_attention_mesh_loss_fn(
+                self.model, self.mesh, weighted=weighted
+            )
         return make_motion_mesh_loss_fn(
             self.mesh, self.mesh_axes, schedule=self.schedule,
             num_microbatches=self.num_microbatches, weighted=weighted,

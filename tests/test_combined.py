@@ -67,7 +67,8 @@ def test_3d_training_converges(setup):
 
 def test_3d_tp_indivisible_heads_raises(setup):
     mesh, _, params, x, y = setup
-    bad = AttentionClassifier(input_dim=IN, dim=32, depth=2, num_heads=3,
+    # 3 heads divide dim (valid model) but do not shard over tp=2
+    bad = AttentionClassifier(input_dim=IN, dim=30, depth=2, num_heads=3,
                               output_dim=6, max_len=T)
     with pytest.raises(ValueError, match="do not shard over tp"):
         jax.jit(make_3d_loss_fn(bad, mesh))(bad.init(jax.random.PRNGKey(3)),

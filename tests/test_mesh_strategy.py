@@ -226,6 +226,79 @@ class TestCharMeshStep:
         assert float(loss) < first * 0.8
 
 
+class TestAttentionMesh:
+    """Full dp x sp x tp composition behind the mesh strategy."""
+
+    def _model(self):
+        from pytorch_distributed_rnn_tpu.models import AttentionClassifier
+
+        return AttentionClassifier(input_dim=9, dim=16, depth=2,
+                                   num_heads=4, output_dim=6, max_len=64)
+
+    def test_3d_mesh_matches_single_device(self, datasets):
+        """MeshTrainer on dp=2,sp=2,tp=2 reproduces the plain single-mesh
+        trainer's numerics for the attention model."""
+        ref = DDPTrainer(
+            model=self._model(), training_set=datasets, batch_size=24,
+            learning_rate=2.5e-3, seed=SEED,
+            mesh=make_mesh({"dp": 2}, devices=jax.devices()[:2]),
+        )
+        ref_params, ref_history, _ = ref.train(epochs=2)
+
+        trainer = MeshTrainer(
+            mesh_axes={"dp": 2, "sp": 2, "tp": 2}, model=self._model(),
+            training_set=datasets, batch_size=24, learning_rate=2.5e-3,
+            seed=SEED,
+        )
+        assert trainer.is_attention
+        params, history, _ = trainer.train(epochs=2)
+        assert history == pytest.approx(ref_history, rel=1e-3)
+        assert leaves_sum(params) == pytest.approx(
+            leaves_sum(ref_params), rel=1e-4
+        )
+
+    def test_pp_rejected_for_attention(self, datasets):
+        with pytest.raises(ValueError, match="no pipeline stages"):
+            MeshTrainer(
+                mesh_axes={"dp": 2, "pp": 2}, model=self._model(),
+                training_set=datasets, batch_size=24,
+                learning_rate=2.5e-3, seed=SEED,
+            )
+
+
+@pytest.mark.slow
+def test_cli_attention_3d_mesh_end_to_end(tmp_path):
+    """``main.py --model attention mesh --mesh dp=2,sp=2,tp=2`` trains
+    through the real CLI on the 8-device mesh."""
+    import os
+    from pathlib import Path
+
+    env = dict(os.environ)
+    repo_root = str(Path(__file__).resolve().parents[1])
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo_root, env.get("PYTHONPATH")) if p
+    )
+    data_dir = tmp_path / "data"
+    subprocess.run(
+        [sys.executable, "-m", "pytorch_distributed_rnn_tpu.launcher",
+         "prepare-data", "--dataset-path", str(data_dir),
+         "--num-train", "192", "--num-test", "32"],
+        check=True, capture_output=True, text=True, env=env,
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytorch_distributed_rnn_tpu.main",
+         "--dataset-path", str(data_dir),
+         "--checkpoint-directory", str(tmp_path / "models"),
+         "--epochs", "1", "--batch-size", "48", "--seed", str(SEED),
+         "--dropout", "0", "--model", "attention", "--hidden-units", "16",
+         "--no-validation", "--log", "INFO",
+         "mesh", "--mesh", "dp=2,sp=2,tp=2"],
+        capture_output=True, text=True, cwd=tmp_path, timeout=600, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "Memory Usage" in proc.stderr
+
+
 @pytest.mark.slow
 def test_cli_mesh_subcommand_end_to_end(tmp_path):
     """``main.py ... mesh --mesh dp=2,sp=2`` trains on the 8-device CPU
