@@ -38,6 +38,7 @@ from pytorch_distributed_rnn_tpu.parallel.pp import (
 from pytorch_distributed_rnn_tpu.parallel.ep import (
     ep_moe_ffn,
     make_ep_moe_forward,
+    make_ep_train_step,
 )
 from pytorch_distributed_rnn_tpu.parallel.multihost import (
     global_device_mesh,
@@ -95,6 +96,7 @@ __all__ = [
     "pp_stacked_lstm",
     "ep_moe_ffn",
     "make_ep_moe_forward",
+    "make_ep_train_step",
     "initialize_multihost",
     "global_device_mesh",
     "process_info",

@@ -77,6 +77,43 @@ def ep_moe_ffn(params, x_local, axis: str, *, capacity_factor: float = 2.0):
     return out.reshape(shape), aux
 
 
+def make_ep_train_step(optimizer, mesh, axis: str = "ep", *,
+                       capacity_factor: float = 2.0,
+                       aux_weight: float = 0.01, donate: bool = True):
+    """Jitted expert-parallel MoE *training* step (regression shape):
+    ``step(params, opt_state, x, y)`` with ``x``/``y`` (N, D) sharded
+    along ``axis``; loss = global MSE + aux_weight * Switch aux loss.
+
+    Grad is taken OUTSIDE the shard_mapped loss (the combined.py
+    pattern), so the two ``all_to_all``s transpose into the reverse
+    dispatch/combine exchanges and replicated-parameter cotangents
+    re-reduce correctly - EP is a trainable strategy, not just a forward
+    factory.
+    """
+    import optax
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P(axis)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def loss_fn(params, x_local, y_local):
+        out, aux = ep_moe_ffn(params, x_local, axis,
+                              capacity_factor=capacity_factor)
+        local = jnp.mean((out - y_local) ** 2)
+        return lax.pmean(local, axis) + aux_weight * aux
+
+    def step(params, opt_state, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+
 def make_ep_moe_forward(mesh, axis: str = "ep", *,
                         capacity_factor: float = 2.0):
     """Jitted expert-parallel MoE FFN: tokens (N, D) sharded along ``axis``

@@ -353,7 +353,14 @@ def make_motion_mesh_loss_fn(mesh, axes: dict[str, int], *,
 def _classifier_loss_metrics(logits, y, w=None):
     """The one (loss, correct) block shared by the motion and attention
     mesh losses: local mean loss + correct count, optionally 0/1-weighted
-    (the fused whole-run path's padding mask)."""
+    (the fused whole-run path's padding mask).
+
+    Weighted contract: the caller pmean's the LOCAL weighted means over
+    ``dp``, which equals the global weighted mean only when every dp
+    shard carries the same number of live (w>0) examples.  The trainers
+    guarantee this - ``SpmdTrainer._pad_batch`` pads each rank's chunk
+    independently (rank-equal live counts; see its docstring) - so do
+    NOT feed this path batches padded only at the global tail."""
     if w is not None:
         nll = cross_entropy_loss(logits, y, reduction="none")
         local = jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)

@@ -100,12 +100,24 @@ def train(args, trainer_class):
         logging.info(f"Test set of size {len(test_set)}")
 
     if getattr(args, "model", "rnn") == "attention":
+        # loud, never silent: a silently-ignored flag is exactly the
+        # reference quirk PARITY.md fixes (main.py:26 --dropout)
         if getattr(args, "dropout", 0.0):
-            # loud like the mesh strategies: a silently-ignored dropout
-            # flag is exactly the reference quirk PARITY.md fixes
             raise SystemExit(
                 "--model attention has no dropout - pass --dropout 0 "
                 "(the CLI default 0.1 mirrors the reference surface)"
+            )
+        unsupported = [
+            flag for flag, active in (
+                ("--precision bf16", getattr(args, "precision", "f32") != "f32"),
+                ("--remat", getattr(args, "remat", False)),
+                ("--cell gru", getattr(args, "cell", "lstm") != "lstm"),
+            ) if active
+        ]
+        if unsupported:
+            raise SystemExit(
+                f"--model attention does not support: "
+                f"{', '.join(unsupported)}"
             )
         from pytorch_distributed_rnn_tpu.models import AttentionClassifier
 
