@@ -8,6 +8,9 @@ Tasks (mirroring ``/root/reference/fabfile.py`` Fabric tasks):
                     when the real UCI HAR download is absent)
   run-debug         single seeded 1-epoch run (``run_debug``)
   run-all           full shuffled benchmark sweep (``run_all``)
+  run-chip          real-chip local rows at the three sweep batch sizes
+                    (the committed results_baseline_*.json re-run analogue;
+                    defaults to --backend native)
   run-slots         real multi-slot sweep (processes-per-host dimension)
   run-hosts         multi-host jax.distributed world over SSH
                     (--hosts h1:2,h2:2; the mpirun --host analogue;
@@ -65,6 +68,10 @@ def main(argv=None):
     for task in ("run-debug", "run-all", "show-commands"):
         p = sub.add_parser(task)
         _add_common(p)
+
+    p = sub.add_parser("run-chip")
+    _add_common(p)
+    p.set_defaults(backend="native")  # real attached accelerator
 
     p = sub.add_parser("run-network-test")
     _add_common(p)
@@ -139,6 +146,8 @@ def main(argv=None):
 
     if args.task == "run-debug":
         run = bench.DEBUG_RUN
+    elif args.task == "run-chip":
+        run = bench.CHIP_RUN
     elif args.task == "run-all":
         run = bench.BENCHMARK_RUN
     elif args.task == "run-slots":

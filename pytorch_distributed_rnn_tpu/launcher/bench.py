@@ -77,6 +77,18 @@ DEBUG_RUN = {
     "parameters": dict(BASE_PARAMETERS),
 }
 
+# Real-chip rows (the reference's committed results_baseline_{1,2,3}.json
+# re-runs, /root/reference: local trainer at the three sweep batch sizes):
+# run with --backend native so the trainer uses the attached accelerator
+# instead of the virtual-device study platform.
+CHIP_RUN = {
+    "trainers": ["local"],
+    "devices": [1],
+    "slots": [1],
+    "batch_sizes": [480, 960, 1440],
+    "parameters": dict(BASE_PARAMETERS),
+}
+
 # fabfile.py:130-191: delays 0-400 ms, loss 0-15 %.
 NETWORK_RULES = [
     ("delay", 0.0),

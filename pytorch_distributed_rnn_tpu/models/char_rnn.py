@@ -18,6 +18,7 @@ from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from pytorch_distributed_rnn_tpu.ops.initializers import linear_init
 from pytorch_distributed_rnn_tpu.ops.losses import cross_entropy_loss
@@ -78,6 +79,84 @@ class CharRNN:
         return cross_entropy_loss(
             logits.reshape(-1, self.vocab_size), targets.reshape(-1)
         )
+
+    def generate(self, params, prompt: jax.Array, length: int,
+                 key: jax.Array | None = None,
+                 temperature: float = 1.0) -> jax.Array:
+        """Autoregressive sampling: ``prompt (B, Tp) int32 ->
+        (B, Tp + length)``.
+
+        The prompt is consumed in one batched ``stacked_rnn`` pass (the
+        MXU-friendly prefill), whose per-layer final carries seed a
+        ``lax.scan`` decode loop of single-token cell steps - the
+        compiler-friendly shape for autoregression on TPU (static trip
+        count, no growing buffers).  ``temperature=0`` is greedy argmax
+        (deterministic, no key needed); otherwise tokens are drawn from
+        ``softmax(logits / temperature)``.  Generation runs in f32
+        regardless of ``precision`` - decode is latency-bound, not
+        MXU-bound, and sampling is sensitive to logit rounding.
+        """
+        from pytorch_distributed_rnn_tpu.ops.rnn import gru_step, lstm_step
+
+        if temperature < 0.0:
+            raise ValueError("temperature must be >= 0")
+        if prompt.ndim != 2 or prompt.shape[1] < 1:
+            raise ValueError(
+                "prompt must be (batch, >=1 tokens); an empty prompt has "
+                "no last-step logits to seed decoding"
+            )
+        greedy = temperature == 0.0
+        if key is None:
+            if not greedy:
+                raise ValueError("sampling (temperature > 0) needs a key")
+            key = jax.random.PRNGKey(0)  # unused by the greedy path
+
+        x = params["embed"][prompt]
+        outputs, finals = stacked_rnn(
+            params["rnn"], x, self.cell, unroll=self.unroll, impl=self.impl,
+        )
+        logits0 = (
+            outputs[:, -1, :].astype(jnp.float32) @ params["head"]["weight"].T
+            + params["head"]["bias"]
+        )
+
+        def pick(k, logits):
+            if greedy:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return jax.random.categorical(
+                k, logits / temperature, axis=-1
+            ).astype(jnp.int32)
+
+        def decode_step(carry, _):
+            carries, logits, k = carry
+            k, k_samp = jax.random.split(k)
+            tok = pick(k_samp, logits)
+            h_in = params["embed"][tok]
+            new_carries = []
+            for layer, state in zip(params["rnn"], carries):
+                if self.cell == "lstm":
+                    xp = (h_in @ layer["w_ih"].T + layer["b_ih"]
+                          + layer["b_hh"])
+                    state = jax.tree.map(
+                        lambda s: s.astype(jnp.float32), state)
+                    (h, c), h_in = lstm_step(layer["w_hh"].T, state, xp)
+                    new_carries.append((h, c))
+                else:  # gru
+                    xp = h_in @ layer["w_ih"].T + layer["b_ih"]
+                    h, h_in = gru_step(
+                        layer["w_hh"].T, layer["b_hh"],
+                        state.astype(jnp.float32), xp)
+                    new_carries.append(h)
+            logits = (
+                h_in.astype(jnp.float32) @ params["head"]["weight"].T
+                + params["head"]["bias"]
+            )
+            return (new_carries, logits, k), tok
+
+        _, sampled = lax.scan(
+            decode_step, (finals, logits0, key), None, length=length
+        )
+        return jnp.concatenate([prompt, sampled.T], axis=1)
 
 
 def char_rnn_50m(impl: str = "auto", precision: str = "f32",

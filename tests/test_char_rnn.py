@@ -93,3 +93,68 @@ def test_dp_training(small_model):
         params, opt_state, loss, _ = step(params, opt_state, (tokens,))
         first = first if first is not None else float(loss)
     assert float(loss) < first
+
+
+# ---------------------------------------------------------------------------
+# Generation / sampling
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("cell", ["lstm", "gru"])
+def test_greedy_generate_matches_stepwise_apply(cell):
+    """The scan decode loop must agree with naive full re-application:
+    greedy-decoding k tokens one at a time via ``apply`` (recomputing the
+    whole prefix each step) is the ground truth the carry-threading decode
+    must reproduce exactly."""
+    model = CharRNN(vocab_size=VOCAB, embed_dim=16, hidden_dim=24,
+                    layer_dim=2, cell=cell, impl="scan")
+    params = model.init(jax.random.PRNGKey(1))
+    prompt = jnp.asarray(
+        np.random.RandomState(0).randint(0, VOCAB, size=(3, 7)), jnp.int32)
+
+    out = model.generate(params, prompt, length=6, temperature=0.0)
+    assert out.shape == (3, 13)
+    assert bool(jnp.all(out[:, :7] == prompt))
+
+    ref = prompt
+    for _ in range(6):
+        logits = model.apply(params, ref)[:, -1, :]
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        ref = jnp.concatenate([ref, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_sampled_generate_is_seeded_and_in_vocab():
+    model = CharRNN(vocab_size=VOCAB, embed_dim=16, hidden_dim=24,
+                    layer_dim=1, impl="scan")
+    params = model.init(jax.random.PRNGKey(2))
+    prompt = jnp.zeros((2, 4), jnp.int32)
+
+    a = model.generate(params, prompt, length=8,
+                       key=jax.random.PRNGKey(7), temperature=1.0)
+    b = model.generate(params, prompt, length=8,
+                       key=jax.random.PRNGKey(7), temperature=1.0)
+    c = model.generate(params, prompt, length=8,
+                       key=jax.random.PRNGKey(8), temperature=1.0)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+    assert int(a.min()) >= 0 and int(a.max()) < VOCAB
+
+
+def test_generate_rejects_bad_args():
+    model = CharRNN(vocab_size=VOCAB, embed_dim=8, hidden_dim=8,
+                    layer_dim=1, impl="scan")
+    params = model.init(jax.random.PRNGKey(0))
+    prompt = jnp.zeros((1, 2), jnp.int32)
+    with pytest.raises(ValueError):
+        model.generate(params, prompt, length=2, temperature=-1.0)
+    with pytest.raises(ValueError):
+        model.generate(params, prompt, length=2, temperature=1.0)  # no key
+
+
+def test_generate_rejects_empty_prompt():
+    model = CharRNN(vocab_size=VOCAB, embed_dim=8, hidden_dim=8,
+                    layer_dim=1, impl="scan")
+    params = model.init(jax.random.PRNGKey(0))
+    with pytest.raises(ValueError):
+        model.generate(params, jnp.zeros((2, 0), jnp.int32), length=2,
+                       temperature=0.0)

@@ -159,6 +159,17 @@ def test_expand_benchmark_sweep():
     assert seeds == {123456789}
 
 
+def test_expand_chip_sweep_runs_on_attached_accelerator():
+    from pytorch_distributed_rnn_tpu.launcher.bench import CHIP_RUN
+
+    configs = expand_run_configs(CHIP_RUN, backend="native")
+    assert len(configs) == 3  # local x 1 device x {480, 960, 1440}
+    for c in configs:
+        assert (c.trainer, c.devices, c.backend) == ("local", 1, "native")
+        _, env = get_command(c)
+        assert "PDRNN_PLATFORM" not in env  # no virtual-device override
+
+
 def _fake_executor(log_list):
     def executor(config, timeout=None):
         log_list.append(config)
