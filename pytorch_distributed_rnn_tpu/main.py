@@ -70,6 +70,12 @@ def build_parser() -> argparse.ArgumentParser:
         "(0 = best-model-only, the reference's trigger)",
     )
     parser.add_argument(
+        "--grad-accum", default=1, type=int, metavar="K",
+        help="accumulate gradients over K equal microbatches per optimizer "
+        "step (local trainer; batch sizes must divide by K) - the "
+        "activation-memory lever for batches that do not fit HBM",
+    )
+    parser.add_argument(
         "--precision", default="f32", choices=["f32", "bf16"],
         help="bf16: bfloat16 compute (full MXU rate, half the HBM "
         "traffic) with f32 parameters and optimizer state",

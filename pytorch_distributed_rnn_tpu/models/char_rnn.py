@@ -96,7 +96,12 @@ class CharRNN:
         regardless of ``precision`` - decode is latency-bound, not
         MXU-bound, and sampling is sensitive to logit rounding.
         """
-        from pytorch_distributed_rnn_tpu.ops.rnn import gru_step, lstm_step
+        from pytorch_distributed_rnn_tpu.ops.rnn import (
+            gru_input_proj,
+            gru_step,
+            lstm_input_proj,
+            lstm_step,
+        )
 
         if temperature < 0.0:
             raise ValueError("temperature must be >= 0")
@@ -134,15 +139,16 @@ class CharRNN:
             h_in = params["embed"][tok]
             new_carries = []
             for layer, state in zip(params["rnn"], carries):
+                # single-timestep slice through the shared projection
+                # helpers (the one definition of the bias-folding rules)
                 if self.cell == "lstm":
-                    xp = (h_in @ layer["w_ih"].T + layer["b_ih"]
-                          + layer["b_hh"])
+                    xp = lstm_input_proj(layer, h_in[:, None, :])[:, 0]
                     state = jax.tree.map(
                         lambda s: s.astype(jnp.float32), state)
                     (h, c), h_in = lstm_step(layer["w_hh"].T, state, xp)
                     new_carries.append((h, c))
                 else:  # gru
-                    xp = h_in @ layer["w_ih"].T + layer["b_ih"]
+                    xp = gru_input_proj(layer, h_in[:, None, :])[:, 0]
                     h, h_in = gru_step(
                         layer["w_hh"].T, layer["b_hh"],
                         state.astype(jnp.float32), xp)

@@ -124,6 +124,9 @@ def run_worker(args, rank: int):
             worker_rank=rank,
             num_workers=args.world_size - 1,
             seed=args.seed,
+            # forwarded so the unsupported-flag guard raises instead of
+            # the flag being silently dropped
+            grad_accum=getattr(args, "grad_accum", 1),
         )
         _, train_history, _ = trainer.train(epochs=args.epochs)
         trainer.finish()

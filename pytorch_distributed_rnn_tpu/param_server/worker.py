@@ -35,6 +35,7 @@ class ParameterServerWorkerTrainer(Trainer):
     # every step pushes gradients / pulls params over TCP: the host must
     # act per batch, so the scanned device-resident epoch path cannot apply
     DEVICE_DATA = False
+    SUPPORTS_GRAD_ACCUM = False  # grads are computed by its own push step
 
     def __init__(
         self,
@@ -46,6 +47,7 @@ class ParameterServerWorkerTrainer(Trainer):
         worker_rank: int,
         num_workers: int,
         seed: int | None = None,
+        grad_accum: int = 1,
     ):
         sampler = DistributedSampler(
             len(training_set),
@@ -64,6 +66,7 @@ class ParameterServerWorkerTrainer(Trainer):
             checkpoint_dir=None,  # checkpointing disabled on PS workers
             sampler=sampler,
             seed=seed,
+            grad_accum=grad_accum,
         )
         self.comm = comm
         self.worker_rank = worker_rank

@@ -152,6 +152,7 @@ def train(args, trainer_class):
         checkpoint_dir=args.checkpoint_directory,
         seed=args.seed,
         checkpoint_every=getattr(args, "checkpoint_every", 0),
+        grad_accum=getattr(args, "grad_accum", 1),
     )
 
     if getattr(args, "resume", None):

@@ -60,6 +60,10 @@ class Trainer:
     """
 
     DEVICE_DATA = True
+    # strategies whose step programs are built by external factories
+    # (SPMD pmean steps, native-TCP DDP, PS workers) flip this off until
+    # they implement microbatch accumulation themselves
+    SUPPORTS_GRAD_ACCUM = True
 
     def __init__(
         self,
@@ -73,6 +77,7 @@ class Trainer:
         sampler=None,
         seed: int | None = None,
         checkpoint_every: int = 0,
+        grad_accum: int = 1,
     ):
         self.model = model
         self.checkpoint_dir = Path(checkpoint_dir) if checkpoint_dir else None
@@ -90,6 +95,19 @@ class Trainer:
         self.test_set = test_set
         self.batch_size = batch_size
         self.learning_rate = learning_rate
+        # HBM lever: split each optimizer batch into `grad_accum` equal
+        # microbatches, accumulate grads, apply ONE update - the effective
+        # batch keeps the CLI batch-size semantics while peak activation
+        # memory shrinks by ~grad_accum (how the 50M-LM preset reaches
+        # batch sizes whose single-shot activations do not fit).
+        self.grad_accum = 1 if grad_accum is None else int(grad_accum)
+        if self.grad_accum < 1:
+            raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
+        if self.grad_accum > 1 and not self.SUPPORTS_GRAD_ACCUM:
+            raise NotImplementedError(
+                f"{type(self).__name__} builds its train step outside "
+                "_make_grad_step and does not support grad_accum > 1"
+            )
 
         self.params = model.init(jax.random.PRNGKey(seed if seed is not None else 0))
         self.optimizer = self._get_optimizer(learning_rate)
@@ -158,17 +176,87 @@ class Trainer:
     def _make_grad_step(self, loss_and_metrics):
         """The shared grad+update body: ``step(params, opt_state, batch,
         *extra) -> (params, opt_state, loss, metrics)``; ``*extra`` is
-        forwarded to the loss fn (the weighted-run path's mask)."""
+        forwarded to the loss fn (the weighted-run path's mask).
 
-        def step(params, opt_state, batch, *extra):
+        With ``grad_accum > 1`` (plain, unweighted loss only) the batch is
+        reshaped into equal microbatches and scanned: grads and batch-mean
+        losses are averaged across microbatches before the single optimizer
+        update - numerically the full-batch mean/grad (up to float
+        reassociation), at ~1/grad_accum the activation memory.  A dropout
+        key in ``*extra`` is folded per microbatch (independent masks)."""
+        if self.grad_accum <= 1:
+
+            def step(params, opt_state, batch, *extra):
+                (loss, metrics), grads = jax.value_and_grad(
+                    loss_and_metrics, has_aux=True
+                )(params, batch, *extra)
+                updates, opt_state = self.optimizer.update(
+                    grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return params, opt_state, loss, metrics
+
+            return step
+
+        k_conf = self.grad_accum
+
+        def single_shot(params, opt_state, batch, *extra):
             (loss, metrics), grads = jax.value_and_grad(
                 loss_and_metrics, has_aux=True
             )(params, batch, *extra)
-            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             return params, opt_state, loss, metrics
 
-        return step
+        def accum_step(params, opt_state, batch, *extra):
+            n = batch[0].shape[0]
+            # the epoch's final partial batch (n = len(dataset) %
+            # batch_size) need not divide by k: use the largest divisor
+            # <= k_conf (worst case 1 = single shot) - the partial batch
+            # is smaller than the full ones, so its single-shot
+            # activations fit wherever the microbatched full ones did
+            k = next(d for d in range(k_conf, 0, -1) if n % d == 0)
+            if k == 1:
+                return single_shot(params, opt_state, batch, *extra)
+            micro = jax.tree.map(
+                lambda a: a.reshape(k, n // k, *a.shape[1:]), batch
+            )
+            keys = (
+                jax.vmap(lambda i: jax.random.fold_in(extra[0], i))(
+                    jnp.arange(k)
+                ),
+            ) if extra else ()
+
+            def body(carry, mb_in):
+                g_acc, l_acc, m_acc = carry
+                mb = mb_in[0] if extra else mb_in
+                e = (mb_in[1],) if extra else ()
+                (loss, metrics), grads = jax.value_and_grad(
+                    loss_and_metrics, has_aux=True
+                )(params, mb, *e)
+                g_acc = jax.tree.map(jnp.add, g_acc, grads)
+                m_acc = jax.tree.map(jnp.add, m_acc, metrics)
+                return (g_acc, l_acc + loss, m_acc), None
+
+            zeros_g = jax.tree.map(jnp.zeros_like, params)
+            first_mb = jax.tree.map(lambda a: a[0], micro)
+            zeros_m = jax.tree.map(
+                jnp.zeros_like,
+                jax.eval_shape(
+                    lambda p, b: loss_and_metrics(p, b)[1], params, first_mb
+                ),
+            )
+            xs = (micro, keys[0]) if extra else micro
+            (g_sum, l_sum, m_sum), _ = jax.lax.scan(
+                body, (zeros_g, jnp.zeros(()), zeros_m), xs
+            )
+            grads = jax.tree.map(lambda g: g / k, g_sum)
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, l_sum / k, m_sum
+
+        return accum_step
 
     def _build_train_step(self):
         """One fused XLA program: grad + update + metrics."""
@@ -347,6 +435,9 @@ class Trainer:
             and not (self._dropout > 0.0 and self._has_partial_batch())
             # periodic checkpointing needs the host at epoch boundaries
             and not (self.checkpoint_every and self.checkpoint_dir)
+            # the fused run's weighted loss (per-example mask) is not
+            # expressible as equal-microbatch accumulation
+            and self.grad_accum == 1
         )
 
         def train_inner():

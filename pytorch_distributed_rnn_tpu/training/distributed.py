@@ -41,6 +41,11 @@ from pytorch_distributed_rnn_tpu.training.formatter import TrainingMessageFormat
 class SpmdTrainer(Trainer):
     """Shared machinery for the mesh-data-parallel strategies."""
 
+    # grad accumulation lives in _make_grad_step; the SPMD step factories
+    # (parallel/dp.py) bypass it, so reject the flag instead of silently
+    # ignoring it
+    SUPPORTS_GRAD_ACCUM = False
+
     SYNC = "backward"
 
     def __init__(
@@ -56,6 +61,7 @@ class SpmdTrainer(Trainer):
         mesh=None,
         axis: str = "dp",
         checkpoint_every: int = 0,
+        grad_accum: int = 1,
     ):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.axis = axis
@@ -75,6 +81,7 @@ class SpmdTrainer(Trainer):
             sampler=sampler,
             seed=seed,
             checkpoint_every=checkpoint_every,
+            grad_accum=grad_accum,
         )
         self.world_size = world_size
         # single controller: one process reports as rank 0.  In a

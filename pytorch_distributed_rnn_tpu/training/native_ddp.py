@@ -47,6 +47,8 @@ log = logging.getLogger(__name__)
 class NativeDDPTrainer(Trainer):
     """One rank of a process-per-rank DDP world."""
 
+    SUPPORTS_GRAD_ACCUM = False  # builds its step around the TCP allreduce
+
     # gradients cross the host TCP transport every step, so the host must
     # act per batch (no scanned device-resident epoch program)
     DEVICE_DATA = False
@@ -62,6 +64,7 @@ class NativeDDPTrainer(Trainer):
         test_set=None,
         checkpoint_dir=None,
         seed: int | None = None,
+        grad_accum: int = 1,
     ):
         rank = comm.rank
         world = comm.world_size
@@ -80,6 +83,7 @@ class NativeDDPTrainer(Trainer):
             checkpoint_dir=checkpoint_dir if rank == 0 else None,
             sampler=sampler,
             seed=seed,
+            grad_accum=grad_accum,
         )
         self.comm = comm
         self.rank = rank
@@ -145,6 +149,9 @@ def run_rank(comm, args, model, datasets):
         learning_rate=args.learning_rate,
         checkpoint_dir=args.checkpoint_directory,
         seed=args.seed,
+        # forwarded so the unsupported-flag guard raises instead of the
+        # flag being silently dropped
+        grad_accum=getattr(args, "grad_accum", 1),
     )
     if getattr(args, "resume", None):
         meta = trainer.resume_from(args.resume)

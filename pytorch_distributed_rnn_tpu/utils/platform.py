@@ -49,8 +49,17 @@ def _enable_compile_cache(jax):
 
     ``PDRNN_COMPILE_CACHE_DIR`` overrides the location; ``off`` disables.
     Only compilations >= 1s are cached, so the many tiny test programs
-    don't churn the cache.
+    don't churn the cache.  Forced-CPU runs (``PDRNN_PLATFORM=cpu`` - the
+    virtual-device study/test platform) skip the cache unless a dir is set
+    explicitly: XLA:CPU AOT cache loads warn about compile-vs-host machine
+    feature tuning mismatches on every hit, and the hermetic suite doesn't
+    need cross-process reuse.
     """
+    if (
+        os.environ.get("PDRNN_PLATFORM") == "cpu"
+        and "PDRNN_COMPILE_CACHE_DIR" not in os.environ
+    ):
+        return
     # per-user default path: a world-shared fixed /tmp path would let one
     # local user's cache entries (compiled executables) be loaded by another
     uid = getattr(os, "getuid", lambda: 0)()

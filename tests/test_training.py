@@ -305,3 +305,79 @@ def test_profile_flag_writes_trace(tmp_path):
     )
     traces = list(trace_dir.rglob("*.xplane.pb"))
     assert traces, list(trace_dir.rglob("*"))
+
+
+class TestGradAccumulation:
+    """--grad-accum: K equal microbatches per optimizer step must match the
+    single-shot batch exactly (same mean loss/grads up to float
+    reassociation), and strategies whose steps bypass _make_grad_step must
+    reject the flag instead of silently ignoring it."""
+
+    def test_accum_matches_single_shot(self, datasets):
+        train, _, _ = datasets  # 192 examples; bs=48 -> 4 full batches
+        histories = {}
+        for accum in (1, 4):
+            trainer = Trainer(
+                small_model(), train, batch_size=48, learning_rate=2.5e-3,
+                seed=SEED, grad_accum=accum,
+            )
+            params, history, _ = trainer.train(epochs=2)
+            histories[accum] = (params, history)
+        p1, h1 = histories[1]
+        p4, h4 = histories[4]
+        np.testing.assert_allclose(h1, h4, rtol=2e-4)
+        for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p4)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-4)
+
+    def test_indivisible_batches_fall_back_to_largest_divisor(self, datasets):
+        """grad_accum that doesn't divide a batch (incl. the epoch's final
+        partial batch) accumulates over the largest divisor <= K instead of
+        failing; numerics still match single-shot (mean of equal-microbatch
+        means == full-batch mean)."""
+        train, _, _ = datasets  # 192 examples; bs=80 -> batches 80, 80, 32
+        histories = {}
+        for accum in (1, 3):  # 80 % 3 != 0 -> k=2; 32 -> k=2
+            trainer = Trainer(
+                small_model(), train, batch_size=80, learning_rate=2.5e-3,
+                seed=SEED, grad_accum=accum,
+            )
+            _, history, _ = trainer.train(epochs=2)
+            histories[accum] = history
+        np.testing.assert_allclose(histories[1], histories[3], rtol=2e-4)
+
+    def test_grad_accum_zero_rejected(self, datasets):
+        train, _, _ = datasets
+        with pytest.raises(ValueError, match="grad_accum"):
+            Trainer(
+                small_model(), train, batch_size=48, learning_rate=2.5e-3,
+                seed=SEED, grad_accum=0,
+            )
+
+    def test_spmd_strategies_reject_grad_accum(self, datasets):
+        train, _, _ = datasets
+        with pytest.raises(NotImplementedError):
+            DDPTrainer(
+                small_model(), train, batch_size=48, learning_rate=2.5e-3,
+                seed=SEED, mesh=make_mesh({"dp": 1}), grad_accum=2,
+            )
+
+    def test_cli_grad_accum_end_to_end(self, tmp_path, monkeypatch):
+        from pytorch_distributed_rnn_tpu.data.synthetic import (
+            write_synthetic_har_dataset,
+        )
+        from pytorch_distributed_rnn_tpu.main import main
+
+        data_dir = tmp_path / "data"
+        write_synthetic_har_dataset(data_dir, num_train=128, num_test=16,
+                                    seq_length=16)
+        monkeypatch.chdir(tmp_path)
+        main([
+            "--dataset-path", str(data_dir),
+            "--output-path", str(tmp_path),
+            "--checkpoint-directory", str(tmp_path),
+            "--epochs", "1", "--batch-size", "32", "--seed", "1",
+            "--no-validation", "--grad-accum", "2",
+            "local",
+        ])
+        assert (tmp_path / "history.json").exists()
