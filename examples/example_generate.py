@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Train a tiny char-LM and sample from it (new capability; the reference
+has no LM or inference path - its only model is the HAR classifier,
+``/root/reference/src/motion/model.py:4-17``).
+
+Trains ``CharRNN`` for a few hundred steps on a synthetic
+deterministic-successor token stream (each token's successor is fixed, so
+the LM can drive next-token loss to ~0), then decodes greedily and with
+temperature sampling from the learned model.  Greedy decoding must
+reproduce the successor chain - the printed check asserts it.
+"""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from pytorch_distributed_rnn_tpu.utils import apply_platform_overrides
+
+apply_platform_overrides()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from pytorch_distributed_rnn_tpu.models import CharRNN
+
+VOCAB = 32
+SEED = 0
+
+
+def successor(tok):
+    """The ground-truth next token: a fixed permutation of the vocab."""
+    return (7 * tok + 3) % VOCAB
+
+
+def main():
+    model = CharRNN(vocab_size=VOCAB, embed_dim=16, hidden_dim=64,
+                    layer_dim=1, impl="scan")
+    params = model.init(jax.random.PRNGKey(SEED))
+    opt = optax.adam(3e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(model.loss)(params, tokens)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    rng = np.random.RandomState(SEED)
+    loss = None
+    for i in range(300):
+        start = rng.randint(0, VOCAB, size=(16, 1)).astype(np.int32)
+        seq = [start]
+        for _ in range(24):
+            seq.append(successor(seq[-1]))
+        tokens = jnp.asarray(np.concatenate(seq, axis=1))
+        params, opt_state, loss = step(params, opt_state, tokens)
+    print(f"final next-token loss after 300 steps: {float(loss):.4f}")
+
+    prompt = jnp.asarray([[1, int(successor(1))]], jnp.int32)
+    greedy = np.asarray(model.generate(params, prompt, length=8,
+                                       temperature=0.0))[0]
+    expected = [1, successor(1)]
+    for _ in range(8):
+        expected.append(int(successor(expected[-1])))
+    print(f"greedy decode:   {greedy.tolist()}")
+    print(f"successor chain: {expected}")
+    assert greedy.tolist() == expected, "greedy decode diverged from the chain"
+
+    sampled = np.asarray(
+        model.generate(params, prompt, length=8,
+                       key=jax.random.PRNGKey(42), temperature=1.0)
+    )[0]
+    print(f"temperature 1.0: {sampled.tolist()}")
+    print("generation ok")
+
+
+if __name__ == "__main__":
+    main()

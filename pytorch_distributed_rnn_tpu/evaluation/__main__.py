@@ -23,6 +23,9 @@ def main(argv=None):
     parser.add_argument("results", nargs="+", help="results_*.json files")
     parser.add_argument("--csv", default=None, help="write scaling table CSV")
     parser.add_argument("--plot", default=None, help="write scaling figure")
+    parser.add_argument("--network-plot", default=None,
+                        help="write the delay/loss perturbation figure "
+                        "(needs results with fault rules)")
     parser.add_argument("--batch-size", type=int, default=None,
                         help="restrict the plot to one batch size")
     args = parser.parse_args(argv)
@@ -49,6 +52,11 @@ def main(argv=None):
 
         plot_scaling(df, args.plot, batch_size=args.batch_size)
         print(f"wrote {args.plot}")
+    if args.network_plot:
+        from pytorch_distributed_rnn_tpu.evaluation.plots import plot_network
+
+        plot_network(df, args.network_plot)
+        print(f"wrote {args.network_plot}")
     return 0
 
 

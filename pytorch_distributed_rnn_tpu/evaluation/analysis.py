@@ -47,7 +47,7 @@ def create_measurement_df(results) -> pd.DataFrame:
             results = json.load(f)
 
     rows = []
-    for run in results:
+    for run_id, run in enumerate(results):
         text = (run.get("stderr") or "") + "\n" + (run.get("stdout") or "")
         perf = parse_perf_lines(text)
         size_match = TRAIN_SIZE_RE.search(text)
@@ -59,6 +59,8 @@ def create_measurement_df(results) -> pd.DataFrame:
         for rank, memory, duration in perf:
             rows.append(
                 {
+                    "run": run_id,  # position in the results file: repeated
+                    # sweep runs of the same config stay distinguishable
                     "trainer": run.get("trainer"),
                     "devices": run.get("devices", 1),
                     "slots": run.get("slots", 1),

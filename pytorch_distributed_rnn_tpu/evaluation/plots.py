@@ -49,3 +49,39 @@ def plot_scaling(df, path, batch_size=None):
     fig.savefig(path, dpi=120)
     plt.close(fig)
     return path
+
+
+def plot_network(df, path):
+    """Write the network-perturbation figure (the Experiments_network.ipynb
+    plots): per-run training duration (slowest reporting rank - PS masters
+    never train, so rank 0 may not report) vs injected delay (ms) and loss
+    probability, mean over repeated runs, one panel per rule type.
+    Returns the figure path."""
+    # PS runs emit perf lines from worker ranks (the master never trains),
+    # so "the run's duration" is the slowest reporting rank, not rank 0
+    faulted = df[df["rule_type"].notna()]
+    if faulted.empty:
+        raise ValueError("no measurements with fault rules to plot")
+
+    rule_types = sorted(faulted["rule_type"].unique())
+    fig, axes = plt.subplots(1, len(rule_types), figsize=(5 * len(rule_types), 4))
+    if len(rule_types) == 1:
+        axes = [axes]
+    for ax, rule in zip(axes, rule_types):
+        sub = faulted[faulted["rule_type"] == rule]
+        for trainer, group in sub.groupby("trainer"):
+            # slowest rank within each run, then mean over repeated runs
+            # (same repeat handling as the scaling figure's aggregation)
+            per_run = group.groupby(["rule_value", "run"])["duration_s"].max()
+            agg = per_run.groupby("rule_value").mean().reset_index()
+            ax.plot(agg["rule_value"], agg["duration_s"], "o-", label=trainer)
+        ax.set_xlabel("delay (ms)" if rule == "delay" else "loss probability")
+        ax.set_ylabel("training duration (s)")
+        ax.set_title(f"injected {rule}")
+        ax.legend()
+        ax.grid(True, alpha=0.3)
+    fig.suptitle("network perturbation (native-transport fault injection)")
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+    return path

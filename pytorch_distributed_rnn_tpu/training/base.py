@@ -108,6 +108,16 @@ class Trainer:
                 f"{type(self).__name__} builds its train step outside "
                 "_make_grad_step and does not support grad_accum > 1"
             )
+        if self.grad_accum > 1 and batch_size % self.grad_accum:
+            # loud up front: silently running full batches at a smaller k
+            # would use ~k_actual/k x the activation memory the user sized
+            # for.  (The epoch's FINAL partial batch may still fall back to
+            # a smaller divisor - it is smaller than a full batch, so its
+            # memory never exceeds what the user asked for.)
+            raise ValueError(
+                f"batch_size {batch_size} is not divisible by "
+                f"grad_accum {self.grad_accum}"
+            )
 
         self.params = model.init(jax.random.PRNGKey(seed if seed is not None else 0))
         self.optimizer = self._get_optimizer(learning_rate)
@@ -184,20 +194,6 @@ class Trainer:
         update - numerically the full-batch mean/grad (up to float
         reassociation), at ~1/grad_accum the activation memory.  A dropout
         key in ``*extra`` is folded per microbatch (independent masks)."""
-        if self.grad_accum <= 1:
-
-            def step(params, opt_state, batch, *extra):
-                (loss, metrics), grads = jax.value_and_grad(
-                    loss_and_metrics, has_aux=True
-                )(params, batch, *extra)
-                updates, opt_state = self.optimizer.update(
-                    grads, opt_state, params)
-                params = optax.apply_updates(params, updates)
-                return params, opt_state, loss, metrics
-
-            return step
-
-        k_conf = self.grad_accum
 
         def single_shot(params, opt_state, batch, *extra):
             (loss, metrics), grads = jax.value_and_grad(
@@ -207,6 +203,11 @@ class Trainer:
                 grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             return params, opt_state, loss, metrics
+
+        if self.grad_accum <= 1:
+            return single_shot
+
+        k_conf = self.grad_accum
 
         def accum_step(params, opt_state, batch, *extra):
             n = batch[0].shape[0]

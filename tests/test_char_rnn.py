@@ -158,3 +158,11 @@ def test_generate_rejects_empty_prompt():
     with pytest.raises(ValueError):
         model.generate(params, jnp.zeros((2, 0), jnp.int32), length=2,
                        temperature=0.0)
+
+
+def test_example_generate_end_to_end():
+    """examples/example_generate.py: the LM learns the successor chain and
+    greedy decode reproduces it (asserted inside main)."""
+    from examples.example_generate import main
+
+    main()

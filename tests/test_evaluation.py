@@ -151,3 +151,37 @@ def test_cli_and_plot_round_trip(tmp_path):
 def test_plot_requires_measurements(tmp_path):
     with pytest.raises(ValueError):
         plot_scaling(create_measurement_df([]), tmp_path / "x.png")
+
+
+def test_network_plot_round_trip(tmp_path):
+    """plot_network renders delay/loss panels from fault-rule runs whose
+    perf lines come from worker ranks (PS masters never train), via the
+    CLI's --network-plot."""
+    results = [
+        _run("parameter-server", 2, 20.0, 300.0, rule_type="delay",
+             rule_value=v, ranks=3)
+        for v in (0.0, 100.0, 400.0)
+    ] + [
+        _run("parameter-server", 2, 22.0, 300.0, rule_type="loss",
+             rule_value=v, ranks=3)
+        for v in (0.05, 0.15)
+    ]
+    results_path = tmp_path / "results_network.json"
+    results_path.write_text(json.dumps(results))
+
+    from pytorch_distributed_rnn_tpu.evaluation.__main__ import main
+
+    png_path = tmp_path / "network.png"
+    rc = main([str(results_path), "--network-plot", str(png_path)])
+    assert rc == 0
+    assert png_path.exists() and png_path.stat().st_size > 0
+
+
+def test_network_plot_requires_fault_rules(tmp_path):
+    from pytorch_distributed_rnn_tpu.evaluation.plots import plot_network
+
+    with pytest.raises(ValueError):
+        plot_network(
+            create_measurement_df([_run("local", 1, 10.0, 100.0)]),
+            tmp_path / "x.png",
+        )

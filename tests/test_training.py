@@ -337,14 +337,25 @@ class TestGradAccumulation:
         means == full-batch mean)."""
         train, _, _ = datasets  # 192 examples; bs=80 -> batches 80, 80, 32
         histories = {}
-        for accum in (1, 3):  # 80 % 3 != 0 -> k=2; 32 -> k=2
+        for accum in (1, 5):  # full 80 % 5 == 0; partial 32 % 5 != 0 -> k=4
             trainer = Trainer(
                 small_model(), train, batch_size=80, learning_rate=2.5e-3,
                 seed=SEED, grad_accum=accum,
             )
             _, history, _ = trainer.train(epochs=2)
             histories[accum] = history
-        np.testing.assert_allclose(histories[1], histories[3], rtol=2e-4)
+        np.testing.assert_allclose(histories[1], histories[5], rtol=2e-4)
+
+    def test_indivisible_full_batch_rejected_up_front(self, datasets):
+        """A --batch-size the configured K does not divide would silently
+        run every full batch at a smaller k (more memory than the user
+        sized for) - rejected at construction instead."""
+        train, _, _ = datasets
+        with pytest.raises(ValueError, match="not divisible"):
+            Trainer(
+                small_model(), train, batch_size=80, learning_rate=2.5e-3,
+                seed=SEED, grad_accum=3,
+            )
 
     def test_grad_accum_zero_rejected(self, datasets):
         train, _, _ = datasets
