@@ -32,10 +32,16 @@ def main(argv=None):
 
     import pandas as pd
 
-    df = pd.concat(
-        [create_measurement_df(path) for path in args.results],
-        ignore_index=True,
-    )
+    # run ids restart at 0 in each results file: offset per file so
+    # repeats of the same config in different files stay distinct runs
+    frames, offset = [], 0
+    for path in args.results:
+        frame = create_measurement_df(path)
+        if not frame.empty:
+            frame["run"] = frame["run"] + offset
+            offset = int(frame["run"].max()) + 1
+        frames.append(frame)
+    df = pd.concat(frames, ignore_index=True)
     if df.empty:
         print("no perf lines found in the given results files")
         return 1

@@ -53,10 +53,17 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--no-validation", action="store_true")
     parser.add_argument("--cell", default="lstm", choices=["lstm", "gru"])
     parser.add_argument(
-        "--model", default="rnn", choices=["rnn", "attention"],
-        help="model family: stacked RNN (reference parity) or the "
+        "--model", default="rnn", choices=["rnn", "attention", "char"],
+        help="model family: stacked RNN (reference parity), the "
         "attention classifier (long-context family; composes the full "
-        "dp x sp x tp mesh under the mesh strategy)",
+        "dp x sp x tp mesh under the mesh strategy), or the byte-level "
+        "char LM (next-token loss on --dataset-path corpus.txt windows, "
+        "synthetic motif stream when absent)",
+    )
+    parser.add_argument(
+        "--seq-length", default=None, type=int, metavar="T",
+        help="token-window length for --model char (default 128); "
+        "motion/attention take their length from the HAR data",
     )
     parser.add_argument(
         "--num-heads", default=4, type=int,

@@ -45,4 +45,16 @@ def execute(args):
             "--profile is not supported by the parameter-server strategy "
             "(training runs in spawned worker processes)"
         )
+    if getattr(args, "model", "rnn") != "rnn":
+        # loud, never silent (the PARITY.md dead-flag principle): the PS
+        # runner builds the motion RNN itself
+        raise SystemExit(
+            "parameter-server trains the motion RNN family only - "
+            f"--model {args.model} is not wired here"
+        )
+    if getattr(args, "seq_length", None) is not None:
+        raise SystemExit(
+            "--seq-length only applies to --model char (not wired into "
+            "parameter-server)"
+        )
     return run(args)

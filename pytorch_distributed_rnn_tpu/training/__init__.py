@@ -72,6 +72,14 @@ def add_sub_commands(sub_parser):
             mesh_trainer_factory,
         )
 
+        if getattr(args, "model", "rnn") == "char":
+            # the mesh strategy's loss fns are built by the mesh-loss
+            # factories (motion/attention); wiring the LM there is future
+            # work - reject instead of training the wrong objective
+            raise SystemExit(
+                "--model char is not wired into the mesh strategy yet - "
+                "use local/distributed/horovod"
+            )
         return train(args, mesh_trainer_factory(args))
 
     mesh_p.set_defaults(func=_mesh)
@@ -84,20 +92,23 @@ def train(args, trainer_class):
     logging.basicConfig(level=args.log)
     logging.getLogger().setLevel(args.log)
 
-    training_set, validation_set, test_set = MotionDataset.load(
-        args.dataset_path,
-        output_path=args.output_path,
-        validation_fraction=args.validation_fraction,
-        seed=args.seed,
-    )
+    if getattr(args, "model", "rnn") == "char":
+        return _train_char_lm(args, trainer_class)
+    if getattr(args, "seq_length", None) is not None:
+        raise SystemExit(
+            "--seq-length only applies to --model char (motion/attention "
+            "sequence length is a property of the HAR data)"
+        )
 
-    logging.info(f"Training set of size {len(training_set)}")
-    if args.no_validation:
-        validation_set = None
-        test_set = None
-    else:
-        logging.info(f"Validation set of size {len(validation_set)}")
-        logging.info(f"Test set of size {len(test_set)}")
+    training_set, validation_set, test_set = _log_and_trim_datasets(
+        args,
+        *MotionDataset.load(
+            args.dataset_path,
+            output_path=args.output_path,
+            validation_fraction=args.validation_fraction,
+            seed=args.seed,
+        ),
+    )
 
     if getattr(args, "model", "rnn") == "attention":
         # loud, never silent: a silently-ignored flag is exactly the
@@ -142,6 +153,68 @@ def train(args, trainer_class):
             dropout=getattr(args, "dropout", 0.0) or 0.0,
         )
 
+    return _run_trainer(
+        args, trainer_class, model,
+        (training_set, validation_set, test_set),
+    )
+
+
+def _train_char_lm(args, trainer_class):
+    """``--model char``: byte-level LM on token windows - the stress family
+    (BASELINE.json config 5) as a first-class CLI citizen.  Same shared
+    loop and strategies; only the dataset and the loss surface differ
+    (``data/text.py``, ``training/lm.py``)."""
+    from pytorch_distributed_rnn_tpu.data.text import TextDataset
+    from pytorch_distributed_rnn_tpu.models import CharRNN
+    from pytorch_distributed_rnn_tpu.training.lm import wrap_lm_trainer
+
+    seq_length = getattr(args, "seq_length", None)
+    if seq_length is None:
+        seq_length = 128
+    elif seq_length < 1:
+        raise SystemExit(f"--seq-length must be >= 1, got {seq_length}")
+
+    training_set, validation_set, test_set = _log_and_trim_datasets(
+        args,
+        *TextDataset.load(
+            args.dataset_path,
+            seq_length=seq_length,
+            validation_fraction=args.validation_fraction,
+            seed=args.seed,
+        ),
+    )
+
+    model = CharRNN(
+        vocab_size=training_set.vocab_size,
+        embed_dim=args.hidden_units,
+        hidden_dim=args.hidden_units,
+        layer_dim=args.stacked_layer,
+        cell=getattr(args, "cell", "lstm"),
+        precision=getattr(args, "precision", "f32"),
+        remat=getattr(args, "remat", False),
+        dropout=getattr(args, "dropout", 0.0) or 0.0,
+    )
+    return _run_trainer(
+        args, wrap_lm_trainer(trainer_class), model,
+        (training_set, validation_set, test_set),
+    )
+
+
+def _log_and_trim_datasets(args, training_set, validation_set, test_set):
+    """Shared dataset logging + ``--no-validation`` trimming for every
+    model family's CLI path."""
+    logging.info(f"Training set of size {len(training_set)}")
+    if args.no_validation:
+        return training_set, None, None
+    logging.info(f"Validation set of size {len(validation_set)}")
+    logging.info(f"Test set of size {len(test_set)}")
+    return training_set, validation_set, test_set
+
+
+def _run_trainer(args, trainer_class, model, datasets):
+    """The strategy-independent tail of every CLI run: construct, resume,
+    (optionally trace,) train, dump rank-0 history."""
+    training_set, validation_set, test_set = datasets
     trainer = trainer_class(
         model=model,
         training_set=training_set,

@@ -41,6 +41,14 @@ from pytorch_distributed_rnn_tpu.training.formatter import TrainingMessageFormat
 from pytorch_distributed_rnn_tpu.utils.profiling import measure_memory_and_time
 
 
+def _correct_count(value) -> int:
+    """Host-side display form of the ``correct`` metric: classification
+    counts are exact integers; the LM's fractional per-sequence accuracy
+    sums (``training/lm.py``) ROUND for display instead of flooring (int()
+    would bias every printed accuracy downward)."""
+    return int(round(float(value)))
+
+
 class Trainer:
     """Single-replica ("local") trainer; distribution strategies subclass.
 
@@ -546,7 +554,7 @@ class Trainer:
                         batch_idx=batch_idx,
                         batches=len(batches),
                         training_examples=len(idx),
-                        correct=int(metrics["correct"]),
+                        correct=_correct_count(metrics["correct"]),
                         loss=float(loss),
                     )
                 )
@@ -582,7 +590,7 @@ class Trainer:
 
         # parity quirk kept: sum of batch-mean losses / dataset size
         train_loss = float(total_loss) / len(self.training_set)
-        train_acc = int(total_correct) / len(self.training_set)
+        train_acc = float(total_correct) / len(self.training_set)
         return train_loss, train_acc
 
     def _train_epoch_host(self, formatter):
@@ -612,12 +620,12 @@ class Trainer:
                         batch_idx=batch_idx,
                         batches=num_batches,
                         training_examples=len(features),
-                        correct=int(metrics["correct"]),
+                        correct=_correct_count(metrics["correct"]),
                         loss=float(loss),
                     )
                 )
         total_loss = float(total_loss)
-        total_correct = int(total_correct)
+        total_correct = float(total_correct)
         # parity quirk kept: sum of batch-mean losses / dataset size
         train_loss = total_loss / len(self.training_set)
         train_acc = total_correct / len(self.training_set)
@@ -637,12 +645,13 @@ class Trainer:
         batch = cached[1]
         loss, metrics = self._eval_step_fn(self.params, batch)
         eval_loss = float(loss)  # one batch -> already the mean-of-batches
-        total_correct = int(metrics["correct"])
+        total_correct = float(metrics["correct"])
         num_examples = len(dataset)
         accuracy = total_correct / num_examples
         logging.info(
             formatter.evaluation_message(
-                accuracy, num_examples, epoch, eval_loss, total_correct
+                accuracy, num_examples, epoch, eval_loss,
+                _correct_count(total_correct)
             )
         )
         return eval_loss, accuracy

@@ -223,6 +223,18 @@ def execute(args):
     from pytorch_distributed_rnn_tpu.models import MotionModel
     from pytorch_distributed_rnn_tpu.runtime.native import init_from_env
 
+    if getattr(args, "model", "rnn") != "rnn":
+        # loud, never silent (the PARITY.md dead-flag principle): this
+        # strategy builds the motion RNN itself
+        raise SystemExit(
+            "distributed-native trains the motion RNN family only - "
+            f"--model {args.model} is not wired here"
+        )
+    if getattr(args, "seq_length", None) is not None:
+        raise SystemExit(
+            "--seq-length only applies to --model char (not wired into "
+            "distributed-native)"
+        )
     logging.basicConfig(level=args.log)
     logging.getLogger().setLevel(args.log)
 

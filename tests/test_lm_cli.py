@@ -1,0 +1,155 @@
+"""--model char: the byte-level LM as a first-class CLI citizen
+(TextDataset windows, LM loss mixin over every shared-loop strategy)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_rnn_tpu.data.text import TextDataset
+from pytorch_distributed_rnn_tpu.models import CharRNN
+from pytorch_distributed_rnn_tpu.parallel import make_mesh
+from pytorch_distributed_rnn_tpu.training import DDPTrainer, Trainer
+from pytorch_distributed_rnn_tpu.training.lm import wrap_lm_trainer
+
+SEED = 123456789
+
+
+class TestTextDataset:
+    def test_corpus_file_windows_and_split(self, tmp_path):
+        corpus = tmp_path / "corpus.txt"
+        corpus.write_bytes(bytes(range(256)) * 40)  # 10240 bytes
+        train, valid, test = TextDataset.load(
+            tmp_path, seq_length=31, validation_fraction=0.1, seed=0
+        )
+        # 10240 // 32 = 320 windows -> 32 test, 32 valid, 256 train
+        assert (len(train), len(valid), len(test)) == (256, 32, 32)
+        assert train.features.shape == (256, 32)
+        assert train.seq_length == 31 and train.vocab_size == 256
+        # windows are contiguous byte runs of the cycling corpus
+        w = train.features[0]
+        assert bool(np.all((w[1:] - w[:-1]) % 256 == 1))
+
+    def test_direct_file_path_and_synthetic_fallback(self, tmp_path):
+        f = tmp_path / "anything.txt"
+        f.write_bytes(b"abcdefgh" * 100)
+        train, _, _ = TextDataset.load(f, seq_length=7, seed=0)
+        assert train.features.shape[1] == 8
+
+        train_syn, _, _ = TextDataset.load(
+            tmp_path / "missing", seq_length=15, seed=3,
+            synthetic_sequences=64,
+        )
+        assert train_syn.features.shape[1] == 16
+        # deterministic in seed
+        again, _, _ = TextDataset.load(
+            tmp_path / "missing", seq_length=15, seed=3,
+            synthetic_sequences=64,
+        )
+        np.testing.assert_array_equal(train_syn.features, again.features)
+
+    def test_too_short_corpus_raises(self, tmp_path):
+        f = tmp_path / "corpus.txt"
+        f.write_bytes(b"tiny")
+        with pytest.raises(ValueError, match="too short"):
+            TextDataset.load(tmp_path, seq_length=128)
+
+
+class TestLMLossMixin:
+    def _dataset(self, n=96, t=16):
+        rng = np.random.RandomState(0)
+        return TextDataset(rng.randint(0, 256, size=(n, t + 1)))
+
+    def test_weighted_matches_plain_with_ones(self):
+        train = self._dataset()
+        model = CharRNN(vocab_size=256, embed_dim=16, hidden_dim=16,
+                        layer_dim=1, impl="scan")
+        trainer = wrap_lm_trainer(Trainer)(
+            model, train, batch_size=32, learning_rate=1e-3, seed=SEED
+        )
+        batch = (jnp.asarray(train.features[:32]),
+                 jnp.asarray(train.labels[:32]))
+        loss_p, m_p = trainer._loss_and_metrics(trainer.params, batch)
+        loss_w, m_w = trainer._weighted_loss_and_metrics(
+            trainer.params, batch, jnp.ones(32)
+        )
+        np.testing.assert_allclose(float(loss_p), float(loss_w), rtol=1e-6)
+        np.testing.assert_allclose(
+            float(m_p["correct"]), float(m_w["correct"]), rtol=1e-6
+        )
+
+    def test_lm_ddp_matches_local_exactly(self):
+        """The LM loss under the SPMD DDP strategy reproduces local
+        single-replica training bit-for-bit (same global batch)."""
+        train = self._dataset()
+        model = CharRNN(vocab_size=256, embed_dim=16, hidden_dim=16,
+                        layer_dim=1, impl="scan")
+        local = wrap_lm_trainer(Trainer)(
+            model, train, batch_size=32, learning_rate=1e-3, seed=SEED
+        )
+        _, local_hist, _ = local.train(epochs=2)
+
+        ddp = wrap_lm_trainer(DDPTrainer)(
+            model, train, batch_size=32, learning_rate=1e-3, seed=SEED,
+            mesh=make_mesh({"dp": 4}),
+        )
+        _, ddp_hist, _ = ddp.train(epochs=2)
+        np.testing.assert_allclose(local_hist, ddp_hist, rtol=1e-5)
+
+
+class TestCharCLI:
+    def test_end_to_end_char_run(self, tmp_path, monkeypatch):
+        from pytorch_distributed_rnn_tpu.main import main
+
+        corpus = tmp_path / "corpus.txt"
+        corpus.write_bytes(bytes(range(256)) * 64)
+        monkeypatch.chdir(tmp_path)
+        main([
+            "--dataset-path", str(tmp_path),
+            "--output-path", str(tmp_path),
+            "--checkpoint-directory", str(tmp_path),
+            "--epochs", "2", "--batch-size", "64", "--seed", "1",
+            "--hidden-units", "24", "--stacked-layer", "1",
+            "--model", "char", "--seq-length", "31",
+            "local",
+        ])
+        history = json.loads((tmp_path / "history.json").read_text())
+        assert len(history["train_history"]) == 2
+        # byte-successor corpus: the LM must learn it fast
+        assert history["train_history"][-1] < history["train_history"][0]
+        assert (tmp_path / "best-model.ckpt").exists()
+
+    def test_seq_length_rejected_off_char(self, tmp_path):
+        from pytorch_distributed_rnn_tpu.main import main
+
+        with pytest.raises(SystemExit, match="seq-length"):
+            main([
+                "--dataset-path", str(tmp_path), "--epochs", "1",
+                "--seq-length", "32", "local",
+            ])
+
+    def test_model_flag_rejected_on_unwired_strategies(self, tmp_path,
+                                                       monkeypatch):
+        from pytorch_distributed_rnn_tpu.main import main
+
+        monkeypatch.setenv("MASTER_ADDR", "127.0.0.1")
+        monkeypatch.setenv("MASTER_PORT", "29999")
+        monkeypatch.setenv("RANK", "0")
+        monkeypatch.setenv("WORLD_SIZE", "1")
+        with pytest.raises(SystemExit, match="motion RNN family only"):
+            main([
+                "--dataset-path", str(tmp_path), "--epochs", "1",
+                "--model", "attention", "distributed-native",
+            ])
+        with pytest.raises(SystemExit, match="motion RNN family only"):
+            main([
+                "--dataset-path", str(tmp_path), "--epochs", "1",
+                "--model", "char", "parameter-server", "--world-size", "2",
+            ])
+        with pytest.raises(SystemExit, match="not wired into the mesh"):
+            main([
+                "--dataset-path", str(tmp_path), "--epochs", "1",
+                "--model", "char", "mesh",
+            ])
