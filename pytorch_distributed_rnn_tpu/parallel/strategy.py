@@ -163,16 +163,24 @@ def mesh_rnn_forward(params, x, *, sp=None, tp=None, pp=None,
 # Char-LM mesh training step (per-timestep head; the long-context story)
 # ---------------------------------------------------------------------------
 
-def char_mesh_loss(params, tokens, *, sp=None, tp=None, pp=None,
-                   schedule: str = "wavefront", num_microbatches: int = 4,
-                   unroll: int = 1, dp: str = "dp", cell: str = "lstm"):
-    """Next-token loss for a CharRNN params tree inside a mesh program.
+def _char_local_logits(params, tokens, *, sp=None, tp=None, pp=None,
+                       schedule: str = "wavefront",
+                       num_microbatches: int = 4, unroll: int = 1,
+                       cell: str = "lstm", compute_dtype=None,
+                       remat: bool = False, dropout: float = 0.0,
+                       dropout_key=None):
+    """The ONE char-LM mesh forward: ``(logits, targets, w_pos)``.
 
     ``tokens`` (B_local, T) int32, replicated over the model axes.  With
-    ``sp``, the time axis is sharded: each shard embeds + runs its chunk
-    through the relay stack, computes logits for its positions, and scores
-    them against the (replicated) next tokens; the weighted psum over sp
-    reassembles exactly the global mean over the T-1 predicted positions.
+    ``sp`` the time axis is sharded - each shard embeds + runs its chunk
+    through the relay stack and returns logits/targets for its LOCAL
+    positions, with ``w_pos`` (1, t_local) masking the one padding
+    position (the final global position predicts nothing); the shifted
+    target slice is local arithmetic because tokens are replicated, so no
+    boundary exchange is needed.  Without ``sp``: full-window logits
+    (B, T-1, V), ``w_pos`` None.  ``compute_dtype``/``remat``/``dropout``
+    apply on the unsharded branch only (the sp/tp/pp stacks are
+    f32-structured; callers reject those combinations loudly).
     """
     if sum(a is not None for a in (sp, tp, pp)) > 1:
         raise ValueError("compose dp with at most one of sp/tp/pp")
@@ -183,7 +191,11 @@ def char_mesh_loss(params, tokens, *, sp=None, tp=None, pp=None,
         n = lax.axis_size(sp)
         k = lax.axis_index(sp)
         if t % n != 0:
-            raise ValueError(f"seq len {t} not divisible by sp={n}")
+            raise ValueError(
+                f"char-LM window ({t} = seq_length + 1) not divisible by "
+                f"sp={n} - pick --seq-length so that sp divides "
+                f"seq_length + 1"
+            )
         t_local = t // n
         tok_loc = lax.dynamic_slice_in_dim(tokens, k * t_local, t_local,
                                            axis=1)
@@ -192,24 +204,14 @@ def char_mesh_loss(params, tokens, *, sp=None, tp=None, pp=None,
             params["rnn"], x_loc, sp, unroll=unroll
         )
         logits = out_local @ head_w.T + head_b  # (B, t_local, V)
-        # targets: global position p predicts token p+1; the final global
-        # position is padding (weight 0).  tokens are replicated, so the
-        # shifted slice is local arithmetic - no boundary exchange needed.
         shifted = jnp.concatenate(
             [tokens[:, 1:], tokens[:, -1:]], axis=1
         )
         tgt_loc = lax.dynamic_slice_in_dim(shifted, k * t_local, t_local,
                                            axis=1)
         pos = k * t_local + jnp.arange(t_local)
-        w = (pos < t - 1).astype(jnp.float32)[None, :]  # (1, t_local)
-        nll = cross_entropy_loss(
-            logits.reshape(-1, head_w.shape[0]),
-            tgt_loc.reshape(-1),
-            reduction="none",
-        ).reshape(tgt_loc.shape)
-        local_sum = jnp.sum(nll * w)
-        loss = lax.psum(local_sum, sp) / (tokens.shape[0] * (t - 1))
-        return lax.pmean(loss, dp)
+        w_pos = (pos < t - 1).astype(jnp.float32)[None, :]  # (1, t_local)
+        return logits, tgt_loc, w_pos
 
     x = params["embed"][tokens[:, :-1]]
     if tp is not None:
@@ -236,13 +238,41 @@ def char_mesh_loss(params, tokens, *, sp=None, tp=None, pp=None,
     else:
         from pytorch_distributed_rnn_tpu.ops.rnn import stacked_rnn
 
+        if compute_dtype is not None:
+            x = x.astype(compute_dtype)
         out, _ = stacked_rnn(params["rnn"], x, cell, unroll=unroll,
-                             impl="scan")
-        logits = out @ head_w.T + head_b
+                             impl="scan", compute_dtype=compute_dtype,
+                             remat=remat, dropout=dropout,
+                             dropout_key=dropout_key)
+        logits = out.astype(jnp.float32) @ head_w.T + head_b
 
-    targets = tokens[:, 1:]
+    return logits, tokens[:, 1:], None
+
+
+def char_mesh_loss(params, tokens, *, sp=None, tp=None, pp=None,
+                   schedule: str = "wavefront", num_microbatches: int = 4,
+                   unroll: int = 1, dp: str = "dp", cell: str = "lstm"):
+    """Next-token loss for a CharRNN params tree inside a mesh program:
+    the global mean over the window's T-1 predicted positions, assembled
+    by weighted psum over ``sp`` when the time axis is sharded."""
+    logits, targets, w_pos = _char_local_logits(
+        params, tokens, sp=sp, tp=tp, pp=pp, schedule=schedule,
+        num_microbatches=num_microbatches, unroll=unroll, cell=cell,
+    )
+    vocab = params["head"]["weight"].shape[0]
+    if w_pos is not None:
+        t = tokens.shape[1]
+        nll = cross_entropy_loss(
+            logits.reshape(-1, vocab), targets.reshape(-1),
+            reduction="none",
+        ).reshape(targets.shape)
+        loss = lax.psum(jnp.sum(nll * w_pos), sp) / (
+            tokens.shape[0] * (t - 1)
+        )
+        return lax.pmean(loss, dp)
+
     loss = cross_entropy_loss(
-        logits.reshape(-1, head_w.shape[0]), targets.reshape(-1)
+        logits.reshape(-1, vocab), targets.reshape(-1)
     )
     return lax.pmean(loss, dp)
 
@@ -294,6 +324,104 @@ def make_char_mesh_train_step(optimizer, mesh, axes: dict[str, int], *,
         return params, opt_state, loss
 
     return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+
+def _char_per_sequence_stats(params, tokens, *, sp=None, tp=None, pp=None,
+                             schedule: str = "wavefront",
+                             num_microbatches: int = 4, unroll: int = 1,
+                             cell: str = "lstm", compute_dtype=None,
+                             remat: bool = False, dropout: float = 0.0,
+                             dropout_key=None):
+    """Per-sequence LM statistics inside a mesh program: ``(nll, acc)``,
+    each ``(B_local,)`` - the mean over the window's T-1 predicted
+    positions, assembled across the model axis when the time dim is
+    sharded.  Per-SEQUENCE (not per-token) stats are what the weighted
+    fused-run path needs: its 0/1 mask weights whole (padded) sequences.
+    """
+    logits, targets, w_pos = _char_local_logits(
+        params, tokens, sp=sp, tp=tp, pp=pp, schedule=schedule,
+        num_microbatches=num_microbatches, unroll=unroll, cell=cell,
+        compute_dtype=compute_dtype, remat=remat, dropout=dropout,
+        dropout_key=dropout_key,
+    )
+    t = tokens.shape[1]
+    vocab = params["head"]["weight"].shape[0]
+    nll = cross_entropy_loss(
+        logits.reshape(-1, vocab), targets.reshape(-1), reduction="none"
+    ).reshape(targets.shape)
+    corr = (jnp.argmax(logits, axis=-1) == targets).astype(jnp.float32)
+    if w_pos is not None:  # sp: local positions, assembled by psum
+        per_seq_nll = lax.psum(jnp.sum(nll * w_pos, axis=1), sp) / (t - 1)
+        per_seq_acc = lax.psum(jnp.sum(corr * w_pos, axis=1), sp) / (t - 1)
+        return per_seq_nll, per_seq_acc
+    return jnp.mean(nll, axis=1), jnp.mean(corr, axis=1)
+
+
+def make_char_mesh_loss_fn(mesh, axes: dict[str, int], *,
+                           schedule: str = "wavefront",
+                           num_microbatches: int = 4, unroll: int = 1,
+                           weighted: bool = False, dropout: float = 0.0,
+                           cell: str = "lstm", precision: str = "f32",
+                           remat: bool = False):
+    """Shard_mapped ``loss_fn(params, tokens, y[, w][, key]) -> (loss,
+    metrics)`` for the char-LM over a composed mesh - the trainer-contract
+    sibling of :func:`make_motion_mesh_loss_fn` (same batch plumbing:
+    ``y`` is the dataset's dummy label column, accepted and ignored so the
+    shared loaders/epoch programs drive the LM unchanged).
+
+    ``metrics['correct']`` sums per-sequence mean token accuracy over the
+    GLOBAL batch (``training/lm.py`` semantics), so the shared loop's
+    ``correct / len(dataset)`` prints mean token accuracy.
+    """
+    kw = _axis_kwargs(axes, cell)
+    model_axis = next((a for a, v in kw.items() if v is not None), None)
+    if model_axis is not None and (precision != "f32" or remat):
+        # loud, never silent: the sp/tp/pp stacks are f32-structured, so
+        # honoring the flags is not possible - do not pretend to
+        raise ValueError(
+            f"--precision bf16/--remat are not supported on the {model_axis} "
+            "char mesh (f32-structured relay/stage kernels) - use a "
+            "dp-only mesh or drop the flag"
+        )
+    compute_dtype = jnp.bfloat16 if precision == "bf16" else None
+
+    from functools import partial as _partial
+
+    batch_specs = (P("dp"), P("dp")) + ((P("dp"),) if weighted else ())
+    key_specs = (P(),) if dropout > 0.0 else ()
+
+    @_partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(),) + batch_specs + key_specs,
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    def loss_fn(params, tokens, y, *extra):
+        if dropout > 0.0:
+            key = jax.random.fold_in(extra[-1], lax.axis_index("dp"))
+            extra = extra[:-1]
+        else:
+            key = None
+        per_seq_nll, per_seq_acc = _char_per_sequence_stats(
+            params, tokens, schedule=schedule,
+            num_microbatches=num_microbatches, unroll=unroll, cell=cell,
+            compute_dtype=compute_dtype, remat=remat,
+            dropout=dropout, dropout_key=key, **kw,
+        )
+        if weighted:
+            w = extra[0]
+            local = jnp.sum(per_seq_nll * w) / jnp.maximum(jnp.sum(w), 1.0)
+            correct = jnp.sum(per_seq_acc * (w > 0))
+        else:
+            local = jnp.mean(per_seq_nll)
+            correct = jnp.sum(per_seq_acc)
+        return (
+            lax.pmean(local, "dp"),
+            {"correct": lax.psum(correct, "dp")},
+        )
+
+    return loss_fn
 
 
 # ---------------------------------------------------------------------------

@@ -72,14 +72,6 @@ def add_sub_commands(sub_parser):
             mesh_trainer_factory,
         )
 
-        if getattr(args, "model", "rnn") == "char":
-            # the mesh strategy's loss fns are built by the mesh-loss
-            # factories (motion/attention); wiring the LM there is future
-            # work - reject instead of training the wrong objective
-            raise SystemExit(
-                "--model char is not wired into the mesh strategy yet - "
-                "use local/distributed/horovod"
-            )
         return train(args, mesh_trainer_factory(args))
 
     mesh_p.set_defaults(func=_mesh)
@@ -194,8 +186,12 @@ def _train_char_lm(args, trainer_class):
         remat=getattr(args, "remat", False),
         dropout=getattr(args, "dropout", 0.0) or 0.0,
     )
+    if getattr(trainer_class, "OWNS_LM_LOSS", False):
+        lm_trainer_class = trainer_class  # mesh factory: LM loss wired in
+    else:
+        lm_trainer_class = wrap_lm_trainer(trainer_class)
     return _run_trainer(
-        args, wrap_lm_trainer(trainer_class), model,
+        args, lm_trainer_class, model,
         (training_set, validation_set, test_set),
     )
 

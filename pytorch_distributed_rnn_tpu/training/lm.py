@@ -30,13 +30,9 @@ class LMLossMixin:
     """
 
     def _lm_logits_and_targets(self, params, tokens, key):
-        inputs = tokens[:, :-1]
-        if key is None or self._dropout <= 0.0:
-            logits = self.model.apply(params, inputs)
-        else:
-            logits = self.model.apply(
-                params, inputs, dropout_key=self._fold_rank(key)
-            )
+        # _apply_model supplies the shared dropout-key gating (train-mode
+        # only, per-rank fold in SPMD subclasses)
+        logits = self._apply_model(params, tokens[:, :-1], key)
         return logits.astype(jnp.float32), tokens[:, 1:]
 
     def _loss_and_metrics(self, params, batch, key=None):

@@ -42,9 +42,10 @@ class MeshTrainer(SpmdTrainer):
             axes = {"dp": 1, **axes}
         model = kwargs["model"]
         # the attention family composes the FULL dp x sp x tp mesh (ring
-        # attention over sp, Megatron sharding over tp); RNN cells take dp
-        # plus at most one model axis
+        # attention over sp, Megatron sharding over tp); RNN cells (motion
+        # classifier and char-LM alike) take dp plus at most one model axis
         self.is_attention = hasattr(model, "num_heads")
+        self.is_char = hasattr(model, "vocab_size")
         if self.is_attention:
             if axes.get("pp", 1) > 1:
                 raise ValueError(
@@ -68,6 +69,25 @@ class MeshTrainer(SpmdTrainer):
         # resolve -1 ("all remaining devices") to the actual size
         self.mesh_axes = {name: mesh.shape[name] for name in axes}
         super().__init__(mesh=mesh, axis="dp", **kwargs)
+        if self.is_char and self.model_axis == "sp":
+            window = self.training_set.features.shape[1]
+            sp_size = self.mesh_axes["sp"]
+            if window % sp_size:
+                raise ValueError(
+                    f"char-LM window ({window} = seq_length + 1) not "
+                    f"divisible by sp={sp_size} - pick --seq-length so "
+                    f"that sp divides seq_length + 1"
+                )
+        if self.is_char and self.model_axis is not None and (
+            getattr(model, "precision", "f32") != "f32"
+            or getattr(model, "remat", False)
+        ):
+            # fail at construction, not at the first train step
+            raise ValueError(
+                "--precision bf16/--remat are not supported on sp/tp/pp "
+                "char meshes (f32-structured relay/stage kernels) - use a "
+                "dp-only mesh or drop the flag"
+            )
         if self._dropout > 0.0 and self.model_axis is not None:
             raise NotImplementedError(
                 "dropout is not supported on sp/tp/pp mesh strategies - "
@@ -83,6 +103,19 @@ class MeshTrainer(SpmdTrainer):
 
             return make_attention_mesh_loss_fn(
                 self.model, self.mesh, weighted=weighted
+            )
+        if self.is_char:
+            from pytorch_distributed_rnn_tpu.parallel.strategy import (
+                make_char_mesh_loss_fn,
+            )
+
+            return make_char_mesh_loss_fn(
+                self.mesh, self.mesh_axes, schedule=self.schedule,
+                num_microbatches=self.num_microbatches, weighted=weighted,
+                dropout=self._dropout,
+                cell=getattr(self.model, "cell", "lstm"),
+                precision=getattr(self.model, "precision", "f32"),
+                remat=getattr(self.model, "remat", False),
             )
         return make_motion_mesh_loss_fn(
             self.mesh, self.mesh_axes, schedule=self.schedule,
@@ -165,12 +198,24 @@ def mesh_trainer_factory(args):
     """Bind the CLI's mesh flags into a Trainer-compatible constructor."""
     spec = parse_mesh_spec(args.mesh)
 
+    cls = MeshTrainer
+    if getattr(args, "model", "rnn") == "char":
+        # the mesh TRAIN steps come from make_char_mesh_loss_fn; the LM
+        # mixin supplies the matching EVAL loss surface (the base class's
+        # _loss_and_metrics is classification-shaped)
+        from pytorch_distributed_rnn_tpu.training.lm import wrap_lm_trainer
+
+        cls = wrap_lm_trainer(MeshTrainer)
+
     def build(**kwargs):
-        return MeshTrainer(
+        return cls(
             mesh_axes=spec,
             schedule=args.sp_schedule,
             num_microbatches=args.num_microbatches,
             **kwargs,
         )
 
+    # tells _train_char_lm the LM loss is already wired in (wrapping the
+    # factory's PRODUCT is not possible from outside - it is not a class)
+    build.OWNS_LM_LOSS = True
     return build

@@ -148,8 +148,87 @@ class TestCharCLI:
                 "--dataset-path", str(tmp_path), "--epochs", "1",
                 "--model", "char", "parameter-server", "--world-size", "2",
             ])
-        with pytest.raises(SystemExit, match="not wired into the mesh"):
+
+class TestCharMesh:
+    """--model char under the mesh strategy: the LM trains on composed
+    dp x {sp,tp} meshes with the same CLI surface as motion/attention."""
+
+    def _cli(self, tmp_path, mesh_spec, extra=()):
+        from pytorch_distributed_rnn_tpu.main import main
+
+        corpus = tmp_path / "corpus.txt"
+        if not corpus.exists():
+            corpus.write_bytes(bytes(range(256)) * 48)
+        main([
+            "--dataset-path", str(tmp_path),
+            "--output-path", str(tmp_path),
+            "--checkpoint-directory", str(tmp_path),
+            "--epochs", "2", "--batch-size", "64", "--seed", "1",
+            "--hidden-units", "32", "--stacked-layer", "2",
+            "--dropout", "0",
+            "--model", "char", "--seq-length", "31", "--no-validation",
+            *extra,
+            "mesh", "--mesh", mesh_spec,
+        ])
+        return json.loads((tmp_path / "history.json").read_text())
+
+    @pytest.mark.parametrize("mesh_spec", ["dp=2,sp=2", "dp=2,tp=2"])
+    def test_mesh_char_trains(self, tmp_path, monkeypatch, mesh_spec):
+        monkeypatch.chdir(tmp_path)
+        history = self._cli(tmp_path, mesh_spec)
+        assert len(history["train_history"]) == 2
+        assert history["train_history"][-1] < history["train_history"][0]
+
+    def test_mesh_char_matches_lm_local(self, tmp_path, monkeypatch):
+        """dp-only mesh char training reproduces the plain LM trainer's
+        loss history (same global batches, pmean over dp)."""
+        monkeypatch.chdir(tmp_path)
+        mesh_hist = self._cli(tmp_path, "dp=4")["train_history"]
+
+        from pytorch_distributed_rnn_tpu.data.text import TextDataset
+        from pytorch_distributed_rnn_tpu.models import CharRNN
+
+        # the CLI's --validation-fraction default (0.1) governs the split
+        # even under --no-validation (the split happens before trimming)
+        train, _, _ = TextDataset.load(
+            tmp_path, seq_length=31, validation_fraction=0.1, seed=1
+        )
+        model = CharRNN(vocab_size=256, embed_dim=32, hidden_dim=32,
+                        layer_dim=2, impl="scan")
+        local = wrap_lm_trainer(Trainer)(
+            model, train, batch_size=64, learning_rate=0.0025, seed=1
+        )
+        _, local_hist, _ = local.train(epochs=2)
+        np.testing.assert_allclose(mesh_hist, local_hist, rtol=1e-5)
+
+    def test_mesh_char_sp_rejects_indivisible_window(self, tmp_path):
+        from pytorch_distributed_rnn_tpu.main import main
+
+        corpus = tmp_path / "corpus.txt"
+        corpus.write_bytes(bytes(range(256)) * 48)
+        with pytest.raises(ValueError, match="not divisible by sp"):
             main([
                 "--dataset-path", str(tmp_path), "--epochs", "1",
-                "--model", "char", "mesh",
+                "--batch-size", "64", "--dropout", "0",
+                "--model", "char", "--seq-length", "32",  # window 33
+                "--no-validation", "mesh", "--mesh", "dp=2,sp=2",
             ])
+
+    def test_mesh_char_bf16_rejected_on_model_axis(self, tmp_path):
+        from pytorch_distributed_rnn_tpu.main import main
+
+        corpus = tmp_path / "corpus.txt"
+        corpus.write_bytes(bytes(range(256)) * 48)
+        with pytest.raises(ValueError, match="bf16"):
+            main([
+                "--dataset-path", str(tmp_path), "--epochs", "1",
+                "--batch-size", "64", "--dropout", "0",
+                "--precision", "bf16",
+                "--model", "char", "--seq-length", "31",
+                "--no-validation", "mesh", "--mesh", "dp=2,sp=2",
+            ])
+
+    def test_mesh_char_bf16_trains_on_dp_only(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        history = self._cli(tmp_path, "dp=4", extra=("--precision", "bf16"))
+        assert history["train_history"][-1] < history["train_history"][0]
