@@ -145,13 +145,14 @@ def main(argv=None):
         return 0
 
     if args.task == "run-debug":
-        run = bench.DEBUG_RUN
+        runs = [bench.DEBUG_RUN]
     elif args.task == "run-chip":
-        run = bench.CHIP_RUN
+        # motion rows + the char-LM companion row in one resumable sweep
+        runs = [bench.CHIP_RUN, bench.CHIP_LM_RUN]
     elif args.task == "run-all":
-        run = bench.BENCHMARK_RUN
+        runs = [bench.BENCHMARK_RUN]
     elif args.task == "run-slots":
-        run = bench.SLOTS_RUN
+        runs = [bench.SLOTS_RUN]
     elif args.task == "run-network-test":
         executed = bench.run_network_test(
             args.results,
@@ -163,9 +164,13 @@ def main(argv=None):
         )
         return _report(executed, args.results)
 
-    configs = bench.expand_run_configs(
-        run, _dataset_parameters(args), args.backend
-    )
+    configs = [
+        config
+        for run in runs
+        for config in bench.expand_run_configs(
+            run, _dataset_parameters(args), args.backend
+        )
+    ]
     executed = bench.run_benchmark(
         configs, args.results, timeout=args.timeout
     )

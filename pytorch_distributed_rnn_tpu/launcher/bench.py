@@ -89,6 +89,24 @@ CHIP_RUN = {
     "parameters": dict(BASE_PARAMETERS),
 }
 
+# Companion char-LM chip row (the LM family as a CLI citizen on real
+# hardware): H=512 keeps the fused Pallas kernel in play ('auto' takes the
+# fused path for hidden <= 512 on TPU - ops/rnn.py resolve_rnn_impl).
+CHIP_LM_RUN = {
+    "trainers": ["local"],
+    "devices": [1],
+    "slots": [1],
+    "batch_sizes": [256],
+    "parameters": {
+        **BASE_PARAMETERS,
+        "model": "char",
+        "seq-length": 128,
+        "hidden-units": 512,
+        "stacked-layer": 2,
+        "dropout": 0,
+    },
+}
+
 # fabfile.py:130-191: delays 0-400 ms, loss 0-15 %.
 NETWORK_RULES = [
     ("delay", 0.0),

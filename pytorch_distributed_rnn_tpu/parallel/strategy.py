@@ -238,8 +238,6 @@ def _char_local_logits(params, tokens, *, sp=None, tp=None, pp=None,
     else:
         from pytorch_distributed_rnn_tpu.ops.rnn import stacked_rnn
 
-        if compute_dtype is not None:
-            x = x.astype(compute_dtype)
         out, _ = stacked_rnn(params["rnn"], x, cell, unroll=unroll,
                              impl="scan", compute_dtype=compute_dtype,
                              remat=remat, dropout=dropout,
@@ -375,13 +373,17 @@ def make_char_mesh_loss_fn(mesh, axes: dict[str, int], *,
     """
     kw = _axis_kwargs(axes, cell)
     model_axis = next((a for a, v in kw.items() if v is not None), None)
-    if model_axis is not None and (precision != "f32" or remat):
-        # loud, never silent: the sp/tp/pp stacks are f32-structured, so
-        # honoring the flags is not possible - do not pretend to
+    if model_axis is not None and (
+        precision != "f32" or remat or dropout > 0.0
+    ):
+        # loud, never silent: the sp/tp/pp stacks are f32-structured and
+        # thread no dropout, so honoring the flags is not possible - do
+        # not pretend to
         raise ValueError(
-            f"--precision bf16/--remat are not supported on the {model_axis} "
-            "char mesh (f32-structured relay/stage kernels) - use a "
-            "dp-only mesh or drop the flag"
+            f"precision=bf16/remat/dropout are not supported on the "
+            f"{model_axis} char mesh (f32-structured relay/stage kernels "
+            "without dropout threading) - use a dp-only mesh or drop the "
+            "flag"
         )
     compute_dtype = jnp.bfloat16 if precision == "bf16" else None
 
