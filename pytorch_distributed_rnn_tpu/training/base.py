@@ -276,10 +276,9 @@ class Trainer:
     def _build_eval_step(self):
         return jax.jit(self._loss_and_metrics)
 
-    def _build_idx_train_step(self):
-        """Train step taking (params, opt_state, features, labels, idx,
-        [key]): the batch is gathered on device from resident arrays; the
-        trailing per-step dropout key is passed only when dropout is on."""
+    def _make_idx_train_step(self):
+        """The un-jitted idx-gather step (sharding-aware subclasses re-jit
+        it with layout constraints)."""
         grad_step = self._make_grad_step(self._loss_and_metrics)
 
         def step(params, opt_state, features, labels, idx, *extra):
@@ -287,12 +286,16 @@ class Trainer:
                 params, opt_state, (features[idx], labels[idx]), *extra
             )
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        return step
 
-    def _build_epoch_fn(self):
-        """Whole-epoch program: ``lax.scan`` over the epoch's (num_batches,
-        batch) index matrix - one dispatch per epoch.  With dropout on, a
-        (num_batches, 2) per-step key matrix rides the scan."""
+    def _build_idx_train_step(self):
+        """Train step taking (params, opt_state, features, labels, idx,
+        [key]): the batch is gathered on device from resident arrays; the
+        trailing per-step dropout key is passed only when dropout is on."""
+        return jax.jit(self._make_idx_train_step(), donate_argnums=(0, 1))
+
+    def _make_epoch_fn(self):
+        """The un-jitted whole-epoch program (see _build_epoch_fn)."""
         grad_step = self._make_grad_step(self._loss_and_metrics)
         with_key = self._dropout > 0.0
 
@@ -312,13 +315,16 @@ class Trainer:
             metrics_sum = jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics)
             return params, opt_state, jnp.sum(losses), metrics_sum
 
-        return jax.jit(epoch, donate_argnums=(0, 1))
+        return epoch
 
-    def _build_run_fn(self):
-        """The whole multi-epoch training run as ONE program: scan over
-        every batch of every epoch (weight-masked so the final partial
-        batch keeps reference semantics), returning per-step losses and
-        correct-counts for the host to fold into per-epoch history."""
+    def _build_epoch_fn(self):
+        """Whole-epoch program: ``lax.scan`` over the epoch's (num_batches,
+        batch) index matrix - one dispatch per epoch.  With dropout on, a
+        (num_batches, 2) per-step key matrix rides the scan."""
+        return jax.jit(self._make_epoch_fn(), donate_argnums=(0, 1))
+
+    def _make_run_fn(self):
+        """The un-jitted whole-run program (see _build_run_fn)."""
         grad_step = self._make_grad_step(self._weighted_loss_and_metrics)
         with_key = self._dropout > 0.0
 
@@ -338,7 +344,14 @@ class Trainer:
             )
             return params, opt_state, losses, correct
 
-        return jax.jit(run, donate_argnums=(0, 1))
+        return run
+
+    def _build_run_fn(self):
+        """The whole multi-epoch training run as ONE program: scan over
+        every batch of every epoch (weight-masked so the final partial
+        batch keeps reference semantics), returning per-step losses and
+        correct-counts for the host to fold into per-epoch history."""
+        return jax.jit(self._make_run_fn(), donate_argnums=(0, 1))
 
     # -- dropout keys --------------------------------------------------------
 

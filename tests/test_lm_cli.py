@@ -232,3 +232,40 @@ class TestCharMesh:
         monkeypatch.chdir(tmp_path)
         history = self._cli(tmp_path, "dp=4", extra=("--precision", "bf16"))
         assert history["train_history"][-1] < history["train_history"][0]
+
+
+class TestCharCombos:
+    def test_char_grad_accum_matches_single_shot(self, tmp_path):
+        """The LM (the family --grad-accum exists for) under accumulation
+        reproduces single-shot training."""
+        rng = np.random.RandomState(0)
+        train = TextDataset(rng.randint(0, 256, size=(96, 17)))
+        model = CharRNN(vocab_size=256, embed_dim=16, hidden_dim=16,
+                        layer_dim=1, impl="scan")
+        hist = {}
+        for accum in (1, 4):
+            trainer = wrap_lm_trainer(Trainer)(
+                model, train, batch_size=32, learning_rate=1e-3, seed=SEED,
+                grad_accum=accum,
+            )
+            _, h, _ = trainer.train(epochs=2)
+            hist[accum] = h
+        np.testing.assert_allclose(hist[1], hist[4], rtol=2e-4)
+
+    def test_char_gru_cli(self, tmp_path, monkeypatch):
+        from pytorch_distributed_rnn_tpu.main import main
+
+        corpus = tmp_path / "corpus.txt"
+        corpus.write_bytes(bytes(range(256)) * 48)
+        monkeypatch.chdir(tmp_path)
+        main([
+            "--dataset-path", str(tmp_path),
+            "--output-path", str(tmp_path),
+            "--checkpoint-directory", str(tmp_path),
+            "--epochs", "2", "--batch-size", "64", "--seed", "1",
+            "--hidden-units", "24", "--stacked-layer", "1",
+            "--cell", "gru", "--model", "char", "--seq-length", "31",
+            "--no-validation", "local",
+        ])
+        history = json.loads((tmp_path / "history.json").read_text())
+        assert history["train_history"][-1] < history["train_history"][0]
