@@ -50,7 +50,8 @@ BASE_PARAMETERS = {
 }
 
 BENCHMARK_RUN = {
-    "trainers": ["local", "distributed", "horovod", "distributed-native"],
+    "trainers": ["local", "distributed", "horovod", "distributed-native",
+                 "fsdp"],
     "devices": [1, 2, 4, 8],
     "slots": [1],
     "batch_sizes": [480, 960, 1440],

@@ -33,7 +33,8 @@ from dataclasses import dataclass, field
 class RunConfig:
     """One benchmark run (the reference's run-config dict made explicit)."""
 
-    trainer: str  # local | distributed | horovod | parameter-server
+    trainer: str  # local | distributed | horovod | fsdp |
+    # distributed-native | parameter-server
     devices: int = 1  # "hosts" analogue: dp world size
     slots: int = 1  # processes-per-host analogue: multiplies world
     parameters: tuple = field(default_factory=tuple)  # ((flag, value), ...)
@@ -91,7 +92,14 @@ def get_command(config: RunConfig, python: str | None = None):
             "--trainer", config.trainer,
             "--backend", config.backend, "--", *flag_argv,
         ]
-    elif config.trainer in ("local", "distributed", "horovod"):
+    elif config.trainer == "fsdp" and config.slots > 1:
+        # loud, never silent: no multi-controller fsdp topology exists yet,
+        # and labeling a single-process run as multi-slot would corrupt
+        # the benchmark data
+        raise ValueError(
+            "fsdp has no multi-slot (multi-process) topology - use slots=1"
+        )
+    elif config.trainer in ("local", "distributed", "horovod", "fsdp"):
         argv = [python, "-m", "pytorch_distributed_rnn_tpu.main",
                 *flag_argv, config.trainer]
         if config.backend == "cpu":

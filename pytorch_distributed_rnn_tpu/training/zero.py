@@ -38,8 +38,8 @@ class ZeroTrainer(SpmdTrainer):
     # _make_grad_step), so microbatch accumulation composes fine
     SUPPORTS_GRAD_ACCUM = True
 
-    def __init__(self, **kwargs):
-        super().__init__(**kwargs)
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
         # re-lay-out the replicated init into the ZeRO layout.  (The
         # transient replica is the same cost the reference pays at init;
         # models too big for ONE replica use parallel/zero.init_sharded's

@@ -148,8 +148,8 @@ def test_command_string_distinguishes_topology_and_fault():
 def test_expand_benchmark_sweep():
     configs = expand_run_configs(BENCHMARK_RUN)
     # local only at 1 device (3 batch sizes); distributed + horovod +
-    # distributed-native at {1,2,4,8} devices x 3 batch sizes
-    assert len(configs) == 3 + 3 * 4 * 3
+    # distributed-native + fsdp at {1,2,4,8} devices x 3 batch sizes
+    assert len(configs) == 3 + 4 * 4 * 3
     assert all(
         c.devices == 1 for c in configs if c.trainer == "local"
     )
@@ -318,3 +318,10 @@ def test_end_to_end_debug_run(tmp_path):
         r"0: Memory Usage: (\d+\.\d+), Training Duration: (\d+\.\d+)",
         result["stderr"],
     ), result["stderr"][-2000:]
+
+
+def test_fsdp_multi_slot_rejected():
+    from pytorch_distributed_rnn_tpu.launcher.commands import make_config
+
+    with pytest.raises(ValueError, match="multi-slot"):
+        get_command(make_config("fsdp", devices=2, slots=2))
