@@ -86,7 +86,7 @@ def main(argv=None):
                    help="host:slots list, e.g. h1:2,h2:2 (the mpirun "
                    "--host analogue); host 0 is the coordinator")
     p.add_argument("--trainer", default="distributed",
-                   choices=["distributed", "horovod"])
+                   choices=["distributed", "horovod", "fsdp"])
     p.add_argument("--coordinator-port", type=int, default=29601)
     p.add_argument("--python", default="python3")
     p.add_argument("--repo-dir", default="~/pytorch_distributed_rnn_tpu")
@@ -104,7 +104,7 @@ def main(argv=None):
                    help="jax transport: controller process count")
     p.add_argument("--devices-per-process", type=int, default=1)
     p.add_argument("--trainer", default="distributed",
-                   choices=["distributed", "horovod"])
+                   choices=["distributed", "horovod", "fsdp"])
     p.add_argument("--master-port", type=int, default=29533)
     p.add_argument("--coordinator-port", type=int, default=29601)
     p.add_argument("--timeout", type=float, default=600)

@@ -79,7 +79,10 @@ def get_command(config: RunConfig, python: str | None = None):
     env: dict[str, str] = {}
     world = config.world_size
 
-    if config.trainer in ("distributed", "horovod") and config.slots > 1:
+    if (
+        config.trainer in ("distributed", "horovod", "fsdp")
+        and config.slots > 1
+    ):
         # REAL multi-slot topology (the reference's processes-per-host,
         # fabfile.py:51,203-206): `slots` OS processes rendezvous through a
         # jax.distributed coordinator into ONE multi-controller world, each
@@ -92,13 +95,6 @@ def get_command(config: RunConfig, python: str | None = None):
             "--trainer", config.trainer,
             "--backend", config.backend, "--", *flag_argv,
         ]
-    elif config.trainer == "fsdp" and config.slots > 1:
-        # loud, never silent: no multi-controller fsdp topology exists yet,
-        # and labeling a single-process run as multi-slot would corrupt
-        # the benchmark data
-        raise ValueError(
-            "fsdp has no multi-slot (multi-process) topology - use slots=1"
-        )
     elif config.trainer in ("local", "distributed", "horovod", "fsdp"):
         argv = [python, "-m", "pytorch_distributed_rnn_tpu.main",
                 *flag_argv, config.trainer]

@@ -238,7 +238,16 @@ def test_preflight_two_ranks():
 
 
 @pytest.mark.slow
-def test_end_to_end_jax_world(tmp_path):
+@pytest.mark.parametrize(
+    "trainer,devices_per_process,port,extra",
+    [
+        ("distributed", 1, 29611, ()),
+        # fsdp: sharded state spans both controllers' devices
+        ("fsdp", 2, 29637, ("--hidden-units", "128")),
+    ],
+)
+def test_end_to_end_jax_world(tmp_path, trainer, devices_per_process, port,
+                              extra):
     """A real 2-process jax.distributed world through the launcher: both
     controller processes train the SPMD program over one global mesh and
     emit rank-tagged perf lines (rank-0-only history/checkpoints)."""
@@ -256,9 +265,10 @@ def test_end_to_end_jax_world(tmp_path):
         ["--dataset-path", str(data_dir),
          "--checkpoint-directory", str(tmp_path / "models"),
          "--epochs", "1", "--batch-size", "48", "--seed", "123456789",
-         "--no-validation", "--log", "INFO"],
-        devices_per_process=1,
-        coordinator_port=29611,
+         "--no-validation", "--log", "INFO", *extra],
+        devices_per_process=devices_per_process,
+        trainer=trainer,
+        coordinator_port=port,
         timeout=300,
         cwd=tmp_path,
     )
@@ -266,7 +276,7 @@ def test_end_to_end_jax_world(tmp_path):
     import re
 
     for pid, (rc, out, err) in enumerate(results):
-        assert rc == 0
+        assert rc == 0, err[-2000:]
         assert re.search(
             rf"{pid}: Memory Usage: \d+\.\d+, Training Duration: \d+\.\d+",
             err,
@@ -320,8 +330,11 @@ def test_end_to_end_debug_run(tmp_path):
     ), result["stderr"][-2000:]
 
 
-def test_fsdp_multi_slot_rejected():
-    from pytorch_distributed_rnn_tpu.launcher.commands import make_config
-
-    with pytest.raises(ValueError, match="multi-slot"):
-        get_command(make_config("fsdp", devices=2, slots=2))
+def test_fsdp_multi_slot_is_a_real_process_world():
+    """fsdp with slots > 1 launches a multi-controller world exactly like
+    distributed/horovod (run-world --transport jax --trainer fsdp)."""
+    argv, _ = get_command(make_config("fsdp", devices=2, slots=2),
+                          python="python")
+    assert "run-world" in argv
+    assert argv[argv.index("--trainer") + 1] == "fsdp"
+    assert argv[argv.index("--num-processes") + 1] == "2"
