@@ -50,8 +50,8 @@ SEED = 123456789
 V5E_BF16_PEAK_FLOPS = 197e12
 
 
-def motion_throughput(impl: str) -> float:
-    """seq/s for the reference workload with the given RNN impl."""
+def motion_throughput(impl: str, cell: str = "lstm") -> float:
+    """seq/s for the reference workload with the given RNN impl/cell."""
     from pytorch_distributed_rnn_tpu.data import MotionDataset
     from pytorch_distributed_rnn_tpu.data.synthetic import generate_har_arrays
     from pytorch_distributed_rnn_tpu.models import MotionModel
@@ -60,7 +60,7 @@ def motion_throughput(impl: str) -> float:
     X, y = generate_har_arrays(NUM_SEQUENCES, SEQ_LEN, NUM_FEATURES, seed=0)
     train_set = MotionDataset(X, y)
     model = MotionModel(input_dim=NUM_FEATURES, hidden_dim=32, layer_dim=2,
-                        output_dim=6, impl=impl)
+                        output_dim=6, impl=impl, cell=cell)
     trainer = Trainer(
         model, train_set, batch_size=BATCH_SIZE, learning_rate=0.0025,
         seed=SEED,
@@ -195,6 +195,13 @@ def main():
                         f"{type(exc).__name__}: {exc}"[:160])
                     last = exc
             raise last
+
+        # GRU flavor of the reference workload (BASELINE.json config 4's
+        # single-chip component; its multi-host half needs a real slice)
+        attempt(
+            "motion_gru_seq_per_sec",
+            lambda: round(motion_throughput("auto", cell="gru"), 1),
+        )
 
         if on_tpu:
             attempt("char_rnn_50m_bf16", lambda: _lm("bf16"))

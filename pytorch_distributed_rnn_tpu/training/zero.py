@@ -49,6 +49,7 @@ class ZeroTrainer(SpmdTrainer):
         self.params = jax.device_put(self.params, self._param_shardings)
         self.opt_state = jax.device_put(self.opt_state, self._opt_shardings)
         self._batch_sharding = NamedSharding(self.mesh, P(self.axis))
+        self._gather_fn = None
 
     def per_device_state_bytes(self) -> int:
         """Max bytes any one device holds for params + optimizer state
@@ -109,3 +110,47 @@ class ZeroTrainer(SpmdTrainer):
             )
 
         return jax.jit(eval_fn)
+
+    # -- checkpointing -------------------------------------------------------
+
+    def _gather_state(self):
+        """Replicated host-writable copies of the sharded state.
+
+        In a multi-controller world a ZeRO-sharded array spans devices the
+        writing process cannot address, so ``np.asarray`` (the checkpoint
+        writer's path) would fail - the state must be all-gathered FIRST,
+        by every process (it is a collective program), after which rank 0
+        alone writes.
+        """
+        rep = NamedSharding(self.mesh, P())
+        if self._gather_fn is None:
+            self._gather_fn = jax.jit(
+                lambda p, o: (p, o),
+                out_shardings=(
+                    jax.tree.map(lambda _: rep, self.params),
+                    jax.tree.map(lambda _: rep, self.opt_state),
+                ),
+            )
+        return self._gather_fn(self.params, self.opt_state)
+
+    def _save_checkpoint(self, epoch, loss, best=False):
+        if self.checkpoint_dir is None:
+            return
+        # every process participates in the gather; only rank 0 writes
+        params, opt_state = self._gather_state()
+        if self.rank != 0:
+            return
+        from pytorch_distributed_rnn_tpu.training.checkpoint import (
+            save_checkpoint,
+        )
+
+        save_checkpoint(
+            self.checkpoint_dir, epoch, params, opt_state, loss, best=best
+        )
+
+    def resume_from(self, checkpoint_path):
+        meta = super().resume_from(checkpoint_path)
+        # the loader returns host trees: re-apply the ZeRO layout
+        self.params = jax.device_put(self.params, self._param_shardings)
+        self.opt_state = jax.device_put(self.opt_state, self._opt_shardings)
+        return meta

@@ -122,3 +122,23 @@ class TestFsdpCLI:
         history = json.loads((tmp_path / "history.json").read_text())
         assert len(history["train_history"]) == 2
         assert (tmp_path / "best-model.ckpt").exists()
+
+    def test_checkpoint_resume_reapplies_layout(self, datasets, tmp_path):
+        fsdp = ZeroTrainer(
+            model=big_model(), training_set=datasets,
+            validation_set=datasets, batch_size=48,
+            learning_rate=2.5e-3, seed=SEED, mesh=make_mesh({"dp": 4}),
+            checkpoint_dir=tmp_path,
+        )
+        per_dev = fsdp.per_device_state_bytes()
+        fsdp.train(epochs=1)
+        assert (tmp_path / "best-model.ckpt").exists()
+
+        fresh = ZeroTrainer(
+            model=big_model(), training_set=datasets, batch_size=48,
+            learning_rate=2.5e-3, seed=SEED, mesh=make_mesh({"dp": 4}),
+        )
+        fresh.resume_from(tmp_path / "best-model.ckpt")
+        # the restored state is back in the ZeRO layout, not replicated
+        assert fresh.per_device_state_bytes() == per_dev
+        fresh.train(epochs=1)  # and trains

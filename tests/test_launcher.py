@@ -241,8 +241,10 @@ def test_preflight_two_ranks():
 @pytest.mark.parametrize(
     "trainer,devices_per_process,port,extra",
     [
-        ("distributed", 1, 29611, ()),
-        # fsdp: sharded state spans both controllers' devices
+        ("distributed", 1, 29611, ("--no-validation",)),
+        # fsdp: sharded state spans both controllers' devices; validation
+        # ON so the best-checkpoint path exercises the all-processes
+        # gather of cross-controller sharded state
         ("fsdp", 2, 29637, ("--hidden-units", "128")),
     ],
 )
@@ -265,7 +267,7 @@ def test_end_to_end_jax_world(tmp_path, trainer, devices_per_process, port,
         ["--dataset-path", str(data_dir),
          "--checkpoint-directory", str(tmp_path / "models"),
          "--epochs", "1", "--batch-size", "48", "--seed", "123456789",
-         "--no-validation", "--log", "INFO", *extra],
+         "--log", "INFO", *extra],
         devices_per_process=devices_per_process,
         trainer=trainer,
         coordinator_port=port,
@@ -283,6 +285,9 @@ def test_end_to_end_jax_world(tmp_path, trainer, devices_per_process, port,
         ), err[-2000:]
     # rank-0-only history write
     assert (tmp_path / "history.json").exists()
+    if trainer == "fsdp":
+        # the gathered-then-written best checkpoint exists and loads
+        assert (tmp_path / "models" / "best-model.ckpt").exists()
 
 
 @pytest.mark.slow
