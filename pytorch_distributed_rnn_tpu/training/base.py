@@ -671,11 +671,17 @@ class Trainer:
 
     # -- checkpointing -------------------------------------------------------
 
+    def _checkpoint_state(self):
+        """Hook: the (params, opt_state) a checkpoint writes.  Sharded
+        strategies override to gather cross-process state first."""
+        return self.params, self.opt_state
+
     def _save_checkpoint(self, epoch, loss, best=False):
         if self.checkpoint_dir is None:
             return
+        params, opt_state = self._checkpoint_state()
         save_checkpoint(
-            self.checkpoint_dir, epoch, self.params, self.opt_state, loss, best=best
+            self.checkpoint_dir, epoch, params, opt_state, loss, best=best
         )
 
     def resume_from(self, checkpoint_path):

@@ -46,8 +46,7 @@ class ZeroTrainer(SpmdTrainer):
         # from-construction path directly.)
         self._param_shardings = sharded_specs(self.params, self.mesh)
         self._opt_shardings = sharded_specs(self.opt_state, self.mesh)
-        self.params = jax.device_put(self.params, self._param_shardings)
-        self.opt_state = jax.device_put(self.opt_state, self._opt_shardings)
+        self._apply_zero_layout()
         self._batch_sharding = NamedSharding(self.mesh, P(self.axis))
         self._gather_fn = None
 
@@ -133,11 +132,25 @@ class ZeroTrainer(SpmdTrainer):
             )
         return self._gather_fn(self.params, self.opt_state)
 
+    def _apply_zero_layout(self):
+        self.params = jax.device_put(self.params, self._param_shardings)
+        self.opt_state = jax.device_put(self.opt_state, self._opt_shardings)
+
+    def _checkpoint_state(self):
+        if jax.process_count() > 1:
+            return self._gather_state()
+        # single controller: every shard is process-addressable, so the
+        # writer's np.asarray assembles the tree host-side without ever
+        # materializing a device-side replica (ZeRO's memory point)
+        return self.params, self.opt_state
+
     def _save_checkpoint(self, epoch, loss, best=False):
+        """Unlike SpmdTrainer's rank-gate-then-write, the state hook must
+        run on EVERY process first (the multi-controller gather is a
+        collective program); only the file write is rank-0-only."""
         if self.checkpoint_dir is None:
             return
-        # every process participates in the gather; only rank 0 writes
-        params, opt_state = self._gather_state()
+        params, opt_state = self._checkpoint_state()
         if self.rank != 0:
             return
         from pytorch_distributed_rnn_tpu.training.checkpoint import (
@@ -150,7 +163,5 @@ class ZeroTrainer(SpmdTrainer):
 
     def resume_from(self, checkpoint_path):
         meta = super().resume_from(checkpoint_path)
-        # the loader returns host trees: re-apply the ZeRO layout
-        self.params = jax.device_put(self.params, self._param_shardings)
-        self.opt_state = jax.device_put(self.opt_state, self._opt_shardings)
+        self._apply_zero_layout()  # the loader returns host trees
         return meta
