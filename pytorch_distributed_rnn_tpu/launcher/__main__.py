@@ -37,6 +37,20 @@ from pytorch_distributed_rnn_tpu.launcher import bench
 from pytorch_distributed_rnn_tpu.launcher.commands import command_string
 
 
+def _trainer_spec(value: str) -> str:
+    """A multi-controller trainer token: a bare strategy name, or a
+    strategy plus its own sub-flags (e.g. ``mesh --mesh dp=1,sp=4``)."""
+    import shlex
+
+    head = shlex.split(value)[0] if value.strip() else ""
+    allowed = ("distributed", "horovod", "fsdp", "mesh")
+    if head not in allowed:
+        raise argparse.ArgumentTypeError(
+            f"trainer must start with one of {allowed}, got {value!r}"
+        )
+    return value
+
+
 def _add_common(parser):
     parser.add_argument("--dataset-path", default="data")
     parser.add_argument("--results", default="results.json")
@@ -103,8 +117,11 @@ def main(argv=None):
     p.add_argument("--num-processes", type=int, default=2,
                    help="jax transport: controller process count")
     p.add_argument("--devices-per-process", type=int, default=1)
-    p.add_argument("--trainer", default="distributed",
-                   choices=["distributed", "horovod", "fsdp"])
+    p.add_argument("--trainer", default="distributed", type=_trainer_spec,
+                   help="distributed | horovod | fsdp | a mesh spec like "
+                   "'mesh --mesh dp=1,sp=4' (sub-flags ride along; sp "
+                   "rings then span controllers - sequence parallelism "
+                   "over DCN)")
     p.add_argument("--master-port", type=int, default=29533)
     p.add_argument("--coordinator-port", type=int, default=29601)
     p.add_argument("--timeout", type=float, default=600)

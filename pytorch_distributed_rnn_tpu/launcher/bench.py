@@ -310,6 +310,19 @@ def launch_jax_world(
         if backend == "cpu":
             env["PDRNN_PLATFORM"] = "cpu"
             env["PDRNN_NUM_CPU_DEVICES"] = str(devices_per_process)
+            # an inherited device-count flag (e.g. the test suite's
+            # 8-device XLA_FLAGS) would win over PDRNN_NUM_CPU_DEVICES and
+            # inflate the global world: rank-local meshes built from the
+            # first N global devices could then land entirely on process
+            # 0's devices - unfetchable from the other controllers
+            flags = " ".join(
+                f for f in env.get("XLA_FLAGS", "").split()
+                if not f.startswith("--xla_force_host_platform_device_count")
+            )
+            if flags:
+                env["XLA_FLAGS"] = flags
+            else:
+                env.pop("XLA_FLAGS", None)
         else:
             # native: partition the host's TPU chips between ranks so each
             # controller owns devices_per_process chips (libtpu allows one
@@ -324,7 +337,7 @@ def launch_jax_world(
         )
         rank_cmds.append((
             [sys.executable, "-m", "pytorch_distributed_rnn_tpu.main",
-             *map(str, cli_args), trainer],
+             *map(str, cli_args), *shlex.split(trainer)],
             env,
         ))
     return spawn_world(rank_cmds, timeout=timeout, cwd=cwd)

@@ -549,8 +549,15 @@ class Trainer:
             if self._dropout > 0.0
             else None
         )
-        total_loss = jnp.zeros(())
-        total_correct = jnp.zeros((), jnp.int32)
+        # host-side accumulators: each program's loss/metrics outputs are
+        # replicated over the (possibly multi-process) mesh, so fetching
+        # them immediately is legal on every rank - while accumulating
+        # into a process-LOCAL device zero can land the sum on a device
+        # other controllers cannot address.  Cost: at most two fetches per
+        # epoch on the fast path (whole-epoch program + optional remainder
+        # step), values the host needs for history/logging anyway.
+        total_loss = 0.0
+        total_correct = 0.0
 
         if log_progress:
             # per-batch progress needs values on host each step: dispatch
@@ -560,8 +567,8 @@ class Trainer:
                 self.params, self.opt_state, loss, metrics = self._idx_step_fn(
                     self.params, self.opt_state, features, labels, idx, *extra
                 )
-                total_loss = total_loss + loss
-                total_correct = total_correct + metrics["correct"]
+                total_loss += float(loss)
+                total_correct += float(metrics["correct"])
                 logging.debug(
                     formatter.train_progress_message(
                         batch_idx=batch_idx,
@@ -590,28 +597,33 @@ class Trainer:
                     self.params, self.opt_state, features, labels, idx_mat,
                     *extra,
                 )
-                total_loss = total_loss + loss_sum
-                total_correct = total_correct + metrics_sum["correct"]
+                total_loss += float(loss_sum)
+                total_correct += float(metrics_sum["correct"])
             if remainder is not None:
                 extra = (keys[-1],) if keys is not None else ()
                 self.params, self.opt_state, loss, metrics = self._idx_step_fn(
                     self.params, self.opt_state, features, labels, remainder,
                     *extra,
                 )
-                total_loss = total_loss + loss
-                total_correct = total_correct + metrics["correct"]
+                total_loss += float(loss)
+                total_correct += float(metrics["correct"])
 
         # parity quirk kept: sum of batch-mean losses / dataset size
-        train_loss = float(total_loss) / len(self.training_set)
-        train_acc = float(total_correct) / len(self.training_set)
+        train_loss = total_loss / len(self.training_set)
+        train_acc = total_correct / len(self.training_set)
         return train_loss, train_acc
 
     def _train_epoch_host(self, formatter):
         """Legacy materialized-batch loop (used when the strategy must act
         on host every step, e.g. the parameter-server worker)."""
         log_progress = logging.getLogger().isEnabledFor(logging.DEBUG)
-        total_loss = jnp.zeros(())
-        total_correct = jnp.zeros((), jnp.int32)
+        # host-side accumulators: each program's loss/metrics outputs are
+        # replicated over the (possibly multi-process) mesh, so fetching
+        # them immediately is legal on every rank - while accumulating
+        # into a process-LOCAL device zero can land the sum on a device
+        # other controllers cannot address
+        total_loss = 0.0
+        total_correct = 0.0
         loader = self._train_loader()
         num_batches = len(loader)
         keys = (
@@ -625,8 +637,8 @@ class Trainer:
             self.params, self.opt_state, loss, metrics = self._train_step_fn(
                 self.params, self.opt_state, batch, *extra
             )
-            total_loss = total_loss + loss
-            total_correct = total_correct + metrics["correct"]
+            total_loss += float(loss)
+            total_correct += float(metrics["correct"])
             if log_progress:
                 logging.debug(
                     formatter.train_progress_message(
@@ -637,8 +649,7 @@ class Trainer:
                         loss=float(loss),
                     )
                 )
-        total_loss = float(total_loss)
-        total_correct = float(total_correct)
+
         # parity quirk kept: sum of batch-mean losses / dataset size
         train_loss = total_loss / len(self.training_set)
         train_acc = total_correct / len(self.training_set)
@@ -673,13 +684,23 @@ class Trainer:
 
     def _checkpoint_state(self):
         """Hook: the (params, opt_state) a checkpoint writes.  Sharded
-        strategies override to gather cross-process state first."""
+        strategies override to gather cross-process state first - such a
+        gather is a COLLECTIVE, so this hook runs on every process
+        unconditionally; only :meth:`_should_write_checkpoint` gates the
+        file write."""
         return self.params, self.opt_state
+
+    def _should_write_checkpoint(self) -> bool:
+        """Hook: whether THIS process writes the file (multi-process
+        strategies restrict to rank 0)."""
+        return True
 
     def _save_checkpoint(self, epoch, loss, best=False):
         if self.checkpoint_dir is None:
             return
         params, opt_state = self._checkpoint_state()
+        if not self._should_write_checkpoint():
+            return
         save_checkpoint(
             self.checkpoint_dir, epoch, params, opt_state, loss, best=best
         )

@@ -97,10 +97,11 @@ class SpmdTrainer(Trainer):
     def _get_formatter(self, epochs):
         return TrainingMessageFormatter(epochs, self.rank)
 
-    def _save_checkpoint(self, epoch, loss, best=False):
-        if self.rank != 0:
-            return
-        super()._save_checkpoint(epoch, loss, best=best)
+    def _should_write_checkpoint(self) -> bool:
+        # rank-0-only writes (reference distributed.py:60-62); the
+        # _checkpoint_state hook still runs on every process first, so a
+        # sharded strategy's collective gather cannot deadlock here
+        return self.rank == 0
 
     def _fold_rank(self, key):
         # independent dropout mask per dp shard (torch DDP has one RNG

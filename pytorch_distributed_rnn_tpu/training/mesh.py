@@ -124,11 +124,25 @@ class MeshTrainer(SpmdTrainer):
             cell=getattr(self.model, "cell", "lstm"),
         )
 
+    def _jit_replicated(self, fn):
+        """jit with every output pinned fully replicated over the mesh.
+
+        The mesh programs keep params replicated and their shard_mapped
+        losses return replicated scalars, but an outer ``jax.jit`` without
+        out_shardings may still PLACE a scalar on one process's device -
+        unfetchable from the other controllers of a multi-process world.
+        Pinning replicated outputs makes every host-side ``float()`` legal
+        on every rank (the dp.py factories get this for free from their
+        whole-program shard_map out_specs)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        rep = NamedSharding(self.mesh, PartitionSpec())
+        return jax.jit(fn, donate_argnums=(0, 1), out_shardings=rep)
+
     def _build_train_step(self):
-        step = make_mesh_grad_step(
+        return self._jit_replicated(make_mesh_grad_step(
             self._mesh_loss_fn(weighted=False), self.optimizer
-        )
-        return jax.jit(step, donate_argnums=(0, 1))
+        ))
 
     def _build_idx_train_step(self):
         grad_step = make_mesh_grad_step(
@@ -140,7 +154,7 @@ class MeshTrainer(SpmdTrainer):
                 params, opt_state, (features[idx], labels[idx]), *extra
             )
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        return self._jit_replicated(step)
 
     def _build_epoch_fn(self):
         grad_step = make_mesh_grad_step(
@@ -167,7 +181,7 @@ class MeshTrainer(SpmdTrainer):
             )
             return params, opt_state, jax.numpy.sum(losses), metrics_sum
 
-        return jax.jit(epoch, donate_argnums=(0, 1))
+        return self._jit_replicated(epoch)
 
     def _build_run_fn(self):
         grad_step = make_mesh_grad_step(
@@ -191,7 +205,7 @@ class MeshTrainer(SpmdTrainer):
             )
             return params, opt_state, losses, correct
 
-        return jax.jit(run, donate_argnums=(0, 1))
+        return self._jit_replicated(run)
 
 
 def mesh_trainer_factory(args):

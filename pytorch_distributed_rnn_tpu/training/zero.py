@@ -138,28 +138,13 @@ class ZeroTrainer(SpmdTrainer):
 
     def _checkpoint_state(self):
         if jax.process_count() > 1:
+            # collective all-gather: runs on EVERY process (the base
+            # _save_checkpoint calls this hook before its rank gate)
             return self._gather_state()
         # single controller: every shard is process-addressable, so the
         # writer's np.asarray assembles the tree host-side without ever
         # materializing a device-side replica (ZeRO's memory point)
         return self.params, self.opt_state
-
-    def _save_checkpoint(self, epoch, loss, best=False):
-        """Unlike SpmdTrainer's rank-gate-then-write, the state hook must
-        run on EVERY process first (the multi-controller gather is a
-        collective program); only the file write is rank-0-only."""
-        if self.checkpoint_dir is None:
-            return
-        params, opt_state = self._checkpoint_state()
-        if self.rank != 0:
-            return
-        from pytorch_distributed_rnn_tpu.training.checkpoint import (
-            save_checkpoint,
-        )
-
-        save_checkpoint(
-            self.checkpoint_dir, epoch, params, opt_state, loss, best=best
-        )
 
     def resume_from(self, checkpoint_path):
         meta = super().resume_from(checkpoint_path)

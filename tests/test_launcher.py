@@ -246,6 +246,12 @@ def test_preflight_two_ranks():
         # ON so the best-checkpoint path exercises the all-processes
         # gather of cross-controller sharded state
         ("fsdp", 2, 29637, ("--hidden-units", "128")),
+        # sequence parallelism whose sp ring ppermutes ACROSS the two
+        # controller processes (the DCN long-context analogue); char-LM
+        # windows (synthetic fallback) time-shard 4 ways
+        ("mesh --mesh dp=1,sp=4", 2, 29653,
+         ("--model", "char", "--seq-length", "31", "--stacked-layer", "2",
+          "--hidden-units", "32", "--dropout", "0", "--no-validation")),
     ],
 )
 def test_end_to_end_jax_world(tmp_path, trainer, devices_per_process, port,
