@@ -31,9 +31,13 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 
-from pytorch_distributed_rnn_tpu.utils import apply_platform_overrides
+from pytorch_distributed_rnn_tpu.utils import ensure_usable_backend
 
-apply_platform_overrides()
+# The ambient TPU backend can hang (not raise) during init when its
+# tunnel is down - both r1 and r2 driver artifacts went red on exactly
+# this (VERDICT.md).  Probe it in a subprocess with a timeout; on
+# hang/failure force CPU so the JSON contract line still prints.
+BACKEND_INFO = ensure_usable_backend(min_devices=1, timeout=60.0)
 
 import numpy as np
 
@@ -138,6 +142,12 @@ def main():
     import jax
 
     on_tpu = jax.default_backend() == "tpu"
+    if BACKEND_INFO["fallback"]:
+        print(
+            "bench.py: ambient backend unavailable (probe hung/failed); "
+            "falling back to CPU",
+            file=sys.stderr,
+        )
     headline = motion_throughput("auto")
 
     extras: dict = {}
@@ -219,6 +229,10 @@ def main():
                 "data": "synthetic (random HAR-shaped arrays / random "
                         "tokens; real UCI HAR absent in this image)",
                 "backend": jax.default_backend(),
+                "backend_note": (
+                    "ambient backend unavailable; fell back to cpu"
+                    if BACKEND_INFO["fallback"] else "ambient"
+                ),
                 "extra_metrics": extras,
             }
         )

@@ -1,3 +1,11 @@
-from pytorch_distributed_rnn_tpu.utils.platform import apply_platform_overrides
+from pytorch_distributed_rnn_tpu.utils.platform import (
+    apply_platform_overrides,
+    ensure_usable_backend,
+    probe_backend,
+)
 
-__all__ = ["apply_platform_overrides"]
+__all__ = [
+    "apply_platform_overrides",
+    "ensure_usable_backend",
+    "probe_backend",
+]

@@ -16,6 +16,85 @@ first backend use.  This helper reads our own env vars and applies that:
 from __future__ import annotations
 
 import os
+import subprocess
+import sys
+
+_PROBE_CACHE: dict = {}
+
+
+def probe_backend(timeout: float = 45.0):
+    """Check ambient-backend health in a throwaway subprocess.
+
+    The ambient backend (a TPU PJRT plugin registered from sitecustomize)
+    can HANG during init when its tunnel is down - not raise, hang
+    (observed round 2: a bare ``jax.devices()`` blocked >120s,
+    VERDICT.md "driver-contract fragility").  Anything that must stay
+    runnable therefore may never gate on in-process backend init.  This
+    probes ``jax.default_backend()`` + device count in a subprocess with a
+    hard timeout; the parent's backend state is untouched.
+
+    Returns ``(platform, n_devices)`` on success, ``None`` when init
+    raises, hangs, or produces garbage.  Result is cached per-process.
+    """
+    # One probe per process: the answer (backend healthy or not) does not
+    # change meaningfully within a run, and probes cost seconds.
+    key = "probe"
+    if key in _PROBE_CACHE:
+        return _PROBE_CACHE[key]
+    # a sentinel-prefixed line keeps the parse robust against anything
+    # else (sitecustomize banners, plugin chatter) written to the child's
+    # stdout - a healthy backend must never be misread as broken
+    code = (
+        "import jax, sys; "
+        "sys.stdout.write('\\nPDRNN_PROBE %s %d\\n' "
+        "% (jax.default_backend(), len(jax.devices())))"
+    )
+    result = None
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            timeout=timeout,
+        )
+        if proc.returncode == 0:
+            for line in proc.stdout.decode().splitlines():
+                parts = line.strip().split()
+                if len(parts) == 3 and parts[0] == "PDRNN_PROBE":
+                    result = (parts[1], int(parts[2]))
+    except (subprocess.TimeoutExpired, OSError, ValueError):
+        result = None
+    _PROBE_CACHE[key] = result
+    return result
+
+
+def ensure_usable_backend(min_devices: int = 1, timeout: float = 45.0):
+    """Force the CPU platform when the ambient backend is hung or broken.
+
+    Must run before the first in-process backend use.  When
+    ``PDRNN_PLATFORM`` is already set the caller has chosen a platform and
+    no probe runs.  Returns a dict: ``platform`` (best knowledge),
+    ``n_devices`` (probed, or None), ``fallback`` (True when the ambient
+    backend was unusable and CPU was forced) - callers surface the
+    fallback in their output rather than dying with the tunnel
+    (VERDICT.md round-3 item 1).
+    """
+    if os.environ.get("PDRNN_PLATFORM"):
+        apply_platform_overrides()
+        return {
+            "platform": os.environ["PDRNN_PLATFORM"],
+            "n_devices": None,
+            "fallback": False,
+        }
+    probe = probe_backend(timeout)
+    if probe is None or probe[1] < min_devices:
+        os.environ["PDRNN_PLATFORM"] = "cpu"
+        if min_devices > 1:
+            os.environ.setdefault("PDRNN_NUM_CPU_DEVICES", str(min_devices))
+        apply_platform_overrides()
+        return {"platform": "cpu", "n_devices": None, "fallback": True}
+    apply_platform_overrides()
+    return {"platform": probe[0], "n_devices": probe[1], "fallback": False}
 
 
 def apply_platform_overrides():
@@ -60,16 +139,46 @@ def _enable_compile_cache(jax):
         and "PDRNN_COMPILE_CACHE_DIR" not in os.environ
     ):
         return
-    # per-user default path: a world-shared fixed /tmp path would let one
-    # local user's cache entries (compiled executables) be loaded by another
-    uid = getattr(os, "getuid", lambda: 0)()
-    cache_dir = os.environ.get(
-        "PDRNN_COMPILE_CACHE_DIR", f"/tmp/pdrnn-xla-cache-{uid}"
+    # Default under the user's own cache root, never a predictable /tmp
+    # path: cache entries are compiled executables, and a /tmp dir can be
+    # pre-created (and then owned) by another local user, who would then
+    # control what this process deserializes.
+    default_dir = os.path.join(
+        os.environ.get("XDG_CACHE_HOME")
+        or os.path.join(os.path.expanduser("~"), ".cache"),
+        "pdrnn-xla",
     )
+    cache_dir = os.environ.get("PDRNN_COMPILE_CACHE_DIR", default_dir)
     if cache_dir.lower() in ("", "0", "off", "none"):
+        return
+    if not _cache_dir_is_safe(cache_dir):
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "compile cache DISABLED: %s is not a private directory owned "
+            "by this user (need uid-owned, no group/world write) - fix "
+            "its permissions or set PDRNN_COMPILE_CACHE_DIR", cache_dir,
+        )
         return
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:  # pragma: no cover - older jax without the flags
         pass
+
+
+def _cache_dir_is_safe(cache_dir: str) -> bool:
+    """Create the cache dir 0700 if absent; refuse to use a dir another
+    user owns or can write (it would feed us their compiled executables)."""
+    try:
+        os.makedirs(cache_dir, mode=0o700, exist_ok=True)
+        st = os.stat(cache_dir)
+    except OSError:
+        return False
+    if not hasattr(os, "getuid"):  # non-POSIX: ownership model differs
+        return True
+    if st.st_uid != os.getuid():
+        return False
+    if st.st_mode & 0o022:  # group/world-writable
+        return False
+    return True

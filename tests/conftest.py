@@ -17,6 +17,10 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # hang if the tunnel is busy/wedged.  The test suite is the no-hardware
 # path; children must be pure CPU.
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+# ... and the backend-probe helpers (bench.py import, __graft_entry__)
+# must not spend a probe-subprocess timeout dialing the wedged plugin:
+# an explicit platform choice skips the probe entirely.
+os.environ.setdefault("PDRNN_PLATFORM", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
