@@ -18,9 +18,12 @@ batches.
 
 from __future__ import annotations
 
+import logging
 from pathlib import Path
 
 import numpy as np
+
+log = logging.getLogger(__name__)
 
 VOCAB_SIZE = 256  # byte-level
 
@@ -46,6 +49,20 @@ class TextDataset:
         return len(self.features)
 
     @classmethod
+    def resolve_corpus(cls, dataset_path):
+        """The ONE "does this path hold a corpus" rule: the file itself,
+        or ``corpus.txt`` under a directory; ``None`` when
+        ``dataset_path`` is None or holds neither."""
+        if dataset_path is None:
+            return None
+        path = Path(dataset_path)
+        if path.is_file():
+            return path
+        if (path / "corpus.txt").is_file():
+            return path / "corpus.txt"
+        return None
+
+    @classmethod
     def load(
         cls,
         dataset_path,
@@ -62,13 +79,19 @@ class TextDataset:
         (deterministic in ``seed``).  Windows are shuffled with ``seed``
         before the split so the three sets are i.i.d. slices of the corpus.
         """
-        path = Path(dataset_path) if dataset_path else None
-        corpus_file = None
-        if path is not None:
-            if path.is_file():
-                corpus_file = path
-            elif (path / "corpus.txt").is_file():
-                corpus_file = path / "corpus.txt"
+        corpus_file = cls.resolve_corpus(dataset_path)
+        if corpus_file is None and dataset_path is not None:
+            # A given path that resolves to nothing must not SILENTLY
+            # train on synthetic data (a typo'd corpus path would look
+            # like a real run) - warn loudly before falling back.  Not an
+            # error: the launcher and the world tests pass the generic
+            # data directory for every family, where "no corpus.txt" is
+            # the normal synthetic-LM case.
+            log.warning(
+                "--dataset-path %s holds no corpus (no such file / no "
+                "corpus.txt under it) - training on the SYNTHETIC motif "
+                "corpus instead", dataset_path,
+            )
 
         if corpus_file is not None:
             data = np.frombuffer(corpus_file.read_bytes(), dtype=np.uint8)

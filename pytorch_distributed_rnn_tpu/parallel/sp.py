@@ -137,17 +137,41 @@ def sp_lstm_layer(params, x_local, axis: str, *, unroll: int = 1):
     return outputs, final
 
 
-def sp_stacked_lstm(layers, x_local, axis: str, *, unroll: int = 1):
+def _cast_for_compute(layers, x_local, compute_dtype):
+    """Mixed-precision entry shared by the sp stacks: params and the local
+    activations move to ``compute_dtype`` (bf16 matmuls at full MXU rate);
+    the per-step carry stays f32 inside :func:`ops.rnn.lstm_step` /
+    :func:`gru_step` (their documented contract), so sp numerics degrade
+    exactly like the unsharded ``stacked_rnn(compute_dtype=...)`` path."""
+    if compute_dtype is None:
+        return layers, x_local
+    layers = [
+        jax.tree.map(lambda p: p.astype(compute_dtype), layer)
+        for layer in layers
+    ]
+    return layers, x_local.astype(compute_dtype)
+
+
+def sp_stacked_lstm(layers, x_local, axis: str, *, unroll: int = 1,
+                    compute_dtype=None, remat: bool = False):
     """Layer-sequential stacked LSTM over a time-sharded sequence.
 
     Each layer is a full relay; total latency O(L*T).  Prefer
     :func:`sp_stacked_lstm_wavefront` when L > 1.
     Returns ``(outputs_local, [per-layer final carries])``.
+
+    ``compute_dtype``/``remat`` are the same TPU levers as
+    ``ops.rnn.stacked_rnn``: bf16 compute with f32 carries, and
+    per-layer ``jax.checkpoint`` (the relay - including its ppermute
+    hops - is replayed during backward instead of saving activations).
     """
+    layer_fn = partial(sp_lstm_layer, axis=axis, unroll=unroll)
+    if remat:
+        layer_fn = jax.checkpoint(layer_fn)
+    layers, out = _cast_for_compute(layers, x_local, compute_dtype)
     finals = []
-    out = x_local
     for layer in layers:
-        out, final = sp_lstm_layer(layer, out, axis, unroll=unroll)
+        out, final = layer_fn(layer, out)
         finals.append(final)
     return out, finals
 
@@ -183,18 +207,24 @@ def sp_gru_layer(params, x_local, axis: str, *, unroll: int = 1):
     return outputs, final
 
 
-def sp_stacked_gru(layers, x_local, axis: str, *, unroll: int = 1):
-    """Layer-sequential stacked GRU over a time-sharded sequence."""
+def sp_stacked_gru(layers, x_local, axis: str, *, unroll: int = 1,
+                   compute_dtype=None, remat: bool = False):
+    """Layer-sequential stacked GRU over a time-sharded sequence.
+    ``compute_dtype``/``remat`` as :func:`sp_stacked_lstm`."""
+    layer_fn = partial(sp_gru_layer, axis=axis, unroll=unroll)
+    if remat:
+        layer_fn = jax.checkpoint(layer_fn)
+    layers, out = _cast_for_compute(layers, x_local, compute_dtype)
     finals = []
-    out = x_local
     for layer in layers:
-        out, final = sp_gru_layer(layer, out, axis, unroll=unroll)
+        out, final = layer_fn(layer, out)
         finals.append(final)
     return out, finals
 
 
 def sp_stacked_lstm_wavefront(layers, x_local, axis: str, *,
-                              unroll: int = 1):
+                              unroll: int = 1, compute_dtype=None,
+                              remat: bool = False):
     """Wavefront-scheduled stacked LSTM over a time-sharded sequence.
 
     Cell ``(l, s)`` = layer ``l``'s recurrence over shard ``s``'s chunk.  At
@@ -212,9 +242,22 @@ def sp_stacked_lstm_wavefront(layers, x_local, axis: str, *,
     :func:`sp_stacked_lstm` exactly.
     """
     if len(layers) == 1:
-        out, final = sp_lstm_layer(layers[0], x_local, axis, unroll=unroll)
-        return out, [final]
+        return sp_stacked_lstm(
+            layers, x_local, axis, unroll=unroll,
+            compute_dtype=compute_dtype, remat=remat,
+        )
 
+    layers, x_local = _cast_for_compute(layers, x_local, compute_dtype)
+    run = partial(_wavefront_run, axis=axis, unroll=unroll)
+    if remat:
+        # one checkpoint around the whole wavefront: its scan interleaves
+        # all layers, so there is no per-layer seam to cut at - backward
+        # replays the L + S - 1 turns (ppermutes included) once
+        run = jax.checkpoint(run)
+    return run(layers, x_local)
+
+
+def _wavefront_run(layers, x_local, *, axis: str, unroll: int):
     n = lax.axis_size(axis)
     idx = lax.axis_index(axis)
     perm = [(i, (i + 1) % n) for i in range(n)]

@@ -66,9 +66,9 @@ def parse_mesh_spec(spec: str) -> dict[str, int]:
         name = name.strip()
         if name in axes:
             raise ValueError(f"duplicate mesh axis {name!r}")
-        if name not in ("dp",) + MODEL_AXES:
+        if name not in ("dp", "ep") + MODEL_AXES:
             raise ValueError(
-                f"unknown mesh axis {name!r} (known: dp, sp, tp, pp)"
+                f"unknown mesh axis {name!r} (known: dp, sp, tp, pp, ep)"
             )
         axes[name] = int(size)
     if not axes:
@@ -112,13 +112,19 @@ def _sp_stack(cell: str, schedule: str):
 def mesh_rnn_forward(params, x, *, sp=None, tp=None, pp=None,
                      schedule: str = "wavefront", num_microbatches: int = 4,
                      unroll: int = 1, dropout: float = 0.0,
-                     dropout_key=None, cell: str = "lstm"):
+                     dropout_key=None, cell: str = "lstm",
+                     compute_dtype=None, remat: bool = False):
     """Motion-model forward (stacked LSTM/GRU -> last-step head) for use
     INSIDE a ``shard_map`` program where the named axes are bound.
 
     ``x`` (B_local, T, in) arrives dp-local and replicated over the model
     axes; logits (B_local, out) return replicated over the model axes (so
     the caller's dp-only loss/metric collectives stay correct).
+
+    ``compute_dtype``/``remat`` apply on the unsharded and ``sp``
+    branches (the relay stacks thread them; the head stays f32 like
+    ``MotionModel.apply``); the tp/pp stacks are f32-structured and the
+    callers reject those combinations loudly.
     """
     if sum(a is not None for a in (sp, tp, pp)) > 1:
         raise ValueError("compose dp with at most one of sp/tp/pp")
@@ -132,9 +138,11 @@ def mesh_rnn_forward(params, x, *, sp=None, tp=None, pp=None,
         t_local = t // n
         x_loc = lax.dynamic_slice_in_dim(x, k * t_local, t_local, axis=1)
         out_local, _ = _sp_stack(cell, schedule)(
-            params["rnn"], x_loc, sp, unroll=unroll
+            params["rnn"], x_loc, sp, unroll=unroll,
+            compute_dtype=compute_dtype, remat=remat,
         )
-        last = out_local[:, -1, :]  # true last step on shard n-1 only
+        # true last step on shard n-1 only; head in f32 (model contract)
+        last = out_local[:, -1, :].astype(jnp.float32)
         logits = last @ params["fc"]["weight"].T + params["fc"]["bias"]
         return broadcast_from(logits, sp, n - 1)
 
@@ -155,8 +163,10 @@ def mesh_rnn_forward(params, x, *, sp=None, tp=None, pp=None,
 
     out, _ = stacked_rnn(params["rnn"], x, cell, unroll=unroll,
                          impl="scan", dropout=dropout,
-                         dropout_key=dropout_key)
-    return out[:, -1, :] @ params["fc"]["weight"].T + params["fc"]["bias"]
+                         dropout_key=dropout_key,
+                         compute_dtype=compute_dtype, remat=remat)
+    last = out[:, -1, :].astype(jnp.float32)
+    return last @ params["fc"]["weight"].T + params["fc"]["bias"]
 
 
 # ---------------------------------------------------------------------------
@@ -178,9 +188,11 @@ def _char_local_logits(params, tokens, *, sp=None, tp=None, pp=None,
     position (the final global position predicts nothing); the shifted
     target slice is local arithmetic because tokens are replicated, so no
     boundary exchange is needed.  Without ``sp``: full-window logits
-    (B, T-1, V), ``w_pos`` None.  ``compute_dtype``/``remat``/``dropout``
-    apply on the unsharded branch only (the sp/tp/pp stacks are
-    f32-structured; callers reject those combinations loudly).
+    (B, T-1, V), ``w_pos`` None.  ``compute_dtype``/``remat`` thread
+    through the unsharded AND ``sp`` branches (the relay stacks take the
+    same levers; the head stays f32); ``dropout`` is unsharded-only, and
+    the tp/pp stacks are f32-structured - callers reject those
+    combinations loudly.
     """
     if sum(a is not None for a in (sp, tp, pp)) > 1:
         raise ValueError("compose dp with at most one of sp/tp/pp")
@@ -201,9 +213,11 @@ def _char_local_logits(params, tokens, *, sp=None, tp=None, pp=None,
                                            axis=1)
         x_loc = params["embed"][tok_loc]
         out_local, _ = _sp_stack(cell, schedule)(
-            params["rnn"], x_loc, sp, unroll=unroll
+            params["rnn"], x_loc, sp, unroll=unroll,
+            compute_dtype=compute_dtype, remat=remat,
         )
-        logits = out_local @ head_w.T + head_b  # (B, t_local, V)
+        # (B, t_local, V); head in f32 like the unsharded branch
+        logits = out_local.astype(jnp.float32) @ head_w.T + head_b
         shifted = jnp.concatenate(
             [tokens[:, 1:], tokens[:, -1:]], axis=1
         )
@@ -279,6 +293,27 @@ def _axis_kwargs(axes: dict[str, int], cell: str = "lstm"):
     """{"sp": "sp" or None, ...} for the single active model axis."""
     model_axis = validate_rnn_mesh(axes, cell)
     return {a: (a if a == model_axis else None) for a in MODEL_AXES}
+
+
+def _reject_unsupported_mesh_levers(model_axis, precision: str,
+                                    remat: bool, dropout: float):
+    """Loud, never silent: bf16 + remat thread through the sp relay
+    stacks (the long-context flagship composition, VERDICT.md round-3
+    item 3) and the unsharded branch, but the tp/pp stacks are
+    f32-structured and no model axis threads dropout - honoring those
+    flags is not possible, so do not pretend to."""
+    if model_axis in ("tp", "pp") and (precision != "f32" or remat):
+        raise ValueError(
+            f"precision=bf16/remat are not supported on the {model_axis} "
+            f"mesh (f32-structured stage/gate kernels) - use a dp or "
+            f"dp x sp mesh, or drop the flag"
+        )
+    if model_axis is not None and dropout > 0.0:
+        raise ValueError(
+            f"dropout is not supported on the {model_axis} mesh (the "
+            "relay/stage kernels thread no dropout) - use a dp-only mesh "
+            "or --dropout 0"
+        )
 
 
 def make_char_mesh_train_step(optimizer, mesh, axes: dict[str, int], *,
@@ -373,18 +408,7 @@ def make_char_mesh_loss_fn(mesh, axes: dict[str, int], *,
     """
     kw = _axis_kwargs(axes, cell)
     model_axis = next((a for a, v in kw.items() if v is not None), None)
-    if model_axis is not None and (
-        precision != "f32" or remat or dropout > 0.0
-    ):
-        # loud, never silent: the sp/tp/pp stacks are f32-structured and
-        # thread no dropout, so honoring the flags is not possible - do
-        # not pretend to
-        raise ValueError(
-            f"precision=bf16/remat/dropout are not supported on the "
-            f"{model_axis} char mesh (f32-structured relay/stage kernels "
-            "without dropout threading) - use a dp-only mesh or drop the "
-            "flag"
-        )
+    _reject_unsupported_mesh_levers(model_axis, precision, remat, dropout)
     compute_dtype = jnp.bfloat16 if precision == "bf16" else None
 
     from functools import partial as _partial
@@ -434,7 +458,8 @@ def make_motion_mesh_loss_fn(mesh, axes: dict[str, int], *,
                              schedule: str = "wavefront",
                              num_microbatches: int = 4, unroll: int = 1,
                              weighted: bool = False, dropout: float = 0.0,
-                             cell: str = "lstm"):
+                             cell: str = "lstm", precision: str = "f32",
+                             remat: bool = False):
     """Shard_mapped ``loss_fn(params, x, y[, w][, key]) -> (loss,
     metrics)`` for the motion model over a composed mesh: ``x``/``y`` (and
     ``w``) shard their batch dim over ``dp``; the scalar loss and summed
@@ -443,8 +468,13 @@ def make_motion_mesh_loss_fn(mesh, axes: dict[str, int], *,
 
     ``dropout > 0`` (dp-only meshes; the trainer guards the model axes)
     appends a trailing replicated per-step PRNG key argument; each dp
-    shard folds its rank in for an independent mask."""
+    shard folds its rank in for an independent mask.  ``precision``/
+    ``remat`` thread through the unsharded and sp branches exactly like
+    the char mesh (tp/pp reject loudly)."""
     kw = _axis_kwargs(axes, cell)
+    model_axis = next((a for a, v in kw.items() if v is not None), None)
+    _reject_unsupported_mesh_levers(model_axis, precision, remat, dropout)
+    compute_dtype = jnp.bfloat16 if precision == "bf16" else None
 
     from functools import partial as _partial
 
@@ -467,7 +497,8 @@ def make_motion_mesh_loss_fn(mesh, axes: dict[str, int], *,
         logits = mesh_rnn_forward(
             params, x, schedule=schedule,
             num_microbatches=num_microbatches, unroll=unroll,
-            dropout=dropout, dropout_key=key, cell=cell, **kw,
+            dropout=dropout, dropout_key=key, cell=cell,
+            compute_dtype=compute_dtype, remat=remat, **kw,
         )
         local, correct = _classifier_loss_metrics(
             logits, y, extra[0] if weighted else None
@@ -545,6 +576,80 @@ def make_attention_mesh_loss_fn(model, mesh, *, weighted: bool = False):
         return (
             lax.pmean(local, "dp"),
             {"correct": lax.psum(correct, "dp")},
+        )
+
+    return loss_fn
+
+
+def make_moe_mesh_loss_fn(model, mesh, *, weighted: bool = False):
+    """Shard_mapped ``loss_fn(params, x, y[, w]) -> (loss, metrics)`` for a
+    :class:`~pytorch_distributed_rnn_tpu.models.MoEClassifier` over a
+    dp x ep mesh (either axis may have size 1).
+
+    Layout (the textbook MoE placement): batch rows shard over the FULL
+    dp x ep product - every device is a data shard for the backbone - and
+    the experts shard over ``ep`` (``parallel/ep.py``: all_to_all
+    dispatch/combine riding ICI).  Params replicated; grad outside the
+    shard_map re-reduces replicated-parameter cotangents and transposes
+    the all_to_alls into the reverse exchanges.
+
+    The weighted path computes the EXACT global weighted mean
+    (psum(num)/psum(den)) rather than the pmean-of-local-means shortcut:
+    with data sharded over two axes the live-count-balance precondition of
+    the shortcut (``_classifier_loss_metrics`` docstring) spans (dp, ep)
+    cells, and exactness here is free.  Aux statistics pmean over BOTH
+    axes, so the Switch loss is the global-batch value - identical to the
+    dense single-device path when capacity is ample.
+    """
+    from functools import partial as _partial
+
+    for axis in ("dp", "ep"):
+        if axis not in mesh.shape:
+            raise ValueError(
+                f"moe mesh needs axis {axis!r} (size 1 is fine); got "
+                f"{dict(mesh.shape)}"
+            )
+
+    from pytorch_distributed_rnn_tpu.ops.rnn import stacked_rnn
+    from pytorch_distributed_rnn_tpu.parallel.ep import ep_moe_ffn
+
+    data = ("dp", "ep")
+    batch_specs = (P(data), P(data)) + ((P(data),) if weighted else ())
+
+    @_partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(),) + batch_specs,
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    def loss_fn(params, x_local, y_local, *w):
+        out, _ = stacked_rnn(
+            params["rnn"], x_local, model.cell, unroll=model.unroll,
+            impl="scan",
+        )
+        moe_out, aux = ep_moe_ffn(
+            params["moe"], out, "ep",
+            capacity_factor=model.capacity_factor, stat_axes=data,
+        )
+        h = out + moe_out
+        last = h[:, -1, :].astype(jnp.float32)
+        logits = last @ params["fc"]["weight"].T + params["fc"]["bias"]
+
+        if weighted:
+            nll = cross_entropy_loss(logits, y_local, reduction="none")
+            num = lax.psum(jnp.sum(nll * w[0]), data)
+            den = lax.psum(jnp.sum(w[0]), data)
+            loss = num / jnp.maximum(den, 1.0)
+            correct = jnp.sum(
+                (jnp.argmax(logits, axis=1) == y_local) * (w[0] > 0)
+            )
+        else:
+            loss = lax.pmean(cross_entropy_loss(logits, y_local), data)
+            correct = jnp.sum(jnp.argmax(logits, axis=1) == y_local)
+        return (
+            loss + model.aux_weight * aux,
+            {"correct": lax.psum(correct, data)},
         )
 
     return loss_fn

@@ -218,6 +218,21 @@ class Trainer:
         k_conf = self.grad_accum
 
         def accum_step(params, opt_state, batch, *extra):
+            # *extra here can only be the dropout PRNG key: it is vmapped
+            # through fold_in below.  Any other payload (e.g. the weighted
+            # path's mask vector) would be silently consumed as key
+            # material - fail loudly instead.
+            assert len(extra) <= 1, (
+                f"accum_step takes at most a dropout key in *extra, "
+                f"got {len(extra)} extras"
+            )
+            if extra:
+                import jax.dtypes as _dtypes
+
+                d = extra[0].dtype
+                assert d == jnp.uint32 or _dtypes.issubdtype(
+                    d, _dtypes.prng_key
+                ), f"accum_step *extra must be a PRNG key, got dtype {d}"
             n = batch[0].shape[0]
             # the epoch's final partial batch (n = len(dataset) %
             # batch_size) need not divide by k: use the largest divisor

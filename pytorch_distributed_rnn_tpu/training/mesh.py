@@ -43,10 +43,28 @@ class MeshTrainer(SpmdTrainer):
         model = kwargs["model"]
         # the attention family composes the FULL dp x sp x tp mesh (ring
         # attention over sp, Megatron sharding over tp); RNN cells (motion
-        # classifier and char-LM alike) take dp plus at most one model axis
+        # classifier and char-LM alike) take dp plus at most one model
+        # axis; the MoE family takes dp x ep (experts sharded over ep)
         self.is_attention = hasattr(model, "num_heads")
         self.is_char = hasattr(model, "vocab_size")
-        if self.is_attention:
+        self.is_moe = hasattr(model, "num_experts")
+        # `!= 1`, not `> 1`: a -1 ("all remaining devices") size must hit
+        # these rejects too, not silently resolve into ghost replication
+        if not self.is_moe and axes.get("ep", 1) != 1:
+            raise ValueError(
+                "the ep axis shards MoE experts - it applies to "
+                "--model moe only (parallel/ep.py)"
+            )
+        if self.is_moe:
+            bad = [a for a in ("sp", "tp", "pp") if axes.get(a, 1) != 1]
+            if bad:
+                raise ValueError(
+                    f"--model moe composes dp x ep only; got {bad} "
+                    "(the attention family covers sp/tp composition)"
+                )
+            axes = {"dp": axes.get("dp", 1), "ep": axes.get("ep", 1)}
+            self.model_axis = None
+        elif self.is_attention:
             if axes.get("pp", 1) > 1:
                 raise ValueError(
                     "the attention family has no pipeline stages; use "
@@ -68,6 +86,13 @@ class MeshTrainer(SpmdTrainer):
         mesh = make_mesh(axes)
         # resolve -1 ("all remaining devices") to the actual size
         self.mesh_axes = {name: mesh.shape[name] for name in axes}
+        if self.is_moe and model.num_experts % self.mesh_axes["ep"]:
+            # after -1 resolution, so `ep=-1` fails here too, at
+            # construction rather than inside the first jitted step
+            raise ValueError(
+                f"--num-experts {model.num_experts} does not shard over "
+                f"ep={self.mesh_axes['ep']}"
+            )
         super().__init__(mesh=mesh, axis="dp", **kwargs)
         if self.is_char and self.model_axis == "sp":
             window = self.training_set.features.shape[1]
@@ -78,15 +103,18 @@ class MeshTrainer(SpmdTrainer):
                     f"divisible by sp={sp_size} - pick --seq-length so "
                     f"that sp divides seq_length + 1"
                 )
-        if self.is_char and self.model_axis is not None and (
+        if self.model_axis in ("tp", "pp") and (
             getattr(model, "precision", "f32") != "f32"
             or getattr(model, "remat", False)
         ):
-            # fail at construction, not at the first train step
+            # fail at construction, not at the first train step; bf16 +
+            # remat DO thread through dp and dp x sp meshes (the sp relay
+            # stacks take the same levers as the unsharded stack - the
+            # long-context + mixed-precision flagship composition)
             raise ValueError(
-                "--precision bf16/--remat are not supported on sp/tp/pp "
-                "char meshes (f32-structured relay/stage kernels) - use a "
-                "dp-only mesh or drop the flag"
+                "--precision bf16/--remat are not supported on tp/pp "
+                "meshes (f32-structured stage/gate kernels) - use a dp or "
+                "dp x sp mesh, or drop the flag"
             )
         if self._dropout > 0.0 and self.model_axis is not None:
             raise NotImplementedError(
@@ -95,7 +123,23 @@ class MeshTrainer(SpmdTrainer):
                 "reference surface, main.py:26)"
             )
 
+    def _data_world_size(self) -> int:
+        # moe shards batch rows over the FULL dp x ep product (every
+        # device is a data shard for the backbone); everything else
+        # shards data over dp only
+        if getattr(self, "is_moe", False):
+            return self.mesh.shape["dp"] * self.mesh.shape["ep"]
+        return super()._data_world_size()
+
     def _mesh_loss_fn(self, weighted: bool):
+        if self.is_moe:
+            from pytorch_distributed_rnn_tpu.parallel.strategy import (
+                make_moe_mesh_loss_fn,
+            )
+
+            return make_moe_mesh_loss_fn(
+                self.model, self.mesh, weighted=weighted
+            )
         if self.is_attention:
             from pytorch_distributed_rnn_tpu.parallel.strategy import (
                 make_attention_mesh_loss_fn,
@@ -122,6 +166,8 @@ class MeshTrainer(SpmdTrainer):
             num_microbatches=self.num_microbatches, weighted=weighted,
             dropout=self._dropout,
             cell=getattr(self.model, "cell", "lstm"),
+            precision=getattr(self.model, "precision", "f32"),
+            remat=getattr(self.model, "remat", False),
         )
 
     def _jit_replicated(self, fn):
@@ -220,6 +266,14 @@ def mesh_trainer_factory(args):
         from pytorch_distributed_rnn_tpu.training.lm import wrap_lm_trainer
 
         cls = wrap_lm_trainer(MeshTrainer)
+    elif getattr(args, "model", "rnn") == "moe":
+        # train steps come from make_moe_mesh_loss_fn (expert-parallel);
+        # the MoE mixin supplies the dense-exact EVAL surface + aux loss
+        from pytorch_distributed_rnn_tpu.training.moe import (
+            wrap_moe_trainer,
+        )
+
+        cls = wrap_moe_trainer(MeshTrainer)
 
     def build(**kwargs):
         return cls(
@@ -232,4 +286,5 @@ def mesh_trainer_factory(args):
     # tells _train_char_lm the LM loss is already wired in (wrapping the
     # factory's PRODUCT is not possible from outside - it is not a class)
     build.OWNS_LM_LOSS = True
+    build.OWNS_MOE_LOSS = True
     return build

@@ -111,6 +111,57 @@ def test_make_sp_forward_matches_model(sp_mesh, schedule):
     np.testing.assert_allclose(logits_sp, logits_ref, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.parametrize("stack_fn", [sp_stacked_lstm,
+                                      sp_stacked_lstm_wavefront])
+def test_sp_stack_bf16_close_to_f32(sp_mesh, stack_fn):
+    """bf16 compute threads through the relay stacks: same reordered
+    matmuls as the unsharded bf16 stack, f32 carries, so outputs track
+    the f32 reference to bf16 tolerance."""
+    params, x = _data(7, 2)
+
+    @partial(
+        shard_map, mesh=sp_mesh, in_specs=(P(), P(None, "sp")),
+        out_specs=P(None, "sp"), check_vma=False,
+    )
+    def run(p, x_local):
+        out, _ = stack_fn(p, x_local, "sp", compute_dtype=jnp.bfloat16)
+        return out
+
+    out_sp = jax.jit(run)(params, x)
+    assert out_sp.dtype == jnp.bfloat16
+    out_ref, _ = stacked_rnn(params, x, "lstm", impl="scan")
+    np.testing.assert_allclose(
+        np.asarray(out_sp, np.float32), out_ref, rtol=0.05, atol=0.02
+    )
+
+
+@pytest.mark.parametrize("stack_fn", [sp_stacked_lstm,
+                                      sp_stacked_lstm_wavefront])
+def test_sp_stack_remat_grads_exact(sp_mesh, stack_fn):
+    """jax.checkpoint around the relay (ppermutes replayed in backward)
+    changes memory, not numerics: grads match the non-remat stack
+    exactly."""
+    params, x = _data(8, 2)
+
+    def loss(p, x_local, remat):
+        out, _ = stack_fn(p, x_local, "sp", remat=remat)
+        return jax.lax.psum(jnp.sum(out ** 2), "sp")
+
+    def run(remat):
+        @partial(
+            shard_map, mesh=sp_mesh, in_specs=(P(), P(None, "sp")),
+            out_specs=P(), check_vma=False,
+        )
+        def f(p, x_local):
+            return loss(p, x_local, remat)
+
+        return jax.jit(jax.grad(f))(params, x)
+
+    g_plain, g_remat = run(False), run(True)
+    for a, b in zip(jax.tree.leaves(g_plain), jax.tree.leaves(g_remat)):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
 def test_sp_grad_matches_single_device(sp_mesh):
     """Backprop through the relay (ppermute transposes cleanly) matches
     single-device gradients - the property DP-over-SP training relies on."""
