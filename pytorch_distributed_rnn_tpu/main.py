@@ -53,12 +53,15 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--no-validation", action="store_true")
     parser.add_argument("--cell", default="lstm", choices=["lstm", "gru"])
     parser.add_argument(
-        "--model", default="rnn", choices=["rnn", "attention", "char"],
+        "--model", default="rnn",
+        choices=["rnn", "attention", "char", "moe"],
         help="model family: stacked RNN (reference parity), the "
         "attention classifier (long-context family; composes the full "
-        "dp x sp x tp mesh under the mesh strategy), or the byte-level "
+        "dp x sp x tp mesh under the mesh strategy), the byte-level "
         "char LM (next-token loss on --dataset-path corpus.txt windows, "
-        "synthetic motif stream when absent)",
+        "synthetic motif stream when absent), or the MoE classifier "
+        "(RNN backbone + Switch-routed expert FFN; experts shard over "
+        "the ep mesh axis under the mesh strategy)",
     )
     parser.add_argument(
         "--seq-length", default=None, type=int, metavar="T",
@@ -69,6 +72,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--num-heads", default=4, type=int,
         help="attention heads (--model attention; must divide "
         "--hidden-units)",
+    )
+    parser.add_argument(
+        "--num-experts", default=4, type=int,
+        help="expert count for --model moe (must shard over the ep mesh "
+        "axis); expert FFN hidden dim defaults to 2 x --hidden-units",
     )
     parser.add_argument("--resume", default=None, type=Path)
     parser.add_argument(

@@ -4,6 +4,7 @@ from pytorch_distributed_rnn_tpu.models.char_rnn import (
     char_rnn_50m,
     num_params,
 )
+from pytorch_distributed_rnn_tpu.models.moe import MoEClassifier
 from pytorch_distributed_rnn_tpu.models.motion import MotionModel
 from pytorch_distributed_rnn_tpu.models.toy import ToyModel
 
@@ -12,6 +13,7 @@ __all__ = [
     "CharRNN",
     "char_rnn_50m",
     "num_params",
+    "MoEClassifier",
     "MotionModel",
     "ToyModel",
 ]

@@ -1,0 +1,92 @@
+"""MoE sequence classifier: stacked RNN backbone + per-timestep MoE FFN.
+
+New capability - the reference has no mixture-of-experts anywhere (SURVEY.md
+parallelism checklist: expert parallelism **absent**).  This model makes the
+``ep`` mesh axis a first-class CLI citizen (``--model moe`` under the
+``local`` and ``mesh`` strategies), completing the reference's
+strategy-inversion (`/root/reference/src/motion/trainer/__init__.py:10-18`)
+for the last parallelism axis.
+
+Shape: the motion classifier's stacked LSTM/GRU backbone (B, T, H), then a
+top-1 Switch-style MoE FFN applied to EVERY timestep's hidden state with a
+residual connection, then the last-timestep f32 head.  Routing over B*T
+tokens gives the expert layer real token counts (the regime the ep
+``all_to_all`` dispatch exists for), unlike routing only the B last-step
+features.
+
+Two forward paths share one parameter tree:
+
+- :meth:`apply` / :meth:`apply_with_aux` - the dense O(E) path
+  (``ops/moe.py::moe_ffn_dense``): exact, single-device; used by ``local``
+  training and by evaluation under every strategy (the numerics reference).
+- the expert-parallel path - ``parallel/strategy.py::make_moe_mesh_loss_fn``
+  shards experts over ``ep`` and batch over dp x ep via
+  ``parallel/ep.py::ep_moe_ffn``; with ample capacity it equals the dense
+  path exactly (Switch drop semantics otherwise).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_rnn_tpu.ops.initializers import linear_init
+from pytorch_distributed_rnn_tpu.ops.moe import init_moe_ffn, moe_ffn_dense
+from pytorch_distributed_rnn_tpu.ops.rnn import init_stacked_rnn, stacked_rnn
+
+
+@dataclass(frozen=True)
+class MoEClassifier:
+    """Functional model: ``params = model.init(key)``,
+    ``logits = model.apply(params, x)`` (dense-exact path)."""
+
+    input_dim: int = 9
+    hidden_dim: int = 32
+    layer_dim: int = 2
+    output_dim: int = 6
+    num_experts: int = 4
+    expert_hidden: int | None = None  # default 2 * hidden_dim
+    capacity_factor: float = 2.0
+    aux_weight: float = 0.01  # Switch load-balancing loss weight
+    cell: str = "lstm"
+    unroll: int = 1
+
+    @property
+    def _expert_hidden(self) -> int:
+        return self.expert_hidden or 2 * self.hidden_dim
+
+    def init(self, key: jax.Array):
+        rnn_key, moe_key, fc_key = jax.random.split(key, 3)
+        return {
+            "rnn": init_stacked_rnn(
+                rnn_key, self.input_dim, self.hidden_dim, self.layer_dim,
+                self.cell,
+            ),
+            "moe": init_moe_ffn(
+                moe_key, self.hidden_dim, self.num_experts,
+                self._expert_hidden,
+            ),
+            "fc": linear_init(fc_key, self.hidden_dim, self.output_dim),
+        }
+
+    def features(self, params, x: jax.Array) -> jax.Array:
+        """Backbone + residual dense MoE: (B, T, in) -> ((B, T, H), aux)."""
+        out, _ = stacked_rnn(
+            params["rnn"], x, self.cell, unroll=self.unroll, impl="scan"
+        )
+        moe_out, aux = moe_ffn_dense(params["moe"], out)
+        return out + moe_out, aux
+
+    def apply_with_aux(self, params, x: jax.Array, dropout_key=None):
+        """(logits (B, out), aux scalar).  ``dropout_key`` accepted for the
+        shared ``_apply_model`` signature; the family has no dropout (the
+        CLI rejects the flag loudly)."""
+        h, aux = self.features(params, x)
+        last = h[:, -1, :].astype(jnp.float32)
+        logits = last @ params["fc"]["weight"].T + params["fc"]["bias"]
+        return logits, aux
+
+    def apply(self, params, x: jax.Array, dropout_key=None) -> jax.Array:
+        return self.apply_with_aux(params, x, dropout_key)[0]

@@ -28,12 +28,17 @@ from pytorch_distributed_rnn_tpu.ops.moe import (
 )
 
 
-def ep_moe_ffn(params, x_local, axis: str, *, capacity_factor: float = 2.0):
+def ep_moe_ffn(params, x_local, axis: str, *, capacity_factor: float = 2.0,
+               stat_axes=None):
     """Expert-parallel top-1 MoE FFN inside ``shard_map``.
 
     ``params`` replicated, ``x_local``: this shard's (..., D) tokens
     (batch-sharded along ``axis``).  Returns ``(out_local, aux_loss)`` with
-    ``aux_loss`` the global Switch load-balancing loss (psum'd).
+    ``aux_loss`` the Switch load-balancing loss averaged over
+    ``stat_axes`` (default: the expert axis only).  When tokens also
+    shard over other mesh axes (the dp x ep training layout), pass them
+    all so the aux fractions are means over the GLOBAL batch - averaging
+    per-shard aux products instead would bias the estimator.
     """
     n = lax.axis_size(axis)
     k = lax.axis_index(axis)
@@ -71,8 +76,9 @@ def ep_moe_ffn(params, x_local, axis: str, *, capacity_factor: float = 2.0):
     # per-shard means first (pmean of each factor), then combine; averaging
     # per-shard losses would bias the product
     one_hot = jax.nn.one_hot(expert, e, dtype=gates.dtype)
-    frac_tokens = lax.pmean(jnp.mean(one_hot, axis=0), axis)
-    frac_prob = lax.pmean(jnp.mean(gates, axis=0), axis)
+    stat_axes = (axis,) if stat_axes is None else stat_axes
+    frac_tokens = lax.pmean(jnp.mean(one_hot, axis=0), stat_axes)
+    frac_prob = lax.pmean(jnp.mean(gates, axis=0), stat_axes)
     aux = e * jnp.sum(frac_tokens * frac_prob)
     return out.reshape(shape), aux
 

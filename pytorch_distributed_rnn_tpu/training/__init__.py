@@ -103,6 +103,8 @@ def train(args, trainer_class):
             "--seq-length only applies to --model char (motion/attention "
             "sequence length is a property of the HAR data)"
         )
+    if getattr(args, "model", "rnn") == "moe":
+        return _train_moe(args, trainer_class)
 
     training_set, validation_set, test_set = _log_and_trim_datasets(
         args,
@@ -160,6 +162,66 @@ def train(args, trainer_class):
     return _run_trainer(
         args, trainer_class, model,
         (training_set, validation_set, test_set),
+    )
+
+
+def _train_moe(args, trainer_class):
+    """``--model moe``: RNN backbone + Switch-routed expert FFN - the EP
+    parallelism axis as a first-class CLI family (SURVEY.md checklist's
+    last absent axis; no reference counterpart).  ``local``/``distributed``/
+    ``horovod`` train the dense-exact path on the shared loop (experts
+    replicated); the ``mesh`` strategy shards experts over ``ep``
+    (``parallel/ep.py`` all_to_all dispatch) with batch rows over the full
+    dp x ep product.  Unsupported flags and strategies reject loudly."""
+    from pytorch_distributed_rnn_tpu.models import MoEClassifier
+    from pytorch_distributed_rnn_tpu.training.moe import wrap_moe_trainer
+
+    if getattr(args, "dropout", 0.0):
+        raise SystemExit(
+            "--model moe has no dropout - pass --dropout 0 (the CLI "
+            "default 0.1 mirrors the reference surface)"
+        )
+    unsupported = [
+        flag for flag, active in (
+            ("--precision bf16", getattr(args, "precision", "f32") != "f32"),
+            ("--remat", getattr(args, "remat", False)),
+        ) if active
+    ]
+    if unsupported:
+        raise SystemExit(
+            f"--model moe does not support: {', '.join(unsupported)}"
+        )
+    if getattr(trainer_class, "__name__", "") == "ZeroTrainer":
+        raise SystemExit(
+            "--model moe is not wired into the fsdp strategy (its "
+            "sharded-state programs are family-specific) - use local, "
+            "distributed, horovod, or mesh --mesh dp=..,ep=.."
+        )
+
+    training_set, validation_set, test_set = _log_and_trim_datasets(
+        args,
+        *MotionDataset.load(
+            args.dataset_path,
+            output_path=args.output_path,
+            validation_fraction=args.validation_fraction,
+            seed=args.seed,
+        ),
+    )
+    model = MoEClassifier(
+        input_dim=training_set.num_features,
+        hidden_dim=args.hidden_units,
+        layer_dim=args.stacked_layer,
+        output_dim=len(MotionDataset.LABELS),
+        num_experts=getattr(args, "num_experts", 4),
+        cell=getattr(args, "cell", "lstm"),
+    )
+    cls = (
+        trainer_class
+        if getattr(trainer_class, "OWNS_MOE_LOSS", False)
+        else wrap_moe_trainer(trainer_class)
+    )
+    return _run_trainer(
+        args, cls, model, (training_set, validation_set, test_set)
     )
 
 

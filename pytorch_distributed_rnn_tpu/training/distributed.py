@@ -65,7 +65,7 @@ class SpmdTrainer(Trainer):
     ):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.axis = axis
-        world_size = self.mesh.shape[axis]
+        world_size = self._data_world_size()
 
         sampler = DistributedSampler(
             len(training_set), num_replicas=world_size, rank=0, seed=seed or 0
@@ -93,6 +93,12 @@ class SpmdTrainer(Trainer):
         # collectives are global), so datasets are not dropped on
         # non-zero ranks; host-side evaluation is process-local.
         self.rank = jax.process_index()
+
+    def _data_world_size(self) -> int:
+        """How many equal shards each global batch splits into - the
+        sampler/loader "world".  Default: the dp axis; strategies that
+        shard data over MORE axes (the moe dp x ep layout) override."""
+        return self.mesh.shape[self.axis]
 
     def _get_formatter(self, epochs):
         return TrainingMessageFormatter(epochs, self.rank)

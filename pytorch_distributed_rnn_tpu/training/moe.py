@@ -1,0 +1,64 @@
+"""MoE loss adapter: the ``--model moe`` family on the shared loop.
+
+Mirrors :mod:`pytorch_distributed_rnn_tpu.training.lm`: the shared loop and
+every strategy consume ``_loss_and_metrics(params, (x, y), key)``
+(``training/base.py``); the MoE family differs only by adding the Switch
+load-balancing auxiliary loss to the classification objective, so this
+mixin swaps exactly that surface.  Train AND eval report CE +
+aux_weight * aux (one objective, comparable across epochs); accuracy
+bookkeeping is unchanged.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from pytorch_distributed_rnn_tpu.ops.losses import cross_entropy_loss
+
+
+class MoELossMixin:
+    """Overrides the two loss surfaces to include the Switch aux loss
+    (dense-exact forward; the mesh strategy overrides the train steps with
+    the expert-parallel program and uses this only for evaluation)."""
+
+    def _moe_logits_aux(self, params, x, key):
+        # _apply_model supplies shared dropout-key gating; the family has
+        # no dropout, so route directly through apply_with_aux
+        return self.model.apply_with_aux(params, x, key)
+
+    def _loss_and_metrics(self, params, batch, key=None):
+        x, y = batch
+        logits, aux = self._moe_logits_aux(params, x, key)
+        loss = cross_entropy_loss(logits, y) + self.model.aux_weight * aux
+        correct = jnp.sum(jnp.argmax(logits, axis=1) == y)
+        return loss, {"correct": correct}
+
+    def _weighted_loss_and_metrics(self, params, batch, w, key=None):
+        """0/1-weighted variant (fused-run padding mask).  The aux loss is
+        computed over ALL rows including padded ones - padding rows are
+        real (repeated) examples, so the router statistics stay
+        well-defined; with all-ones weights this equals the plain loss
+        exactly."""
+        x, y = batch
+        logits, aux = self._moe_logits_aux(params, x, key)
+        nll = cross_entropy_loss(logits, y, reduction="none")
+        loss = (
+            jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)
+            + self.model.aux_weight * aux
+        )
+        correct = jnp.sum((jnp.argmax(logits, axis=1) == y) * (w > 0))
+        return loss, {"correct": correct}
+
+
+_WRAPPED: dict = {}
+
+
+def wrap_moe_trainer(trainer_class):
+    """The trainer class with MoE losses mixed in (cached per base)."""
+    cls = _WRAPPED.get(trainer_class)
+    if cls is None:
+        cls = type(
+            f"MoE{trainer_class.__name__}", (MoELossMixin, trainer_class), {}
+        )
+        _WRAPPED[trainer_class] = cls
+    return cls
