@@ -1,0 +1,256 @@
+"""HLO collective-traffic report: the communication side of the scaling
+model, measured from the COMPILED programs instead of wall-clock.
+
+One chip (or a virtual CPU mesh) cannot measure scaling wall-clock - 8
+virtual devices share the same host cores, so the r2 "scaling study" had no
+scaling signal (VERDICT.md weak #3).  What the compiled program DOES pin
+down exactly, on any backend, is how many bytes each training step moves
+through each collective: XLA's post-optimization HLO carries every
+``all-reduce`` / ``all-gather`` / ``reduce-scatter`` /
+``collective-permute`` / ``all-to-all`` with concrete shapes.  Those bytes
+plus a link bandwidth ARE the communication term of the scaling model (the
+"How to Scale Your Model" recipe: count bytes, divide by ICI/DCN
+bandwidth, compare with compute time).
+
+``collective_stats`` parses a compiled module's text; ``report_programs``
+compiles the framework's flagship SPMD programs on a virtual mesh and
+returns one stats row per program.
+"""
+
+from __future__ import annotations
+
+import re
+
+# bytes per element for the dtypes XLA prints in shape strings
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "bf16": 2, "f16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+_COLLECTIVES = (
+    "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
+    "all-to-all",
+)
+
+# `f32[8,128]{1,0} all-reduce(` and tuple-shaped variants
+_OP_RE = re.compile(
+    r"=\s*(?P<shape>\([^)]*\)|\S+)\s+"
+    r"(?P<op>" + "|".join(_COLLECTIVES) + r")(?:-start|-done)?\("
+)
+_SHAPE_RE = re.compile(r"(?P<dtype>[a-z]+\d*)\[(?P<dims>[\d,]*)\]")
+
+
+def _shape_bytes(shape_str: str) -> int:
+    """Total bytes of one HLO shape string (tuples summed)."""
+    total = 0
+    for m in _SHAPE_RE.finditer(shape_str):
+        dtype = m.group("dtype")
+        width = _DTYPE_BYTES.get(dtype)
+        if width is None:
+            continue  # token[] and friends carry no data
+        dims = m.group("dims")
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * width
+    return total
+
+
+def collective_stats(hlo_text: str) -> dict:
+    """{op_kind: {"count": N, "bytes": output bytes per step}} over a
+    compiled module's text.  ``-start``/``-done`` async pairs count once,
+    via the ``-done`` side: a ``-start`` result tuple bundles operand
+    aliases WITH the result buffers, so summing it would double-count the
+    transfer, while the ``-done`` result is exactly the transferred
+    data."""
+    stats: dict = {}
+    for line in hlo_text.splitlines():
+        m = _OP_RE.search(line)
+        if not m or "-start(" in line:
+            continue
+        op = m.group("op")
+        entry = stats.setdefault(op, {"count": 0, "bytes": 0})
+        entry["count"] += 1
+        entry["bytes"] += _shape_bytes(m.group("shape"))
+    return stats
+
+
+def compiled_text(fn, *args) -> str:
+    import jax
+
+    return jax.jit(fn).lower(*args).compile().as_text()
+
+
+def _motion_dp_program(n: int):
+    """Data-parallel motion step on a dp=n mesh (the DDP strategy's
+    gradient psum -> XLA AllReduce)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from pytorch_distributed_rnn_tpu.models import MotionModel
+    from pytorch_distributed_rnn_tpu.ops import cross_entropy_loss
+    from pytorch_distributed_rnn_tpu.parallel import (
+        make_mesh,
+        make_spmd_train_step,
+    )
+
+    mesh = make_mesh({"dp": n})
+    model = MotionModel(input_dim=9, hidden_dim=32, layer_dim=2,
+                        output_dim=6, impl="scan")
+    params = model.init(jax.random.PRNGKey(0))
+    opt = optax.adam(2.5e-3)
+    opt_state = opt.init(params)
+
+    def loss_and_metrics(p, batch):
+        x, y = batch
+        logits = model.apply(p, x)
+        return cross_entropy_loss(logits, y), {
+            "correct": jnp.sum(jnp.argmax(logits, axis=1) == y)
+        }
+
+    step = make_spmd_train_step(loss_and_metrics, optax.adam(2.5e-3), mesh,
+                                donate=False)
+    rng = np.random.RandomState(0)
+    batch = (
+        jnp.asarray(rng.randn(2 * n, 16, 9).astype(np.float32)),
+        jnp.asarray(rng.randint(0, 6, size=2 * n)),
+    )
+    # make_spmd_train_step returns an already-jitted step
+    return (
+        step.lower(params, opt_state, batch).compile().as_text(),
+        params,
+    )
+
+
+def _fsdp_program(n: int):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from pytorch_distributed_rnn_tpu.models import CharRNN
+    from pytorch_distributed_rnn_tpu.parallel import make_mesh
+    from pytorch_distributed_rnn_tpu.parallel.zero import (
+        init_sharded,
+        init_sharded_opt_state,
+        make_fsdp_train_step,
+    )
+
+    mesh = make_mesh({"dp": n})
+    lm = CharRNN(vocab_size=32, embed_dim=16, hidden_dim=16 * n,
+                 layer_dim=1, impl="scan")
+    params, shard = init_sharded(lm, jax.random.PRNGKey(3), mesh)
+    opt = optax.adam(1e-3)
+    state, oshard = init_sharded_opt_state(opt, params, mesh)
+    step = make_fsdp_train_step(lm.loss, opt, mesh, shard, oshard,
+                                donate=False)
+    rng = np.random.RandomState(0)
+    tok = jnp.asarray(rng.randint(0, 32, size=(n, 8)), jnp.int32)
+    return step.lower(params, state, tok).compile().as_text(), params
+
+
+def _char_sp_program(dp: int, sp: int):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from pytorch_distributed_rnn_tpu.models import CharRNN
+    from pytorch_distributed_rnn_tpu.parallel import make_mesh
+    from pytorch_distributed_rnn_tpu.parallel.strategy import (
+        make_char_mesh_loss_fn,
+        make_mesh_grad_step,
+    )
+
+    axes = {"dp": dp, "sp": sp}
+    mesh = make_mesh(axes)
+    lm = CharRNN(vocab_size=32, embed_dim=8, hidden_dim=8, layer_dim=2,
+                 impl="scan")
+    params = lm.init(jax.random.PRNGKey(4))
+    opt = optax.adam(1e-3)
+    state = opt.init(params)
+    loss_fn = make_char_mesh_loss_fn(mesh, axes)
+    step = make_mesh_grad_step(loss_fn, opt)
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, 32, size=(2 * dp, 16)), jnp.int32)
+    batch = (toks, jnp.zeros(2 * dp, jnp.int32))
+    import jax as _jax
+
+    return (
+        _jax.jit(step).lower(params, state, batch).compile().as_text(),
+        params,
+    )
+
+
+def _moe_ep_program(dp: int, ep: int):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from pytorch_distributed_rnn_tpu.models import MoEClassifier
+    from pytorch_distributed_rnn_tpu.parallel import make_mesh
+    from pytorch_distributed_rnn_tpu.parallel.strategy import (
+        make_mesh_grad_step,
+        make_moe_mesh_loss_fn,
+    )
+
+    mesh = make_mesh({"dp": dp, "ep": ep})
+    model = MoEClassifier(input_dim=9, hidden_dim=16, layer_dim=1,
+                          output_dim=6, num_experts=ep * 2)
+    params = model.init(jax.random.PRNGKey(0))
+    opt = optax.adam(1e-3)
+    state = opt.init(params)
+    step = make_mesh_grad_step(make_moe_mesh_loss_fn(model, mesh), opt)
+    rng = np.random.RandomState(0)
+    batch = (
+        jnp.asarray(rng.randn(2 * dp * ep, 12, 9).astype(np.float32)),
+        jnp.asarray(rng.randint(0, 6, size=2 * dp * ep)),
+    )
+    return (
+        jax.jit(step).lower(params, state, batch).compile().as_text(),
+        params,
+    )
+
+
+def param_bytes(params) -> int:
+    import jax
+    import numpy as np
+
+    return int(sum(
+        np.prod(p.shape) * p.dtype.itemsize for p in jax.tree.leaves(params)
+    ))
+
+
+def report_programs(n_devices: int = 8) -> list[dict]:
+    """Compile the flagship SPMD programs on an ``n_devices`` virtual mesh
+    and report each one's per-step collective traffic."""
+    if n_devices < 4 or n_devices % 4:
+        raise ValueError(
+            f"collective-report needs a multiple of 4 devices (the sp/ep "
+            f"rows factor the mesh as dp x 4), got {n_devices}"
+        )
+    rows = []
+    for name, build in (
+        (f"motion dp={n_devices} (DDP grad psum)",
+         lambda: _motion_dp_program(n_devices)),
+        (f"char fsdp dp={n_devices} (ZeRO gather/scatter)",
+         lambda: _fsdp_program(n_devices)),
+        (f"char mesh dp={n_devices // 4},sp=4 (relay ppermute)",
+         lambda: _char_sp_program(n_devices // 4, 4)),
+        (f"moe mesh dp={n_devices // 4},ep=4 (all_to_all dispatch)",
+         lambda: _moe_ep_program(n_devices // 4, 4)),
+    ):
+        text, params = build()
+        rows.append({
+            "program": name,
+            "param_bytes": param_bytes(params),
+            "collectives": collective_stats(text),
+        })
+    return rows

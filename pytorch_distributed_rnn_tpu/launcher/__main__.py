@@ -91,6 +91,11 @@ def main(argv=None):
     _add_common(p)
     p.add_argument("--devices", type=int, default=2)
     p.add_argument("--batch-size", type=int, default=1440)
+    p.add_argument(
+        "--native-ranks", type=int, default=4,
+        help="world size for the perturbed distributed-native rows (the "
+        "ring allreduce that crosses the fault-injected TCP links)",
+    )
 
     p = sub.add_parser("run-slots")
     _add_common(p)
@@ -109,6 +114,10 @@ def main(argv=None):
     p.add_argument("--timeout", type=float, default=1800)
     p.add_argument("cli", nargs=argparse.REMAINDER,
                    help="main.py flags after --")
+
+    p = sub.add_parser("collective-report")
+    p.add_argument("--devices", type=int, default=8)
+    p.add_argument("--results", default="results_collectives.json")
 
     p = sub.add_parser("run-world")
     p.add_argument("--transport", choices=["native", "jax"], default="native")
@@ -138,6 +147,30 @@ def main(argv=None):
         return _run_world(args)
     if args.task == "run-hosts":
         return _run_hosts(args)
+
+    if args.task == "collective-report":
+        import json
+
+        # probe-first like bench.py (commit 8e3b014): a hung ambient
+        # plugin must fall back to a virtual CPU mesh, and a plain host
+        # needs the device count provisioned before first backend use
+        from pytorch_distributed_rnn_tpu.utils import ensure_usable_backend
+
+        ensure_usable_backend(min_devices=args.devices)
+
+        from pytorch_distributed_rnn_tpu.evaluation.collectives import (
+            report_programs,
+        )
+
+        rows = report_programs(args.devices)
+        with open(args.results, "w") as f:
+            json.dump(rows, f, indent=1)
+        for row in rows:
+            print(row["program"])
+            for op, s in sorted(row["collectives"].items()):
+                print(f"  {op:20s} x{s['count']:<3d} {s['bytes']:>12,d} B")
+        print(f"-> {args.results}")
+        return 0
 
     if args.task == "preflight":
         for ident in bench.preflight(args.world_size):
@@ -178,6 +211,7 @@ def main(argv=None):
             extra_parameters=_dataset_parameters(args),
             backend=args.backend,
             timeout=args.timeout,
+            native_ranks=args.native_ranks,
         )
         return _report(executed, args.results)
 
