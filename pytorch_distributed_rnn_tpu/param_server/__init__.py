@@ -45,16 +45,9 @@ def execute(args):
             "--profile is not supported by the parameter-server strategy "
             "(training runs in spawned worker processes)"
         )
-    if getattr(args, "model", "rnn") != "rnn":
-        # loud, never silent (the PARITY.md dead-flag principle): the PS
-        # runner builds the motion RNN itself
-        raise SystemExit(
-            "parameter-server trains the motion RNN family only - "
-            f"--model {args.model} is not wired here"
-        )
-    if getattr(args, "seq_length", None) is not None:
-        raise SystemExit(
-            "--seq-length only applies to --model char (not wired into "
-            "parameter-server)"
-        )
+    from pytorch_distributed_rnn_tpu.training.families import require_family
+
+    # char's vocab-head gradients are the transport stressor; moe stays
+    # with the in-process strategies
+    require_family(args, ("rnn", "char", "attention"), "parameter-server")
     return run(args)

@@ -25,32 +25,23 @@ from pytorch_distributed_rnn_tpu.runtime import Communicator
 log = logging.getLogger(__name__)
 
 
-def _build_model_and_flat_params(args, num_features: int, seed):
-    from pytorch_distributed_rnn_tpu.data import MotionDataset
-    from pytorch_distributed_rnn_tpu.models import MotionModel
+def _build_model_and_flat_params(args, training_set, seed):
+    """Family-aware model + flat parameter vector (the PS wire format).
+    Families rnn/char/attention via ``training/families.py`` - master and
+    workers must build the IDENTICAL model from the same flags/seed, so
+    the one construction path serves both roles."""
+    from pytorch_distributed_rnn_tpu.training import families
 
-    model = MotionModel(
-        input_dim=num_features,
-        hidden_dim=args.hidden_units,
-        layer_dim=args.stacked_layer,
-        output_dim=len(MotionDataset.LABELS),
-        cell=getattr(args, "cell", "lstm"),
-        dropout=getattr(args, "dropout", 0.0) or 0.0,
-    )
+    model = families.build_model(args, training_set)
     params = model.init(jax.random.PRNGKey(seed if seed is not None else 0))
     flat, unravel = ravel_pytree(params)
     return model, np.asarray(flat, np.float32), unravel
 
 
 def _load_datasets(args):
-    from pytorch_distributed_rnn_tpu.data import MotionDataset
+    from pytorch_distributed_rnn_tpu.training import families
 
-    return MotionDataset.load(
-        args.dataset_path,
-        output_path=args.output_path,
-        validation_fraction=args.validation_fraction,
-        seed=args.seed,
-    )
+    return families.load_datasets(args)
 
 
 def run_master(args):
@@ -61,7 +52,7 @@ def run_master(args):
     logging.basicConfig(level=args.log)
     training_set, _, _ = _load_datasets(args)
     _, flat, unravel = _build_model_and_flat_params(
-        args, training_set.num_features, args.seed
+        args, training_set, args.seed
     )
 
     optimizer = optax.adam(args.learning_rate)
@@ -112,10 +103,13 @@ def run_worker(args, rank: int):
     )
     training_set, _, _ = _load_datasets(args)
     model, _, _ = _build_model_and_flat_params(
-        args, training_set.num_features, args.seed
+        args, training_set, args.seed
     )
+    from pytorch_distributed_rnn_tpu.training import families
+
+    trainer_class = families.wrap_trainer(args, ParameterServerWorkerTrainer)
     try:
-        trainer = ParameterServerWorkerTrainer(
+        trainer = trainer_class(
             comm,
             model,
             training_set,

@@ -11,7 +11,6 @@ import json
 import logging
 
 from pytorch_distributed_rnn_tpu.data import MotionDataset
-from pytorch_distributed_rnn_tpu.models import MotionModel
 from pytorch_distributed_rnn_tpu.training.base import Trainer
 from pytorch_distributed_rnn_tpu.training.distributed import (
     DDPTrainer,
@@ -98,67 +97,22 @@ def train(args, trainer_class):
 
     if getattr(args, "model", "rnn") == "char":
         return _train_char_lm(args, trainer_class)
-    if getattr(args, "seq_length", None) is not None:
-        raise SystemExit(
-            "--seq-length only applies to --model char (motion/attention "
-            "sequence length is a property of the HAR data)"
-        )
     if getattr(args, "model", "rnn") == "moe":
+        if getattr(args, "seq_length", None) is not None:
+            raise SystemExit(
+                "--seq-length only applies to --model char"
+            )
         return _train_moe(args, trainer_class)
 
+    # families.load_datasets rejects --seq-length off-char; build_model
+    # carries every family's loud flag rejects (the ONE construction path,
+    # shared with distributed-native and the parameter server)
+    from pytorch_distributed_rnn_tpu.training import families
+
     training_set, validation_set, test_set = _log_and_trim_datasets(
-        args,
-        *MotionDataset.load(
-            args.dataset_path,
-            output_path=args.output_path,
-            validation_fraction=args.validation_fraction,
-            seed=args.seed,
-        ),
+        args, *families.load_datasets(args)
     )
-
-    if getattr(args, "model", "rnn") == "attention":
-        # loud, never silent: a silently-ignored flag is exactly the
-        # reference quirk PARITY.md fixes (main.py:26 --dropout)
-        if getattr(args, "dropout", 0.0):
-            raise SystemExit(
-                "--model attention has no dropout - pass --dropout 0 "
-                "(the CLI default 0.1 mirrors the reference surface)"
-            )
-        unsupported = [
-            flag for flag, active in (
-                ("--precision bf16", getattr(args, "precision", "f32") != "f32"),
-                ("--remat", getattr(args, "remat", False)),
-                ("--cell gru", getattr(args, "cell", "lstm") != "lstm"),
-            ) if active
-        ]
-        if unsupported:
-            raise SystemExit(
-                f"--model attention does not support: "
-                f"{', '.join(unsupported)}"
-            )
-        from pytorch_distributed_rnn_tpu.models import AttentionClassifier
-
-        model = AttentionClassifier(
-            input_dim=training_set.num_features,
-            dim=args.hidden_units,
-            depth=args.stacked_layer,
-            num_heads=getattr(args, "num_heads", 4),
-            output_dim=len(MotionDataset.LABELS),
-        )
-    else:
-        model = MotionModel(
-            input_dim=training_set.num_features,
-            hidden_dim=args.hidden_units,
-            layer_dim=args.stacked_layer,
-            output_dim=len(MotionDataset.LABELS),
-            cell=getattr(args, "cell", "lstm"),
-            precision=getattr(args, "precision", "f32"),
-            remat=getattr(args, "remat", False),
-            # real (train-mode) dropout - the reference parses but never
-            # uses --dropout (/root/reference/src/motion/main.py:26)
-            dropout=getattr(args, "dropout", 0.0) or 0.0,
-        )
-
+    model = families.build_model(args, training_set)
     return _run_trainer(
         args, trainer_class, model,
         (training_set, validation_set, test_set),
@@ -229,37 +183,15 @@ def _train_char_lm(args, trainer_class):
     """``--model char``: byte-level LM on token windows - the stress family
     (BASELINE.json config 5) as a first-class CLI citizen.  Same shared
     loop and strategies; only the dataset and the loss surface differ
-    (``data/text.py``, ``training/lm.py``)."""
-    from pytorch_distributed_rnn_tpu.data.text import TextDataset
-    from pytorch_distributed_rnn_tpu.models import CharRNN
+    (``data/text.py``, ``training/lm.py``; construction shared with the
+    native-transport strategies via ``training/families.py``)."""
+    from pytorch_distributed_rnn_tpu.training import families
     from pytorch_distributed_rnn_tpu.training.lm import wrap_lm_trainer
 
-    seq_length = getattr(args, "seq_length", None)
-    if seq_length is None:
-        seq_length = 128
-    elif seq_length < 1:
-        raise SystemExit(f"--seq-length must be >= 1, got {seq_length}")
-
     training_set, validation_set, test_set = _log_and_trim_datasets(
-        args,
-        *TextDataset.load(
-            args.dataset_path,
-            seq_length=seq_length,
-            validation_fraction=args.validation_fraction,
-            seed=args.seed,
-        ),
+        args, *families.load_datasets(args)
     )
-
-    model = CharRNN(
-        vocab_size=training_set.vocab_size,
-        embed_dim=args.hidden_units,
-        hidden_dim=args.hidden_units,
-        layer_dim=args.stacked_layer,
-        cell=getattr(args, "cell", "lstm"),
-        precision=getattr(args, "precision", "f32"),
-        remat=getattr(args, "remat", False),
-        dropout=getattr(args, "dropout", 0.0) or 0.0,
-    )
+    model = families.build_model(args, training_set)
     if getattr(trainer_class, "OWNS_LM_LOSS", False):
         lm_trainer_class = trainer_class  # mesh factory: LM loss wired in
     else:

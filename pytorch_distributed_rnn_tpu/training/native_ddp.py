@@ -135,11 +135,12 @@ class NativeDDPTrainer(Trainer):
         return step
 
 
-def run_rank(comm, args, model, datasets):
+def run_rank(comm, args, model, datasets, trainer_class=None):
     """Train this rank's replica; returns the trainer (rank 0 writes
-    ``history.json``, every rank logs its perf line)."""
+    ``history.json``, every rank logs its perf line).  ``trainer_class``
+    lets a family mix its loss surface over :class:`NativeDDPTrainer`."""
     training_set, validation_set, test_set = datasets
-    trainer = NativeDDPTrainer(
+    trainer = (trainer_class or NativeDDPTrainer)(
         comm=comm,
         model=model,
         training_set=training_set,
@@ -218,42 +219,25 @@ def launch_world(world_size: int, cli_args, *, master_port: int = 29533,
 def execute(args):
     """CLI entry for one rank (``distributed-native`` subcommand): world
     topology from MASTER_ADDR/MASTER_PORT/RANK/WORLD_SIZE env - exactly how
-    mpirun-launched ranks discovered theirs in the reference."""
-    from pytorch_distributed_rnn_tpu.data import MotionDataset
-    from pytorch_distributed_rnn_tpu.models import MotionModel
-    from pytorch_distributed_rnn_tpu.runtime.native import init_from_env
+    mpirun-launched ranks discovered theirs in the reference.
 
-    if getattr(args, "model", "rnn") != "rnn":
-        # loud, never silent (the PARITY.md dead-flag principle): this
-        # strategy builds the motion RNN itself
-        raise SystemExit(
-            "distributed-native trains the motion RNN family only - "
-            f"--model {args.model} is not wired here"
-        )
-    if getattr(args, "seq_length", None) is not None:
-        raise SystemExit(
-            "--seq-length only applies to --model char (not wired into "
-            "distributed-native)"
-        )
+    Families: rnn / char / attention (``training/families.py``) - the
+    char-LM's bigger gradient vector (vocab head) is exactly what
+    stresses the per-step TCP allreduce."""
+    from pytorch_distributed_rnn_tpu.runtime.native import init_from_env
+    from pytorch_distributed_rnn_tpu.training import families
+
+    families.require_family(
+        args, ("rnn", "char", "attention"), "distributed-native"
+    )
     logging.basicConfig(level=args.log)
     logging.getLogger().setLevel(args.log)
 
-    datasets = MotionDataset.load(
-        args.dataset_path,
-        output_path=args.output_path,
-        validation_fraction=args.validation_fraction,
-        seed=args.seed,
-    )
+    datasets = families.load_datasets(args)
     if args.no_validation:
         datasets = (datasets[0], None, None)
-    model = MotionModel(
-        input_dim=datasets[0].num_features,
-        hidden_dim=args.hidden_units,
-        layer_dim=args.stacked_layer,
-        output_dim=len(MotionDataset.LABELS),
-        cell=getattr(args, "cell", "lstm"),
-        precision=getattr(args, "precision", "f32"),
-        remat=getattr(args, "remat", False),
-    )
+    model = families.build_model(args, datasets[0])
     with init_from_env() as comm:
-        return run_rank(comm, args, model, datasets)
+        return run_rank(comm, args, model, datasets,
+                        trainer_class=families.wrap_trainer(
+                            args, NativeDDPTrainer))
