@@ -66,7 +66,13 @@ def collective_stats(hlo_text: str) -> dict:
     via the ``-done`` side: a ``-start`` result tuple bundles operand
     aliases WITH the result buffers, so summing it would double-count the
     transfer, while the ``-done`` result is exactly the transferred
-    data."""
+    data.
+
+    CAVEAT: text parsing sees each op ONCE even when it sits inside a
+    ``while`` body (a ``lax.scan`` - e.g. the sp relay's per-turn
+    ppermute), so loop-executed collectives are understated by the trip
+    count.  :func:`trace_collective_stats` counts from the jaxpr, where
+    scan lengths are static - use that for per-step traffic totals."""
     stats: dict = {}
     for line in hlo_text.splitlines():
         m = _OP_RE.search(line)
@@ -83,6 +89,92 @@ def compiled_text(fn, *args) -> str:
     import jax
 
     return jax.jit(fn).lower(*args).compile().as_text()
+
+
+# jax collective primitives -> the HLO op names the rest of the report uses
+_COLLECTIVE_PRIMS = {
+    "psum": "all-reduce",
+    "pmax": "all-reduce",
+    "pmin": "all-reduce",
+    "ppermute": "collective-permute",
+    "all_to_all": "all-to-all",
+    "all_gather": "all-gather",
+    "psum_scatter": "reduce-scatter",
+}
+
+
+def trace_collective_stats(fn, *args) -> dict:
+    """Per-step collective traffic counted from the JAXPR (trace only, no
+    compile): every collective primitive's result bytes, with enclosing
+    ``lax.scan`` trip counts multiplied in - the count HLO text parsing
+    gets wrong for loop-executed collectives (the sp relay's per-turn
+    ppermute compiles to ONE collective-permute inside a ``while`` body
+    but executes ``sp`` times per step).  Gradient collectives are
+    included when ``fn`` contains the grad (trace the full train step).
+
+    Bytes are per-device result sizes (the same convention as the HLO
+    parse).  XLA may later merge small same-operand collectives, so the
+    compiled COUNT can be lower; the traced BYTES are the semantic
+    per-step traffic the scaling model needs.
+    """
+    import jax
+    import numpy as np
+
+    closed = jax.make_jaxpr(fn)(*args)
+    jaxpr_cls = type(closed.jaxpr)
+    closed_cls = type(closed)
+    stats: dict = {}
+
+    def add(op, count, nbytes):
+        entry = stats.setdefault(op, {"count": 0, "bytes": 0})
+        entry["count"] += count
+        entry["bytes"] += nbytes
+
+    def aval_bytes(var):
+        aval = getattr(var, "aval", None)
+        if aval is None or not hasattr(aval, "shape"):
+            return 0
+        if not hasattr(aval, "dtype"):
+            return 0
+        n = int(np.prod(aval.shape, dtype=np.int64)) if aval.shape else 1
+        return n * aval.dtype.itemsize
+
+    def subjaxprs(params):
+        found = []
+
+        def maybe(x):
+            if isinstance(x, closed_cls):
+                found.append(x.jaxpr)
+            elif isinstance(x, jaxpr_cls):
+                found.append(x)
+
+        for value in params.values():
+            maybe(value)
+            if isinstance(value, (tuple, list)):
+                for item in value:
+                    maybe(item)
+        return found
+
+    def visit(jaxpr, mult):
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            if name in _COLLECTIVE_PRIMS:
+                nbytes = sum(aval_bytes(v) for v in eqn.outvars)
+                add(_COLLECTIVE_PRIMS[name], mult, nbytes * mult)
+            sub_mult = mult
+            if name == "scan":
+                sub_mult = mult * int(eqn.params.get("length", 1))
+            elif name == "while":
+                # dynamic trip count: cannot be known from the trace -
+                # count once and surface the uncertainty
+                add("while-body(unknown-trip-count)", 1, 0)
+            for sub in subjaxprs(eqn.params):
+                visit(sub, sub_mult)
+
+    visit(closed.jaxpr, 1)
+    if stats.get("while-body(unknown-trip-count)", {}).get("count") == 0:
+        stats.pop("while-body(unknown-trip-count)", None)
+    return stats
 
 
 def _motion_dp_program(n: int):
@@ -114,18 +206,13 @@ def _motion_dp_program(n: int):
             "correct": jnp.sum(jnp.argmax(logits, axis=1) == y)
         }
 
-    step = make_spmd_train_step(loss_and_metrics, optax.adam(2.5e-3), mesh,
-                                donate=False)
+    step = make_spmd_train_step(loss_and_metrics, opt, mesh, donate=False)
     rng = np.random.RandomState(0)
     batch = (
         jnp.asarray(rng.randn(2 * n, 16, 9).astype(np.float32)),
         jnp.asarray(rng.randint(0, 6, size=2 * n)),
     )
-    # make_spmd_train_step returns an already-jitted step
-    return (
-        step.lower(params, opt_state, batch).compile().as_text(),
-        params,
-    )
+    return step, (params, opt_state, batch), params
 
 
 def _fsdp_program(n: int):
@@ -152,7 +239,7 @@ def _fsdp_program(n: int):
                                 donate=False)
     rng = np.random.RandomState(0)
     tok = jnp.asarray(rng.randint(0, 32, size=(n, 8)), jnp.int32)
-    return step.lower(params, state, tok).compile().as_text(), params
+    return step, (params, state, tok), params
 
 
 def _char_sp_program(dp: int, sp: int):
@@ -180,12 +267,7 @@ def _char_sp_program(dp: int, sp: int):
     rng = np.random.RandomState(0)
     toks = jnp.asarray(rng.randint(0, 32, size=(2 * dp, 16)), jnp.int32)
     batch = (toks, jnp.zeros(2 * dp, jnp.int32))
-    import jax as _jax
-
-    return (
-        _jax.jit(step).lower(params, state, batch).compile().as_text(),
-        params,
-    )
+    return jax.jit(step), (params, state, batch), params
 
 
 def _moe_ep_program(dp: int, ep: int):
@@ -213,10 +295,7 @@ def _moe_ep_program(dp: int, ep: int):
         jnp.asarray(rng.randn(2 * dp * ep, 12, 9).astype(np.float32)),
         jnp.asarray(rng.randint(0, 6, size=2 * dp * ep)),
     )
-    return (
-        jax.jit(step).lower(params, state, batch).compile().as_text(),
-        params,
-    )
+    return jax.jit(step), (params, state, batch), params
 
 
 def param_bytes(params) -> int:
@@ -229,8 +308,10 @@ def param_bytes(params) -> int:
 
 
 def report_programs(n_devices: int = 8) -> list[dict]:
-    """Compile the flagship SPMD programs on an ``n_devices`` virtual mesh
-    and report each one's per-step collective traffic."""
+    """Trace the flagship SPMD programs on an ``n_devices`` virtual mesh
+    and report each one's per-step collective traffic (jaxpr-counted, so
+    scan-executed collectives carry their trip counts - see
+    :func:`trace_collective_stats`)."""
     if n_devices < 4 or n_devices % 4:
         raise ValueError(
             f"collective-report needs a multiple of 4 devices (the sp/ep "
@@ -247,10 +328,20 @@ def report_programs(n_devices: int = 8) -> list[dict]:
         (f"moe mesh dp={n_devices // 4},ep=4 (all_to_all dispatch)",
          lambda: _moe_ep_program(n_devices // 4, 4)),
     ):
-        text, params = build()
+        fn, call_args, params = build()
+        # Two complementary views, each honest about its blind spot:
+        # - traced: jaxpr collectives with scan trip counts multiplied in
+        #   (the semantic per-step traffic), but BLIND to GSPMD-inserted
+        #   collectives - sharding-annotation programs like the ZeRO step
+        #   trace as empty because the compiler inserts their gathers;
+        # - compiled: the post-optimization HLO ops (GSPMD included), but
+        #   a collective inside a while body (a lax.scan) is counted once
+        #   regardless of trip count.
+        # Read per-op totals as max(traced, compiled).
         rows.append({
             "program": name,
             "param_bytes": param_bytes(params),
-            "collectives": collective_stats(text),
+            "traced": trace_collective_stats(fn, *call_args),
+            "compiled": collective_stats(compiled_text(fn, *call_args)),
         })
     return rows

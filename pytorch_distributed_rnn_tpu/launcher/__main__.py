@@ -167,8 +167,10 @@ def main(argv=None):
             json.dump(rows, f, indent=1)
         for row in rows:
             print(row["program"])
-            for op, s in sorted(row["collectives"].items()):
-                print(f"  {op:20s} x{s['count']:<3d} {s['bytes']:>12,d} B")
+            for view in ("traced", "compiled"):
+                for op, s in sorted(row[view].items()):
+                    print(f"  {view:8s} {op:22s} x{s['count']:<4d}"
+                          f" {s['bytes']:>12,d} B")
         print(f"-> {args.results}")
         return 0
 
