@@ -233,7 +233,9 @@ def test_expand_chip_sweep_runs_on_attached_accelerator():
     from pytorch_distributed_rnn_tpu.launcher.bench import CHIP_RUN
 
     configs = expand_run_configs(CHIP_RUN, backend="native")
-    assert len(configs) == 3  # local x 1 device x {480, 960, 1440}
+    # local x 1 device x {480, 960, 1440, 2880} - the one-chip
+    # batch-scaling curve
+    assert len(configs) == 4
     for c in configs:
         assert (c.trainer, c.devices, c.backend) == ("local", 1, "native")
         _, env = get_command(c)
