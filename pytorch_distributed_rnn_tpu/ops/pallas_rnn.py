@@ -47,13 +47,49 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
-def _pick_block_b(batch: int) -> int:
+# Mosaic's default per-kernel scoped-VMEM budget is 16MB.  The backward
+# kernel is the fat one, and its footprint is dominated NOT by the block
+# windows but by the f32 stack temporaries the kernel body materializes -
+# gates, the four split views, the d_gates concat - each (block_b, 4H)
+# regardless of the input dtype.  Model calibrated against real-v5e
+# compiler measurements at H=512 (run-chip char row, r3):
+#   f32  block 256 -> 17.26MB measured (overflow);  block 128 runs
+#   bf16 block 512 -> 25.25MB measured (overflow);  block 256 runs
+# The terms below bracket all four points under a 13MB budget.
+_VMEM_BUDGET = 13 * 1024 * 1024
+
+
+def _bwd_vmem_bytes(block_b: int, hidden: int, itemsize: int) -> int:
+    weights = 4 * hidden * hidden * itemsize   # the (H, 4H) block
+    stack = 64 * hidden * block_b              # f32 (block_b, 4H) temporaries
+    streamed = 6 * hidden * block_b * itemsize  # time-indexed windows
+    return weights + stack + streamed
+
+
+def _pick_block_b(batch: int, hidden: int = 32, itemsize: int = 4) -> int:
     """Batch tile: large enough to keep the MXU/VPU busy, small enough that
-    several (block_b, 4H) blocks sit comfortably in VMEM - and chosen so
-    the padded batch wastes at most 7 rows (e.g. 1440 -> 3 tiles of 480,
-    not 3 tiles of 512)."""
-    num_tiles = -(-batch // 512)
-    return _round_up(-(-batch // num_tiles), 8)
+    the backward kernel's working set fits the scoped-VMEM budget.  When
+    the VMEM cap does not bind, tiles waste at most 7 padded rows (e.g.
+    1440 -> 3 tiles of 480, not 3 tiles of 512); when it does, the tile
+    count rises and padding can exceed that (1440 at H=512 f32 -> 7 tiles
+    of 208 = 16 padded rows)."""
+    cap = 512
+    while cap > 8 and _bwd_vmem_bytes(cap, hidden, itemsize) > _VMEM_BUDGET:
+        cap -= 8
+    if _bwd_vmem_bytes(cap, hidden, itemsize) > _VMEM_BUDGET and not _interpret():
+        # No tile fits (the resident weight block alone can exceed the
+        # budget, e.g. H=1024 f32 = 16.78MB): the kernel would die in the
+        # Mosaic compiler with a scoped-VMEM overflow, so fail with a
+        # actionable message instead.  Interpret mode (CPU tests) has no
+        # such limit and keeps working at any H.
+        raise ValueError(
+            f"fused RNN backward cannot fit scoped VMEM at hidden={hidden} "
+            f"itemsize={itemsize} (weights block alone "
+            f"{4 * hidden * hidden * itemsize / 2**20:.1f}MB); "
+            "use impl='scan' for this size"
+        )
+    num_tiles = -(-batch // cap)
+    return min(cap, _round_up(-(-batch // num_tiles), 8))
 
 
 # ---------------------------------------------------------------------------
@@ -128,7 +164,7 @@ def _lstm_fwd_pallas(x_proj, h0, c0, w_hh_t, *, block_b):
 
 
 def _lstm_bwd_kernel(x_proj_ref, h_prev_ref, c_prev_ref, c_t_ref,
-                     dh_all_ref, dh_T_ref, dc_T_ref, w_hh_t_ref, w_hh_ref,
+                     dh_all_ref, dh_T_ref, dc_T_ref, w_hh_t_ref,
                      h0_ref, c0_ref,
                      dx_proj_ref, dh0_ref, dc0_ref,
                      dh_scr, dc_scr):
@@ -183,7 +219,15 @@ def _lstm_bwd_kernel(x_proj_ref, h_prev_ref, c_prev_ref, c_t_ref,
 
     dx_proj_ref[0] = d_gates.astype(dx_proj_ref.dtype)
 
-    dh_prev = jnp.dot(d_gates, w_hh_ref[:], preferred_element_type=jnp.float32)
+    # d_gates @ w_hh_t^T via transposed contraction dims: reusing the SAME
+    # (H, 4H) block the gate recompute reads keeps ONE weight array in
+    # VMEM.  Shipping a second pre-transposed (4H, H) copy doubled the
+    # resident weight footprint (both blocks double-buffered: 16MB at
+    # H=512 f32) and overflowed the 16MB scoped-VMEM limit on real v5e.
+    dh_prev = jax.lax.dot_general(
+        d_gates, w_hh_t_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
     dc_prev = dc * f
     dh_scr[:] = dh_prev
     dc_scr[:] = dc_prev
@@ -201,7 +245,6 @@ def _lstm_bwd_pallas(x_proj, h_all, c_all, h0, c0, w_hh_t,
     nb = batch_p // block_b
     grid = (nb, seq_len)
     dtype = x_proj.dtype
-    w_hh = w_hh_t.T  # (4H, H)
 
     rev = lambda b, t: (seq_len - 1 - t, b, 0)        # noqa: E731
     rev_prev = lambda b, t: (                          # noqa: E731
@@ -219,7 +262,6 @@ def _lstm_bwd_pallas(x_proj, h_all, c_all, h0, c0, w_hh_t,
             pl.BlockSpec((block_b, hidden), lambda b, t: (b, 0)),  # dh_T
             pl.BlockSpec((block_b, hidden), lambda b, t: (b, 0)),  # dc_T
             pl.BlockSpec((hidden, gate_dim), lambda b, t: (0, 0)),
-            pl.BlockSpec((gate_dim, hidden), lambda b, t: (0, 0)),
             pl.BlockSpec((block_b, hidden), lambda b, t: (b, 0)),  # h0
             pl.BlockSpec((block_b, hidden), lambda b, t: (b, 0)),  # c0
         ],
@@ -238,7 +280,7 @@ def _lstm_bwd_pallas(x_proj, h_all, c_all, h0, c0, w_hh_t,
             pltpu.VMEM((block_b, hidden), jnp.float32),
         ],
         interpret=_interpret(),
-    )(x_proj, h_all, c_all, c_all, dh_all, dh_T, dc_T, w_hh_t, w_hh, h0, c0)
+    )(x_proj, h_all, c_all, c_all, dh_all, dh_T, dc_T, w_hh_t, h0, c0)
     return dx_proj, dh0, dc0
 
 
@@ -300,7 +342,7 @@ def lstm_layer_fused(params, x, h0=None, c0=None, *, block_b=None):
     dtype = x.dtype
 
     if block_b is None:
-        block_b = _pick_block_b(batch)
+        block_b = _pick_block_b(batch, hidden, jnp.dtype(dtype).itemsize)
     batch_p = _round_up(max(batch, block_b), block_b)
 
     from pytorch_distributed_rnn_tpu.ops.rnn import lstm_input_proj
@@ -378,7 +420,7 @@ def _gru_fwd_pallas(x_proj, h0, w_hh_t, b_hh, *, block_b):
 
 
 def _gru_bwd_kernel(x_proj_ref, h_prev_ref, dh_all_ref, dh_T_ref,
-                    w_hh_t_ref, w_hh_ref, b_hh_ref, h0_ref,
+                    w_hh_t_ref, b_hh_ref, h0_ref,
                     dx_proj_ref, dhgates_ref, dh0_ref, dh_scr):
     """Reverse-time sweep; weight/bias grads are NOT accumulated here -
     the kernel emits per-step hidden-side gate cotangents (``dhgates``)
@@ -419,8 +461,11 @@ def _gru_bwd_kernel(x_proj_ref, h_prev_ref, dh_all_ref, dh_T_ref,
     dx_proj_ref[0] = d_xgates.astype(dx_proj_ref.dtype)
     dhgates_ref[0] = d_hgates.astype(dhgates_ref.dtype)
 
-    dh_prev = dh * z + jnp.dot(
-        d_hgates, w_hh_ref[:], preferred_element_type=jnp.float32
+    # d_hgates @ w_hh_t^T via transposed contraction dims - one resident
+    # weight array instead of two (see the LSTM backward note)
+    dh_prev = dh * z + jax.lax.dot_general(
+        d_hgates, w_hh_t_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
     )
     dh_scr[:] = dh_prev
 
@@ -435,7 +480,6 @@ def _gru_bwd_pallas(x_proj, h_all, h0, w_hh_t, b_hh, dh_all, dh_T, *,
     hidden = gate_dim // 3
     grid = (batch_p // block_b, seq_len)
     dtype = x_proj.dtype
-    w_hh = w_hh_t.T
 
     rev = lambda b, t: (seq_len - 1 - t, b, 0)        # noqa: E731
     rev_prev = lambda b, t: (                          # noqa: E731
@@ -450,7 +494,6 @@ def _gru_bwd_pallas(x_proj, h_all, h0, w_hh_t, b_hh, dh_all, dh_T, *,
             pl.BlockSpec((1, block_b, hidden), rev),         # dh_all[tt]
             pl.BlockSpec((block_b, hidden), lambda b, t: (b, 0)),
             pl.BlockSpec((hidden, gate_dim), lambda b, t: (0, 0)),
-            pl.BlockSpec((gate_dim, hidden), lambda b, t: (0, 0)),
             pl.BlockSpec((1, gate_dim), lambda b, t: (0, 0)),
             pl.BlockSpec((block_b, hidden), lambda b, t: (b, 0)),  # h0
         ],
@@ -466,7 +509,7 @@ def _gru_bwd_pallas(x_proj, h_all, h0, w_hh_t, b_hh, dh_all, dh_T, *,
         ],
         scratch_shapes=[pltpu.VMEM((block_b, hidden), jnp.float32)],
         interpret=_interpret(),
-    )(x_proj, h_all, dh_all, dh_T, w_hh_t, w_hh, b_hh, h0)
+    )(x_proj, h_all, dh_all, dh_T, w_hh_t, b_hh, h0)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
@@ -508,7 +551,8 @@ def gru_layer_fused(params, x, h0=None, *, block_b=None):
     dtype = x.dtype
 
     if block_b is None:
-        block_b = _pick_block_b(batch)
+        # the LSTM (4H-wide, fatter) VMEM model bounds the GRU's 3H one
+        block_b = _pick_block_b(batch, hidden, jnp.dtype(dtype).itemsize)
     batch_p = _round_up(max(batch, block_b), block_b)
 
     from pytorch_distributed_rnn_tpu.ops.rnn import gru_input_proj

@@ -207,3 +207,45 @@ def test_gru_fused_in_stack_and_model():
     p = scan_m.init(jax.random.PRNGKey(17))
     np.testing.assert_allclose(scan_m.apply(p, x), fused_m.apply(p, x),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_pick_block_b_respects_vmem_budget():
+    """The batch-tile picker must reject configs measured to overflow the
+    16MB scoped-VMEM limit on a real v5e chip (run-chip char row, r3):
+    f32 H=512 block 256 -> 17.26MB, bf16 H=512 block 512 -> 25.25MB; and
+    keep the configs measured to fit (f32/128, bf16/256, and the motion
+    model's H=32 tile of 480)."""
+    from pytorch_distributed_rnn_tpu.ops.pallas_rnn import (
+        _bwd_vmem_bytes,
+        _pick_block_b,
+        _VMEM_BUDGET,
+    )
+
+    assert _bwd_vmem_bytes(256, 512, 4) > _VMEM_BUDGET   # measured 17.26MB
+    assert _bwd_vmem_bytes(512, 512, 2) > _VMEM_BUDGET   # measured 25.25MB
+    assert _bwd_vmem_bytes(128, 512, 4) <= _VMEM_BUDGET  # runs on chip
+    assert _bwd_vmem_bytes(256, 512, 2) <= _VMEM_BUDGET  # runs on chip
+
+    assert _pick_block_b(256, 512, 4) <= 128
+    assert _pick_block_b(256, 512, 2) == 256
+    # the motion model's regime is unchanged: big tiles, tiny VMEM
+    assert _pick_block_b(1440, 32, 4) == 480
+    # under the cap the tile still hugs ceil(batch/num_tiles): 7 tiles
+    # of 208 (16 padded rows), not e.g. 7 tiles of the 208-capped 512
+    assert _pick_block_b(1440, 512, 4) == 208
+
+
+def test_pick_block_b_unfittable_hidden_raises_on_tpu(monkeypatch):
+    """When even an 8-row tile cannot fit (H=1024 f32: the weights block
+    alone is 16.78MB) the picker must fail actionably on TPU rather than
+    hand Mosaic a guaranteed scoped-VMEM overflow; interpret mode (CPU)
+    has no such limit and stays permissive."""
+    import pytest
+
+    from pytorch_distributed_rnn_tpu.ops import pallas_rnn
+
+    assert pallas_rnn._pick_block_b(256, 1024, 4) >= 8  # interpret: permissive
+    monkeypatch.setattr(pallas_rnn, "_interpret", lambda: False)
+    with pytest.raises(ValueError, match="impl='scan'"):
+        pallas_rnn._pick_block_b(256, 1024, 4)
+    assert pallas_rnn._pick_block_b(256, 512, 4) <= 128  # fittable unaffected
