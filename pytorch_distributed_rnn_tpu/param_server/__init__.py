@@ -47,7 +47,8 @@ def execute(args):
         )
     from pytorch_distributed_rnn_tpu.training.families import require_family
 
-    # char's vocab-head gradients are the transport stressor; moe stays
-    # with the in-process strategies
-    require_family(args, ("rnn", "char", "attention"), "parameter-server")
+    # char's vocab-head gradients are the transport stressor; moe rides
+    # the same wire dense-exact (expert grads are ordinary pytree leaves)
+    require_family(args, ("rnn", "char", "attention", "moe"),
+                   "parameter-server")
     return run(args)

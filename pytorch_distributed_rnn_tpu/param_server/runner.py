@@ -27,9 +27,9 @@ log = logging.getLogger(__name__)
 
 def _build_model_and_flat_params(args, training_set, seed):
     """Family-aware model + flat parameter vector (the PS wire format).
-    Families rnn/char/attention via ``training/families.py`` - master and
-    workers must build the IDENTICAL model from the same flags/seed, so
-    the one construction path serves both roles."""
+    Families rnn/char/attention/moe via ``training/families.py`` - master
+    and workers must build the IDENTICAL model from the same flags/seed,
+    so the one construction path serves both roles."""
     from pytorch_distributed_rnn_tpu.training import families
 
     model = families.build_model(args, training_set)

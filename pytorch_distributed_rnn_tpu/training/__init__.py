@@ -10,7 +10,6 @@ from __future__ import annotations
 import json
 import logging
 
-from pytorch_distributed_rnn_tpu.data import MotionDataset
 from pytorch_distributed_rnn_tpu.training.base import Trainer
 from pytorch_distributed_rnn_tpu.training.distributed import (
     DDPTrainer,
@@ -95,18 +94,13 @@ def train(args, trainer_class):
     logging.basicConfig(level=args.log)
     logging.getLogger().setLevel(args.log)
 
-    if getattr(args, "model", "rnn") == "char":
-        return _train_char_lm(args, trainer_class)
-    if getattr(args, "model", "rnn") == "moe":
-        if getattr(args, "seq_length", None) is not None:
-            raise SystemExit(
-                "--seq-length only applies to --model char"
-            )
-        return _train_moe(args, trainer_class)
-
-    # families.load_datasets rejects --seq-length off-char; build_model
-    # carries every family's loud flag rejects (the ONE construction path,
-    # shared with distributed-native and the parameter server)
+    # ONE family-generic path for all four CLI families (rnn, char,
+    # attention, moe): families.load_datasets rejects --seq-length
+    # off-char; build_model carries every family's loud flag rejects (the
+    # ONE construction path, shared with distributed-native and the
+    # parameter server); wrap_trainer mixes in the char-LM / moe loss
+    # surface where the strategy does not own it (the mesh factory's
+    # OWNS_*_LOSS markers pass through).
     from pytorch_distributed_rnn_tpu.training import families
 
     training_set, validation_set, test_set = _log_and_trim_datasets(
@@ -114,90 +108,7 @@ def train(args, trainer_class):
     )
     model = families.build_model(args, training_set)
     return _run_trainer(
-        args, trainer_class, model,
-        (training_set, validation_set, test_set),
-    )
-
-
-def _train_moe(args, trainer_class):
-    """``--model moe``: RNN backbone + Switch-routed expert FFN - the EP
-    parallelism axis as a first-class CLI family (SURVEY.md checklist's
-    last absent axis; no reference counterpart).  ``local``/``distributed``/
-    ``horovod`` train the dense-exact path on the shared loop (experts
-    replicated); the ``mesh`` strategy shards experts over ``ep``
-    (``parallel/ep.py`` all_to_all dispatch) with batch rows over the full
-    dp x ep product.  Unsupported flags and strategies reject loudly."""
-    from pytorch_distributed_rnn_tpu.models import MoEClassifier
-    from pytorch_distributed_rnn_tpu.training.moe import wrap_moe_trainer
-
-    if getattr(args, "dropout", 0.0):
-        raise SystemExit(
-            "--model moe has no dropout - pass --dropout 0 (the CLI "
-            "default 0.1 mirrors the reference surface)"
-        )
-    unsupported = [
-        flag for flag, active in (
-            ("--precision bf16", getattr(args, "precision", "f32") != "f32"),
-            ("--remat", getattr(args, "remat", False)),
-        ) if active
-    ]
-    if unsupported:
-        raise SystemExit(
-            f"--model moe does not support: {', '.join(unsupported)}"
-        )
-    if getattr(trainer_class, "__name__", "") == "ZeroTrainer":
-        raise SystemExit(
-            "--model moe is not wired into the fsdp strategy (its "
-            "sharded-state programs are family-specific) - use local, "
-            "distributed, horovod, or mesh --mesh dp=..,ep=.."
-        )
-
-    training_set, validation_set, test_set = _log_and_trim_datasets(
-        args,
-        *MotionDataset.load(
-            args.dataset_path,
-            output_path=args.output_path,
-            validation_fraction=args.validation_fraction,
-            seed=args.seed,
-        ),
-    )
-    model = MoEClassifier(
-        input_dim=training_set.num_features,
-        hidden_dim=args.hidden_units,
-        layer_dim=args.stacked_layer,
-        output_dim=len(MotionDataset.LABELS),
-        num_experts=getattr(args, "num_experts", 4),
-        cell=getattr(args, "cell", "lstm"),
-    )
-    cls = (
-        trainer_class
-        if getattr(trainer_class, "OWNS_MOE_LOSS", False)
-        else wrap_moe_trainer(trainer_class)
-    )
-    return _run_trainer(
-        args, cls, model, (training_set, validation_set, test_set)
-    )
-
-
-def _train_char_lm(args, trainer_class):
-    """``--model char``: byte-level LM on token windows - the stress family
-    (BASELINE.json config 5) as a first-class CLI citizen.  Same shared
-    loop and strategies; only the dataset and the loss surface differ
-    (``data/text.py``, ``training/lm.py``; construction shared with the
-    native-transport strategies via ``training/families.py``)."""
-    from pytorch_distributed_rnn_tpu.training import families
-    from pytorch_distributed_rnn_tpu.training.lm import wrap_lm_trainer
-
-    training_set, validation_set, test_set = _log_and_trim_datasets(
-        args, *families.load_datasets(args)
-    )
-    model = families.build_model(args, training_set)
-    if getattr(trainer_class, "OWNS_LM_LOSS", False):
-        lm_trainer_class = trainer_class  # mesh factory: LM loss wired in
-    else:
-        lm_trainer_class = wrap_lm_trainer(trainer_class)
-    return _run_trainer(
-        args, lm_trainer_class, model,
+        args, families.wrap_trainer(args, trainer_class), model,
         (training_set, validation_set, test_set),
     )
 

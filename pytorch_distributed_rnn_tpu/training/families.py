@@ -6,8 +6,9 @@ The registry's ``train()`` builds families for the in-process strategies;
 motion RNN - the strategy x family matrix hole VERDICT r2 weak #6 called
 out: the two strategies that exercise the C++ TCP transport never saw the
 models that stress it.  This module gives them the same family surface
-(``rnn``, ``char``, ``attention``) with the same loud flag rejects; the
-``moe`` and mesh-only compositions stay with the in-process strategies.
+(``rnn``, ``char``, ``attention``, and dense-exact ``moe`` - expert
+gradients are ordinary pytree leaves over the wire; expert PARALLELISM
+stays the mesh strategy's ``ep`` axis) with the same loud flag rejects.
 
 Contract: ``load_datasets`` returns family-appropriate (train, valid,
 test); ``build_model`` returns the model with every unsupported flag
@@ -111,10 +112,35 @@ def build_model(args, training_set):
             num_heads=getattr(args, "num_heads", 4),
             output_dim=len(MotionDataset.LABELS),
         )
+    if fam == "moe":
+        from pytorch_distributed_rnn_tpu.models import MoEClassifier
+
+        unsupported = [
+            flag for flag, active in (
+                ("--dropout", bool(getattr(args, "dropout", 0.0))),
+                ("--precision bf16",
+                 getattr(args, "precision", "f32") != "f32"),
+                ("--remat", getattr(args, "remat", False)),
+            ) if active
+        ]
+        if unsupported:
+            raise SystemExit(
+                f"--model moe does not support: {', '.join(unsupported)} "
+                "(pass --dropout 0; the CLI default 0.1 mirrors the "
+                "reference surface)"
+            )
+        return MoEClassifier(
+            input_dim=training_set.num_features,
+            hidden_dim=args.hidden_units,
+            layer_dim=args.stacked_layer,
+            output_dim=len(MotionDataset.LABELS),
+            num_experts=getattr(args, "num_experts", 4),
+            cell=getattr(args, "cell", "lstm"),
+        )
     if fam != "rnn":
         raise SystemExit(
             f"--model {fam} is not wired into this strategy - supported "
-            "here: rnn, char, attention"
+            "here: rnn, char, attention, moe"
         )
     from pytorch_distributed_rnn_tpu.models import MotionModel
 
@@ -131,9 +157,22 @@ def build_model(args, training_set):
 
 
 def wrap_trainer(args, trainer_class):
-    """The strategy's Trainer class with the family's loss mixed in."""
-    if family_of(args) == "char":
+    """The strategy's Trainer class with the family's loss mixed in.
+
+    The mesh strategy's factory carries ``OWNS_LM_LOSS``/``OWNS_MOE_LOSS``
+    markers (its shard_mapped programs wire the family loss themselves) -
+    those pass through unwrapped; rnn/attention always pass through (the
+    base classification loss is theirs already)."""
+    if family_of(args) == "char" and not getattr(
+        trainer_class, "OWNS_LM_LOSS", False
+    ):
         from pytorch_distributed_rnn_tpu.training.lm import wrap_lm_trainer
 
         return wrap_lm_trainer(trainer_class)
+    if family_of(args) == "moe" and not getattr(
+        trainer_class, "OWNS_MOE_LOSS", False
+    ):
+        from pytorch_distributed_rnn_tpu.training.moe import wrap_moe_trainer
+
+        return wrap_moe_trainer(trainer_class)
     return trainer_class

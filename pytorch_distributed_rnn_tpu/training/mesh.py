@@ -283,7 +283,7 @@ def mesh_trainer_factory(args):
             **kwargs,
         )
 
-    # tells _train_char_lm the LM loss is already wired in (wrapping the
+    # tells families.wrap_trainer the LM loss is already wired in (wrapping the
     # factory's PRODUCT is not possible from outside - it is not a class)
     build.OWNS_LM_LOSS = True
     build.OWNS_MOE_LOSS = True

@@ -221,14 +221,15 @@ def execute(args):
     topology from MASTER_ADDR/MASTER_PORT/RANK/WORLD_SIZE env - exactly how
     mpirun-launched ranks discovered theirs in the reference.
 
-    Families: rnn / char / attention (``training/families.py``) - the
-    char-LM's bigger gradient vector (vocab head) is exactly what
-    stresses the per-step TCP allreduce."""
+    Families: rnn / char / attention / moe (``training/families.py``) -
+    the char-LM's bigger gradient vector (vocab head) is exactly what
+    stresses the per-step TCP allreduce; moe rides dense-exact (expert
+    grads are ordinary pytree leaves on the ring)."""
     from pytorch_distributed_rnn_tpu.runtime.native import init_from_env
     from pytorch_distributed_rnn_tpu.training import families
 
     families.require_family(
-        args, ("rnn", "char", "attention"), "distributed-native"
+        args, ("rnn", "char", "attention", "moe"), "distributed-native"
     )
     logging.basicConfig(level=args.log)
     logging.getLogger().setLevel(args.log)

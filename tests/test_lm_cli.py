@@ -144,29 +144,21 @@ class TestCharCLI:
                 "--seq-length", "32", "local",
             ])
 
-    def test_model_flag_rejected_on_unwired_strategies(self, tmp_path,
-                                                       monkeypatch):
-        """char/attention now TRAIN on distributed-native and the PS
-        (training/families.py - VERDICT r2 weak #6 closed); the loud gate
-        remains for the family those strategies cannot take (moe)."""
-        from pytorch_distributed_rnn_tpu.main import main
+    def test_family_gate_stays_loud(self):
+        """All four CLI families now train on every strategy (the moe
+        holes closed in r3), so no CLI invocation can reach an unwired
+        family - but the gate itself must stay loud for any future
+        family added to the CLI before it is wired into a strategy."""
+        from argparse import Namespace
 
-        monkeypatch.setenv("MASTER_ADDR", "127.0.0.1")
-        monkeypatch.setenv("MASTER_PORT", "29999")
-        monkeypatch.setenv("RANK", "0")
-        monkeypatch.setenv("WORLD_SIZE", "1")
+        from pytorch_distributed_rnn_tpu.training import families
+
         with pytest.raises(SystemExit, match="not wired"):
-            main([
-                "--dataset-path", str(tmp_path), "--epochs", "1",
-                "--dropout", "0",
-                "--model", "moe", "distributed-native",
-            ])
-        with pytest.raises(SystemExit, match="not wired"):
-            main([
-                "--dataset-path", str(tmp_path), "--epochs", "1",
-                "--dropout", "0",
-                "--model", "moe", "parameter-server", "--world-size", "2",
-            ])
+            families.require_family(
+                Namespace(model="future-family"),
+                ("rnn", "char", "attention", "moe"),
+                "distributed-native",
+            )
 
 class TestCharMesh:
     """--model char under the mesh strategy: the LM trains on composed

@@ -182,13 +182,17 @@ class TestMoECLI:
         h = self._cli(tmp_path, monkeypatch, "distributed")["train_history"]
         assert h[-1] < h[0]
 
+    def test_fsdp_dense_trains(self, tmp_path, monkeypatch):
+        """ZeRO shards the dense-exact expert tree like any other params
+        (the former matrix hole: fsdp rejected moe before r3)."""
+        h = self._cli(tmp_path, monkeypatch, "fsdp")["train_history"]
+        assert h[-1] < h[0]
+
     def test_rejections(self, tmp_path, monkeypatch):
         with pytest.raises(SystemExit, match="dropout"):
             self._cli(tmp_path, monkeypatch, "--dropout", "0.1", "local")
         with pytest.raises(SystemExit, match="bf16"):
             self._cli(tmp_path, monkeypatch, "--precision", "bf16", "local")
-        with pytest.raises(SystemExit, match="fsdp"):
-            self._cli(tmp_path, monkeypatch, "fsdp")
         with pytest.raises(ValueError, match="dp x ep only"):
             self._cli(tmp_path, monkeypatch, "mesh", "--mesh", "dp=2,sp=2")
         with pytest.raises(ValueError, match="does not shard"):

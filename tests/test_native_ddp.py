@@ -136,12 +136,24 @@ def test_attention_family_two_rank_world(tmp_path):
     assert sums[0] == sums[1], sums
 
 
-def test_moe_family_rejected(tmp_path):
-    """distributed-native keeps its family gate loud for what it cannot
-    train (the MoE family is local/ddp/horovod/mesh)."""
-    from argparse import Namespace
-
-    from pytorch_distributed_rnn_tpu.training.native_ddp import execute
-
-    with pytest.raises(SystemExit, match="not wired"):
-        execute(Namespace(model="moe", log="WARNING"))
+@pytest.mark.slow
+def test_moe_family_two_rank_world(tmp_path):
+    """Dense-exact MoE over the C++ TCP transport: expert gradients are
+    ordinary pytree leaves on the ring allreduce, so the family gets the
+    same rank-parity guarantee as the others (the last strategy x family
+    matrix hole - moe was rejected here before r3)."""
+    data_dir = _dataset(tmp_path)
+    results = launch_world(
+        2,
+        _args(tmp_path, data_dir,
+              extra=("--model", "moe", "--dropout", "0")),
+        master_port=29569, cwd=tmp_path,
+    )
+    sums = {}
+    for code, out, err in results:
+        m = PARAM_RE.search(err)
+        assert m, err[-1500:]
+        sums[int(m.group(1))] = m.group(2)
+    assert sums[0] == sums[1], sums
+    history = json.loads((tmp_path / "history.json").read_text())
+    assert len(history["train_history"]) == 2

@@ -95,6 +95,22 @@ class TestEndToEnd:
         assert all(np.isfinite(history["train_history"]))
         assert history["train_history"][-1] < history["train_history"][0]
 
+    def test_moe_family_ps_trains(self, har_dir, monkeypatch):
+        """Dense-exact MoE through the parameter server: the master holds
+        the flat expert tree, workers push its gradients over TCP like
+        any other leaves (moe was rejected here before r3)."""
+        from pytorch_distributed_rnn_tpu.param_server.runner import run
+
+        monkeypatch.chdir(har_dir)
+        args = _ps_args(har_dir, PORT + 13, world_size=3, ps_mode="sync")
+        args.model = "moe"
+        assert run(args) == 0
+        import json
+
+        history = json.loads((har_dir / "history.json").read_text())
+        assert len(history["train_history"]) == 2
+        assert all(np.isfinite(history["train_history"]))
+
     def test_world_size_one_rejected(self, har_dir):
         from pytorch_distributed_rnn_tpu.param_server.runner import run
 
