@@ -66,6 +66,9 @@ def create_measurement_df(results) -> pd.DataFrame:
                     "slots": run.get("slots", 1),
                     "world": run.get("devices", 1) * run.get("slots", 1),
                     "batch_size": params.get("batch-size"),
+                    # model family ("rnn" = the reference's motion model);
+                    # seq/s is NOT comparable across families
+                    "model": params.get("model", "rnn"),
                     "rule_type": run.get("rule_type"),
                     "rule_value": run.get("rule_value"),
                     "rank": rank,

@@ -270,6 +270,37 @@ def _char_sp_program(dp: int, sp: int):
     return jax.jit(step), (params, state, batch), params
 
 
+def _motion_pp_program(dp: int, pp: int):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from pytorch_distributed_rnn_tpu.models import MotionModel
+    from pytorch_distributed_rnn_tpu.parallel import make_mesh
+    from pytorch_distributed_rnn_tpu.parallel.strategy import (
+        make_mesh_grad_step,
+        make_motion_mesh_loss_fn,
+    )
+
+    axes = {"dp": dp, "pp": pp}
+    mesh = make_mesh(axes)
+    model = MotionModel(input_dim=9, hidden_dim=8, layer_dim=pp,
+                        output_dim=6)
+    params = model.init(jax.random.PRNGKey(6))
+    opt = optax.adam(1e-3)
+    state = opt.init(params)
+    step = make_mesh_grad_step(
+        make_motion_mesh_loss_fn(mesh, axes, num_microbatches=2), opt
+    )
+    rng = np.random.RandomState(0)
+    batch = (
+        jnp.asarray(rng.randn(4 * dp, 16, 9).astype(np.float32)),
+        jnp.asarray(rng.randint(0, 6, size=4 * dp)),
+    )
+    return jax.jit(step), (params, state, batch), params
+
+
 def _moe_ep_program(dp: int, ep: int):
     import jax
     import jax.numpy as jnp
@@ -327,6 +358,8 @@ def report_programs(n_devices: int = 8) -> list[dict]:
          lambda: _char_sp_program(n_devices // 4, 4)),
         (f"moe mesh dp={n_devices // 4},ep=4 (all_to_all dispatch)",
          lambda: _moe_ep_program(n_devices // 4, 4)),
+        (f"motion mesh dp={n_devices // 2},pp=2 (GPipe stage ppermute)",
+         lambda: _motion_pp_program(n_devices // 2, 2)),
     ):
         fn, call_args, params = build()
         # Two complementary views, each honest about its blind spot:
