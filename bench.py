@@ -94,7 +94,7 @@ def lstm_lm_flops_per_token(model) -> float:
 
 def char50m_tokens_per_sec(precision: str, batch: int = 32,
                            seq: int = 129, steps: int = 50,
-                           shape: str = "deep"):
+                           shape: str = "deep", unroll: int = 1):
     """(tokens/s, mfu) for a 50M-class LM; mfu vs the v5e bf16 peak.
 
     ``shape="deep"`` is the BASELINE.json preset (4 x 1280); ``"wide"``
@@ -112,9 +112,10 @@ def char50m_tokens_per_sec(precision: str, batch: int = 32,
 
         model = CharRNN(vocab_size=256, embed_dim=512, hidden_dim=2048,
                         layer_dim=2, cell="lstm", impl="auto",
-                        precision=precision)
+                        precision=precision, unroll=unroll)
     else:
-        model = char_rnn_50m(impl="auto", precision=precision)
+        model = char_rnn_50m(impl="auto", precision=precision,
+                             unroll=unroll)
     params = model.init(jax.random.PRNGKey(0))
     opt = optax.adam(1e-3)
     opt_state = opt.init(params)
@@ -241,7 +242,8 @@ def main():
             )
 
         def _lm(precision, candidates=((512, 10), (256, 20), (128, 30),
-                                       (32, 50)), seq=129, shape="deep"):
+                                       (32, 50)), seq=129, shape="deep",
+                unroll=1):
             # Largest batch that compiles+runs wins (batch 512 failed in
             # the r2 remote compile helper - retried every round).  Record
             # which batch ran AND any larger batches that failed with
@@ -254,7 +256,7 @@ def main():
                 try:
                     tps, mfu = char50m_tokens_per_sec(
                         precision, batch=batch, steps=steps, seq=seq,
-                        shape=shape)
+                        shape=shape, unroll=unroll)
                     result = {"tokens_per_sec": round(tps, 0),
                               "mfu_vs_v5e_bf16_peak": round(mfu, 4),
                               "batch": batch, "seq": seq - 1}
@@ -323,6 +325,25 @@ def main():
                 "char_rnn_55m_wide_bf16",
                 lambda: _lm("bf16", shape="wide"),
             )
+
+            # scan-unroll ladder at one fixed config (batch 256, so the
+            # u=1 rung is the same-config baseline): unroll>1 gives XLA
+            # more ILP per loop iteration (fewer loop-carried barriers)
+            # at the cost of program size; each rung records its own
+            # result or error so one rung's compile failure (the
+            # documented cost of large unroll) cannot discard the others
+            def _unroll_ladder():
+                ladder = {}
+                for u in (1, 2, 4, 8):
+                    try:
+                        ladder[f"unroll{u}"] = _lm(
+                            "bf16", candidates=((256, 15),), unroll=u)
+                    except Exception as exc:  # noqa: BLE001 - keep rungs
+                        ladder[f"unroll{u}"] = (
+                            f"error: {type(exc).__name__}: {exc}"[:160])
+                return ladder
+
+            attempt("char_rnn_50m_bf16_unroll", _unroll_ladder)
             attempt("attention_seq_per_sec",
                     lambda: round(attention_throughput(), 1))
             # dense attention at 8x the HAR window: the single-chip

@@ -166,14 +166,16 @@ class CharRNN:
 
 
 def char_rnn_50m(impl: str = "auto", precision: str = "f32",
-                 remat: bool = False) -> CharRNN:
+                 remat: bool = False, unroll: int = 1) -> CharRNN:
     """The BASELINE.json stress config: ~50M-param stacked-LSTM LM
     (vocab 256, embed 512, 4 x 1280 hidden -> 49.9M params).
     ``precision="bf16"`` / ``remat=True`` are the intended levers for
-    running this preset at depth on real hardware."""
+    running this preset at depth on real hardware; ``unroll`` feeds the
+    scan path's ``lax.scan(unroll=...)`` (more ILP per loop iteration at
+    the cost of program size)."""
     return CharRNN(vocab_size=256, embed_dim=512, hidden_dim=1280,
                    layer_dim=4, cell="lstm", impl=impl,
-                   precision=precision, remat=remat)
+                   precision=precision, remat=remat, unroll=unroll)
 
 
 def num_params(params) -> int:

@@ -34,6 +34,7 @@ from pytorch_distributed_rnn_tpu.parallel.tp import (
 from pytorch_distributed_rnn_tpu.parallel.pp import (
     make_pp_forward,
     pp_stacked_lstm,
+    pp_stacked_rnn,
 )
 from pytorch_distributed_rnn_tpu.parallel.ep import (
     ep_moe_ffn,
@@ -94,6 +95,7 @@ __all__ = [
     "tp_stacked_lstm",
     "make_pp_forward",
     "pp_stacked_lstm",
+    "pp_stacked_rnn",
     "ep_moe_ffn",
     "make_ep_moe_forward",
     "make_ep_train_step",

@@ -31,7 +31,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 from jax import shard_map
 
-from pytorch_distributed_rnn_tpu.ops.rnn import lstm_step
+from pytorch_distributed_rnn_tpu.ops.rnn import gru_step, lstm_step
 from pytorch_distributed_rnn_tpu.parallel.collectives import broadcast_from
 
 
@@ -43,38 +43,56 @@ def _pad_last(x, width: int):
     return jnp.pad(x, cfg)
 
 
-def _stack_padded(layers, width: int):
+def _stack_padded(layers, width: int, cell: str = "lstm"):
     """Stack per-layer params into (L, ...) arrays, w_ih column-padded to
-    ``width`` so traced layer indexing sees homogeneous shapes."""
-    return {
+    ``width`` so traced layer indexing sees homogeneous shapes.  For the
+    LSTM both biases fold into the input projection; torch GRU semantics
+    put ``b_hh`` inside the n-gate's ``r *`` product, so it stays a
+    separate per-layer array and joins inside ``gru_step``."""
+    stacked = {
         "w_ih": jnp.stack([_pad_last(p["w_ih"], width) for p in layers]),
         "w_hh_t": jnp.stack([p["w_hh"].T for p in layers]),
-        "b": jnp.stack([p["b_ih"] + p["b_hh"] for p in layers]),
     }
+    if cell == "gru":
+        stacked["b"] = jnp.stack([p["b_ih"] for p in layers])
+        stacked["b_hh"] = jnp.stack([p["b_hh"] for p in layers])
+    else:
+        stacked["b"] = jnp.stack([p["b_ih"] + p["b_hh"] for p in layers])
+    return stacked
 
 
-def _run_layer(stacked, l, acts, *, unroll: int = 1):
+def _run_layer(stacked, l, acts, *, unroll: int = 1, cell: str = "lstm"):
     """Run layer ``l`` (traced index) over acts (B_m, T, W) -> (B_m, T, H)."""
     w_ih = lax.dynamic_index_in_dim(stacked["w_ih"], l, keepdims=False)
     w_hh_t = lax.dynamic_index_in_dim(stacked["w_hh_t"], l, keepdims=False)
     b = lax.dynamic_index_in_dim(stacked["b"], l, keepdims=False)
     x_proj = jnp.einsum("bti,gi->btg", acts, w_ih) + b
     batch, hidden = acts.shape[0], w_hh_t.shape[0]
-    carry0 = (  # f32 per the lstm_step mixed-precision contract
-        jnp.zeros((batch, hidden), jnp.float32),
-        jnp.zeros((batch, hidden), jnp.float32),
-    )
-    _, out = lax.scan(
-        lambda c, xp: lstm_step(w_hh_t, c, xp),
-        carry0, jnp.swapaxes(x_proj, 0, 1), unroll=unroll,
-    )
+    xs = jnp.swapaxes(x_proj, 0, 1)
+    if cell == "gru":
+        b_hh = lax.dynamic_index_in_dim(stacked["b_hh"], l, keepdims=False)
+        h0 = jnp.zeros((batch, hidden), jnp.float32)
+        _, out = lax.scan(
+            lambda h, xp: gru_step(w_hh_t, b_hh, h, xp),
+            h0, xs, unroll=unroll,
+        )
+    else:
+        carry0 = (  # f32 per the lstm_step mixed-precision contract
+            jnp.zeros((batch, hidden), jnp.float32),
+            jnp.zeros((batch, hidden), jnp.float32),
+        )
+        _, out = lax.scan(
+            lambda c, xp: lstm_step(w_hh_t, c, xp),
+            carry0, xs, unroll=unroll,
+        )
     return jnp.swapaxes(out, 0, 1)
 
 
-def pp_stacked_lstm(layers, x, axis: str, *, num_microbatches: int,
-                    unroll: int = 1):
-    """GPipe-scheduled stacked LSTM, for use inside ``shard_map`` over the
-    ``pp`` axis (params and ``x`` (B, T, in) replicated per stage).
+def pp_stacked_rnn(layers, x, axis: str, *, num_microbatches: int,
+                   unroll: int = 1, cell: str = "lstm"):
+    """GPipe-scheduled stacked RNN (LSTM or GRU), for use inside
+    ``shard_map`` over the ``pp`` axis (params and ``x`` (B, T, in)
+    replicated per stage).
 
     ``L`` layers split into ``axis_size`` contiguous stages (L must divide
     evenly); the batch splits into ``num_microbatches``.  Returns the full
@@ -97,7 +115,7 @@ def pp_stacked_lstm(layers, x, axis: str, *, num_microbatches: int,
     width = max(in_dim, hidden)
     dtype = x.dtype
 
-    stacked = _stack_padded(layers, width)
+    stacked = _stack_padded(layers, width, cell)
     x_micro = _pad_last(x, width).reshape(M, bm, t, width)
 
     def select(active, new, old):
@@ -119,7 +137,8 @@ def pp_stacked_lstm(layers, x, axis: str, *, num_microbatches: int,
         for j in range(per_stage):
             # every layer consumes width-W input (layer output is H-wide)
             acts = _run_layer(stacked, idx * per_stage + j,
-                              _pad_last(acts, width), unroll=unroll)
+                              _pad_last(acts, width), unroll=unroll,
+                              cell=cell)
         # last stage captures its microbatch's output
         outs = jax.tree.map(
             lambda buf_, new: jnp.where(
@@ -141,12 +160,18 @@ def pp_stacked_lstm(layers, x, axis: str, *, num_microbatches: int,
     return outs.reshape(batch, t, hidden)
 
 
+# Backwards-compatible name from when the stage runner was LSTM-only.
+pp_stacked_lstm = pp_stacked_rnn
+
+
 def make_pp_forward(mesh, axis: str = "pp", *, num_microbatches: int = 4,
-                    unroll: int = 1):
+                    unroll: int = 1, cell: str = "lstm"):
     """Jitted pipeline-parallel forward for a MotionModel-shaped params
-    tree: staged stacked LSTM + last-timestep head (computed replicated -
+    tree: staged stacked RNN + last-timestep head (computed replicated -
     it is tiny).  ``x`` replicated in, logits replicated out; numerics
-    match ``MotionModel.apply`` exactly.
+    match ``MotionModel.apply`` exactly.  ``cell`` must match the params
+    tree - a GRU tree run as LSTM would split (B, 3H) pre-activations
+    into four bogus gates without a shape error whenever 4 | 3H.
     """
 
     @partial(
@@ -157,9 +182,9 @@ def make_pp_forward(mesh, axis: str = "pp", *, num_microbatches: int = 4,
         check_vma=False,
     )
     def forward(params, x):
-        out = pp_stacked_lstm(
+        out = pp_stacked_rnn(
             params["rnn"], x, axis, num_microbatches=num_microbatches,
-            unroll=unroll,
+            unroll=unroll, cell=cell,
         )
         last = out[:, -1, :]
         return last @ params["fc"]["weight"].T + params["fc"]["bias"]

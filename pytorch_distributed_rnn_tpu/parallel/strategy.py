@@ -20,9 +20,8 @@ a mesh spec like ``dp=2,sp=4``:
 Supported RNN meshes: ``dp`` composed with AT MOST one of ``sp``/``tp``/
 ``pp`` (the RNN cell kernels do not compose sp x tp in one program; the
 attention family covers the full dp x sp x tp composition via
-``parallel/combined.py``).  Cells: LSTM on every axis; GRU on sp
-(sequential relay) and tp (gate-sharded); the GPipe pp stage runner is
-LSTM-specific.
+``parallel/combined.py``).  Cells: both LSTM and GRU run on every model
+axis - sp (sequential relay), tp (gate-sharded), pp (GPipe stages).
 """
 
 from __future__ import annotations
@@ -36,7 +35,7 @@ from jax import shard_map
 
 from pytorch_distributed_rnn_tpu.ops.losses import cross_entropy_loss
 from pytorch_distributed_rnn_tpu.parallel.collectives import broadcast_from
-from pytorch_distributed_rnn_tpu.parallel.pp import pp_stacked_lstm
+from pytorch_distributed_rnn_tpu.parallel.pp import pp_stacked_rnn
 from pytorch_distributed_rnn_tpu.parallel.sp import (
     sp_stacked_gru,
     sp_stacked_lstm,
@@ -79,8 +78,8 @@ def parse_mesh_spec(spec: str) -> dict[str, int]:
 def validate_rnn_mesh(axes: dict[str, int], cell: str = "lstm"):
     """Reject mesh specs the RNN kernels cannot run.
 
-    LSTM runs on every axis; GRU on sp (sequential relay) and tp
-    (gate-sharded); the GPipe pp stage runner is LSTM-specific.
+    Both cells run on every model axis: sp (sequential relay), tp
+    (gate-sharded), pp (GPipe stage runner - cell-generic since r3).
     """
     model_axes = [a for a in MODEL_AXES if axes.get(a, 1) > 1]
     if len(model_axes) > 1:
@@ -91,10 +90,6 @@ def validate_rnn_mesh(axes: dict[str, int], cell: str = "lstm"):
         )
     if model_axes and cell not in ("lstm", "gru"):
         raise ValueError(f"unknown cell {cell!r}")
-    if model_axes == ["pp"] and cell != "lstm":
-        raise ValueError(
-            f"the pp stage runner is LSTM-specific, got cell={cell!r}"
-        )
     return model_axes[0] if model_axes else None
 
 
@@ -152,9 +147,9 @@ def mesh_rnn_forward(params, x, *, sp=None, tp=None, pp=None,
         return row_parallel_head(params["fc"], out[:, -1, :], tp)
 
     if pp is not None:
-        out = pp_stacked_lstm(
+        out = pp_stacked_rnn(
             params["rnn"], x, pp, num_microbatches=num_microbatches,
-            unroll=unroll,
+            unroll=unroll, cell=cell,
         )
         last = out[:, -1, :]
         return last @ params["fc"]["weight"].T + params["fc"]["bias"]
@@ -244,9 +239,9 @@ def _char_local_logits(params, tokens, *, sp=None, tp=None, pp=None,
             jnp.einsum("bth,vh->btv", h_local, w_local), tp
         ) + head_b
     elif pp is not None:
-        out = pp_stacked_lstm(
+        out = pp_stacked_rnn(
             params["rnn"], x, pp, num_microbatches=num_microbatches,
-            unroll=unroll,
+            unroll=unroll, cell=cell,
         )
         logits = out @ head_w.T + head_b
     else:

@@ -52,13 +52,12 @@ class TestMeshSpec:
     def test_validate_rnn_mesh(self):
         assert validate_rnn_mesh({"dp": 2, "sp": 4}) == "sp"
         assert validate_rnn_mesh({"dp": 8}) is None
-        # GRU runs on sp (sequential relay) and tp (gate-sharded)
+        # GRU runs on every model axis (pp cell-generic since r3)
         assert validate_rnn_mesh({"tp": 2}, cell="gru") == "tp"
         assert validate_rnn_mesh({"sp": 2}, cell="gru") == "sp"
+        assert validate_rnn_mesh({"pp": 2}, cell="gru") == "pp"
         with pytest.raises(ValueError, match="at most ONE"):
             validate_rnn_mesh({"dp": 1, "sp": 2, "tp": 2})
-        with pytest.raises(ValueError, match="LSTM-specific"):
-            validate_rnn_mesh({"pp": 2}, cell="gru")
 
 
 @pytest.fixture(scope="module")
@@ -118,7 +117,8 @@ class TestMeshTrainerEquivalence:
     @pytest.mark.parametrize("axes", [
         {"dp": 2, "sp": 2},
         {"dp": 2, "tp": 2},
-    ], ids=["gru_dp_sp", "gru_dp_tp"])
+        {"dp": 2, "pp": 2},
+    ], ids=["gru_dp_sp", "gru_dp_tp", "gru_dp_pp"])
     def test_gru_mesh_matches_gru_ddp(self, datasets, axes):
         """GRU trains on sp/tp meshes with the same numerics as GRU DDP."""
         def gru_model():
