@@ -105,6 +105,17 @@ def pp_stacked_rnn(layers, x, axis: str, *, num_microbatches: int,
     L = len(layers)
     if L % n != 0:
         raise ValueError(f"{L} layers do not split into {n} stages")
+    # The gate count is derivable from the tree (4H for LSTM, 3H for
+    # GRU), and a mismatched ``cell`` would split the pre-activations
+    # into bogus gates with NO shape error whenever 4 | 3H - so verify
+    # rather than trust the caller.
+    gates = layers[0]["w_ih"].shape[0] // layers[0]["w_hh"].shape[1]
+    expected = {"lstm": 4, "gru": 3}[cell]
+    if gates != expected:
+        raise ValueError(
+            f"cell={cell!r} expects {expected}H-wide gates but the params "
+            f"tree carries {gates}H - wrong cell for this tree"
+        )
     per_stage = L // n
     M = num_microbatches
     batch, t, in_dim = x.shape

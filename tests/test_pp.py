@@ -146,3 +146,23 @@ def test_pp_gru_grads_match():
     g_ref = jax.jit(jax.grad(ref_loss))(params, x)
     for a, b in zip(jax.tree.leaves(g_pp), jax.tree.leaves(g_ref)):
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_pp_cell_mismatch_raises():
+    """A GRU tree run as LSTM would split (B, 3H) pre-activations into
+    four bogus gates with no shape error whenever 4 | 3H - the runner
+    derives the gate count from the tree and rejects the mismatch."""
+    from pytorch_distributed_rnn_tpu.parallel.pp import pp_stacked_rnn
+
+    mesh = make_mesh({"pp": 2})
+    gru_params = init_stacked_rnn(jax.random.PRNGKey(30), IN, H, 2,
+                                  cell="gru")
+    x = jax.random.normal(jax.random.PRNGKey(31), (B, T, IN))
+
+    @partial(shard_map, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+             check_vma=False)
+    def run_as_lstm(p, x):
+        return pp_stacked_rnn(p, x, "pp", num_microbatches=4)
+
+    with pytest.raises(ValueError, match="wrong cell"):
+        jax.jit(run_as_lstm)(gru_params, x)
