@@ -88,6 +88,51 @@ def _run_layer(stacked, l, acts, *, unroll: int = 1, cell: str = "lstm"):
     return jnp.swapaxes(out, 0, 1)
 
 
+def _gpipe_schedule(axis: str, x_micro, run_stage, *, hop, out_tail,
+                    dtype):
+    """The one GPipe tick loop shared by every pipelined family.
+
+    Stage ``k`` processes microbatch ``m`` at tick ``t = k + m``:
+    stage 0 reads microbatch ``m`` from ``x_micro`` (M, B_m, T, W_in),
+    every other stage consumes what arrived from the previous stage;
+    ``run_stage(stage_idx, acts)`` runs the stage's layers; the last
+    stage captures its microbatch's output; ``hop(acts)`` shapes the
+    activation for the stage-to-stage ``ppermute`` (identity when every
+    stage speaks the same width, a pad when layer 0's input width
+    differs).  Returns the (M, B_m, T, *out_tail) outputs replicated
+    from the last stage.
+    """
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    M = x_micro.shape[0]
+
+    def tick(state, tk):
+        buf, outs = state
+        m = tk - idx
+        active = (m >= 0) & (m < M)
+        m_safe = jnp.clip(m, 0, M - 1)
+        inp = jnp.where(
+            idx == 0,
+            lax.dynamic_index_in_dim(x_micro, m_safe, keepdims=False),
+            buf,
+        )
+        acts = run_stage(idx, inp)
+        outs = jnp.where(
+            (active & (idx == n - 1))
+            & (jnp.arange(M)[:, None, None, None] == m_safe),
+            acts[None], outs,
+        )
+        buf = lax.ppermute(hop(acts), axis, perm)
+        return (buf, outs), None
+
+    buf0 = jnp.zeros(x_micro.shape[1:], dtype)
+    outs0 = jnp.zeros(x_micro.shape[:3] + out_tail, dtype)
+    (_, outs), _ = lax.scan(tick, (buf0, outs0), jnp.arange(M + n - 1))
+    # outputs live on the last stage; replicate them everywhere
+    return broadcast_from(outs, axis, n - 1)
+
+
 def pp_stacked_rnn(layers, x, axis: str, *, num_microbatches: int,
                    unroll: int = 1, cell: str = "lstm"):
     """GPipe-scheduled stacked RNN (LSTM or GRU), for use inside
@@ -100,8 +145,6 @@ def pp_stacked_rnn(layers, x, axis: str, *, num_microbatches: int,
     :func:`~pytorch_distributed_rnn_tpu.ops.rnn.stacked_rnn`.
     """
     n = lax.axis_size(axis)
-    idx = lax.axis_index(axis)
-    perm = [(i, (i + 1) % n) for i in range(n)]
     L = len(layers)
     if L % n != 0:
         raise ValueError(f"{L} layers do not split into {n} stages")
@@ -129,50 +172,71 @@ def pp_stacked_rnn(layers, x, axis: str, *, num_microbatches: int,
     stacked = _stack_padded(layers, width, cell)
     x_micro = _pad_last(x, width).reshape(M, bm, t, width)
 
-    def select(active, new, old):
-        return jax.tree.map(lambda a, b: jnp.where(active, a, b), new, old)
-
-    def tick(state, tk):
-        buf, outs = state
-        m = tk - idx
-        active = (m >= 0) & (m < M)
-        m_safe = jnp.clip(m, 0, M - 1)
-        # stage 0 reads its microbatch from the input; later stages consume
-        # what arrived from the previous stage
-        inp = jnp.where(
-            idx == 0,
-            lax.dynamic_index_in_dim(x_micro, m_safe, keepdims=False),
-            buf,
-        )
-        acts = inp
+    def run_stage(stage, acts):
         for j in range(per_stage):
             # every layer consumes width-W input (layer output is H-wide)
-            acts = _run_layer(stacked, idx * per_stage + j,
+            acts = _run_layer(stacked, stage * per_stage + j,
                               _pad_last(acts, width), unroll=unroll,
                               cell=cell)
-        # last stage captures its microbatch's output
-        outs = jax.tree.map(
-            lambda buf_, new: jnp.where(
-                (active & (idx == n - 1))
-                & (jnp.arange(M)[:, None, None, None] == m_safe),
-                new[None], buf_,
-            ),
-            outs, acts,
-        )
-        # hand the activation to the next stage
-        buf = lax.ppermute(_pad_last(acts, width), axis, perm)
-        return (buf, outs), None
+        return acts
 
-    buf0 = jnp.zeros((bm, t, width), dtype)
-    outs0 = jnp.zeros((M, bm, t, hidden), dtype)
-    (_, outs), _ = lax.scan(tick, (buf0, outs0), jnp.arange(M + n - 1))
-    # outputs live on the last stage; replicate and restore batch order
-    outs = broadcast_from(outs, axis, n - 1)
+    outs = _gpipe_schedule(
+        axis, x_micro, run_stage,
+        hop=lambda acts: _pad_last(acts, width),  # hops are W-wide
+        out_tail=(hidden,), dtype=dtype,
+    )
     return outs.reshape(batch, t, hidden)
 
 
 # Backwards-compatible name from when the stage runner was LSTM-only.
 pp_stacked_lstm = pp_stacked_rnn
+
+
+def pp_transformer_blocks(blocks, h, axis: str, *, num_heads: int,
+                          num_microbatches: int):
+    """GPipe-scheduled Transformer encoder blocks, for use inside
+    ``shard_map`` over the ``pp`` axis (params and ``h`` (B, T, D)
+    replicated per stage) - the attention family's pipeline axis.
+
+    Same tick schedule as :func:`pp_stacked_rnn`, but simpler state:
+    every block is D -> D (no layer-0 width mismatch, so no padding),
+    and the hop payload is the (B_m, T, D) activation block.  ``L``
+    blocks split into ``axis_size`` contiguous stages; embed/positions
+    and the pooled head stay with the caller (position-wise and tiny -
+    they run replicated).
+    """
+    from pytorch_distributed_rnn_tpu.models.attention import apply_block
+
+    n = lax.axis_size(axis)
+    L = len(blocks)
+    if L % n != 0:
+        raise ValueError(f"{L} blocks do not split into {n} stages")
+    per_stage = L // n
+    M = num_microbatches
+    batch, t, d = h.shape
+    if batch % M != 0:
+        raise ValueError(f"batch {batch} not divisible into {M} microbatches")
+    bm = batch // M
+
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+    h_micro = h.reshape(M, bm, t, d)
+
+    def run_stage(stage, acts):
+        for j in range(per_stage):
+            p = jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(
+                    a, stage * per_stage + j, keepdims=False),
+                stacked,
+            )
+            acts = apply_block(p, acts, num_heads)
+        return acts
+
+    outs = _gpipe_schedule(
+        axis, h_micro, run_stage,
+        hop=lambda acts: acts,  # every block is D -> D: no padding
+        out_tail=(d,), dtype=h.dtype,
+    )
+    return outs.reshape(batch, t, d)
 
 
 def make_pp_forward(mesh, axis: str = "pp", *, num_microbatches: int = 4,

@@ -576,6 +576,57 @@ def make_attention_mesh_loss_fn(model, mesh, *, weighted: bool = False):
     return loss_fn
 
 
+def make_attention_pp_loss_fn(model, mesh, *, num_microbatches: int = 4,
+                              weighted: bool = False):
+    """Shard_mapped ``loss_fn(params, x, y[, w]) -> (loss, metrics)`` for
+    the attention family over a dp x pp mesh: encoder blocks split into
+    GPipe stages over ``pp`` (``parallel/pp.py:pp_transformer_blocks``),
+    batch rows over ``dp``.  Embed/positions and the pooled head run
+    replicated on every stage (position-wise and tiny).  pp does not
+    currently compose with sp/tp in one program - the trainer rejects
+    those specs loudly."""
+    from functools import partial as _partial
+
+    from pytorch_distributed_rnn_tpu.models.attention import _linear
+    from pytorch_distributed_rnn_tpu.parallel.pp import (
+        pp_transformer_blocks,
+    )
+
+    for axis in ("dp", "pp"):
+        if axis not in mesh.shape:
+            raise ValueError(
+                f"attention pp mesh needs axis {axis!r} (size 1 is "
+                f"fine); got {dict(mesh.shape)}"
+            )
+
+    batch_specs = (P("dp"), P("dp")) + ((P("dp"),) if weighted else ())
+
+    @_partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(),) + batch_specs,
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    def loss_fn(params, x_local, y_local, *w):
+        t = x_local.shape[1]
+        h = _linear(params["embed"], x_local) + params["pos"][:t]
+        h = pp_transformer_blocks(
+            params["blocks"], h, "pp", num_heads=model.num_heads,
+            num_microbatches=num_microbatches,
+        )
+        logits = _linear(params["head"], jnp.mean(h, axis=1))
+        local, correct = _classifier_loss_metrics(
+            logits, y_local, w[0] if weighted else None
+        )
+        return (
+            lax.pmean(local, "dp"),
+            {"correct": lax.psum(correct, "dp")},
+        )
+
+    return loss_fn
+
+
 def make_moe_mesh_loss_fn(model, mesh, *, weighted: bool = False):
     """Shard_mapped ``loss_fn(params, x, y[, w]) -> (loss, metrics)`` for a
     :class:`~pytorch_distributed_rnn_tpu.models.MoEClassifier` over a

@@ -65,16 +65,28 @@ class MeshTrainer(SpmdTrainer):
             axes = {"dp": axes.get("dp", 1), "ep": axes.get("ep", 1)}
             self.model_axis = None
         elif self.is_attention:
-            if axes.get("pp", 1) > 1:
-                raise ValueError(
-                    "the attention family has no pipeline stages; use "
-                    "sp/tp (e.g. --mesh dp=2,sp=2,tp=2)"
-                )
-            axes.pop("pp", None)
-            # every axis name must exist in the mesh for the composed
-            # program; unused axes get size 1
-            axes = {"dp": axes.get("dp", 1), "sp": axes.get("sp", 1),
-                    "tp": axes.get("tp", 1)}
+            # `!= 1`, not `> 1`: pp=-1 ("all remaining devices") must
+            # enter this branch too, not silently drop to plain DDP
+            if axes.get("pp", 1) != 1:
+                # GPipe over encoder blocks (parallel/pp.py); pp does not
+                # compose with sp/tp in one program yet - reject loudly
+                # rather than silently dropping an axis
+                bad = [a for a in ("sp", "tp") if axes.get(a, 1) != 1]
+                if bad:
+                    raise ValueError(
+                        f"attention pp does not compose with {bad} - use "
+                        "dp x pp (e.g. --mesh dp=2,pp=2) or the dp x sp "
+                        "x tp composition"
+                    )
+                # depth % pp is checked AFTER make_mesh resolves pp=-1
+                # (below) - depth % -1 would vacuously pass here
+                axes = {"dp": axes.get("dp", 1), "pp": axes["pp"]}
+            else:
+                axes.pop("pp", None)
+                # every axis name must exist in the mesh for the composed
+                # program; unused axes get size 1
+                axes = {"dp": axes.get("dp", 1), "sp": axes.get("sp", 1),
+                        "tp": axes.get("tp", 1)}
             self.model_axis = None
         else:
             self.model_axis = validate_rnn_mesh(
@@ -92,6 +104,13 @@ class MeshTrainer(SpmdTrainer):
             raise ValueError(
                 f"--num-experts {model.num_experts} does not shard over "
                 f"ep={self.mesh_axes['ep']}"
+            )
+        if (self.is_attention and self.mesh_axes.get("pp", 1) != 1
+                and model.depth % self.mesh_axes["pp"]):
+            # after -1 resolution for the same reason as the moe check
+            raise ValueError(
+                f"--stacked-layer {model.depth} blocks do not split "
+                f"into pp={self.mesh_axes['pp']} stages"
             )
         super().__init__(mesh=mesh, axis="dp", **kwargs)
         if self.is_char and self.model_axis == "sp":
@@ -141,6 +160,16 @@ class MeshTrainer(SpmdTrainer):
                 self.model, self.mesh, weighted=weighted
             )
         if self.is_attention:
+            if self.mesh_axes.get("pp", 1) > 1:
+                from pytorch_distributed_rnn_tpu.parallel.strategy import (
+                    make_attention_pp_loss_fn,
+                )
+
+                return make_attention_pp_loss_fn(
+                    self.model, self.mesh,
+                    num_microbatches=self.num_microbatches,
+                    weighted=weighted,
+                )
             from pytorch_distributed_rnn_tpu.parallel.strategy import (
                 make_attention_mesh_loss_fn,
             )

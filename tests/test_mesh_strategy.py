@@ -257,12 +257,38 @@ class TestAttentionMesh:
             leaves_sum(ref_params), rel=1e-4
         )
 
-    def test_pp_rejected_for_attention(self, datasets):
-        with pytest.raises(ValueError, match="no pipeline stages"):
+    def test_pp_mesh_matches_ddp(self, datasets):
+        """Attention dp x pp (GPipe over encoder blocks, cell-free pp
+        since r3) reproduces plain-DDP numerics."""
+        ref = DDPTrainer(
+            model=self._model(), training_set=datasets, batch_size=24,
+            learning_rate=2.5e-3, seed=SEED,
+            mesh=make_mesh({"dp": 2}, devices=jax.devices()[:2]),
+        )
+        ref_params, ref_history, _ = ref.train(epochs=2)
+        trainer = MeshTrainer(
+            mesh_axes={"dp": 2, "pp": 2}, model=self._model(),
+            training_set=datasets, batch_size=24, learning_rate=2.5e-3,
+            seed=SEED, num_microbatches=3,
+        )
+        params, history, _ = trainer.train(epochs=2)
+        assert history == pytest.approx(ref_history, rel=1e-3)
+        assert leaves_sum(params) == pytest.approx(
+            leaves_sum(ref_params), rel=1e-4
+        )
+
+    def test_pp_composition_rejections(self, datasets):
+        with pytest.raises(ValueError, match="does not compose"):
             MeshTrainer(
-                mesh_axes={"dp": 2, "pp": 2}, model=self._model(),
-                training_set=datasets, batch_size=24,
-                learning_rate=2.5e-3, seed=SEED,
+                mesh_axes={"dp": 1, "pp": 2, "sp": 2},
+                model=self._model(), training_set=datasets,
+                batch_size=24, learning_rate=2.5e-3, seed=SEED,
+            )
+        with pytest.raises(ValueError, match="do not split"):
+            MeshTrainer(
+                mesh_axes={"dp": 1, "pp": 4},  # depth 2 % 4 != 0
+                model=self._model(), training_set=datasets,
+                batch_size=24, learning_rate=2.5e-3, seed=SEED,
             )
 
 
