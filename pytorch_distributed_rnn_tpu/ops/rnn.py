@@ -295,8 +295,16 @@ def stacked_rnn(
         out, final = layer_fn(layer, out)
         finals.append(final)
         if dropout > 0.0 and dropout_key is not None and idx < len(layers) - 1:
-            dropout_key, sub = jax.random.split(dropout_key)
-            keep = 1.0 - dropout
-            mask = jax.random.bernoulli(sub, keep, out.shape)
-            out = jnp.where(mask, out / keep, 0.0)
+            out, dropout_key = interlayer_dropout(out, dropout_key, dropout)
     return out, finals
+
+
+def interlayer_dropout(out, dropout_key, dropout: float):
+    """The ONE between-layer dropout block (split/bernoulli/scale) shared
+    by the unsharded stack above and the sp relay stacks
+    (``parallel/sp.py``) - its placement/scaling being identical across
+    paths is a tested contract.  Returns ``(masked_out, next_key)``."""
+    dropout_key, sub = jax.random.split(dropout_key)
+    keep = 1.0 - dropout
+    mask = jax.random.bernoulli(sub, keep, out.shape)
+    return jnp.where(mask, out / keep, 0.0).astype(out.dtype), dropout_key

@@ -43,6 +43,7 @@ from jax import shard_map
 from pytorch_distributed_rnn_tpu.ops.rnn import (
     gru_input_proj,
     gru_step,
+    interlayer_dropout,
     lstm_input_proj,
     lstm_step,
 )
@@ -153,26 +154,33 @@ def _cast_for_compute(layers, x_local, compute_dtype):
 
 
 def sp_stacked_lstm(layers, x_local, axis: str, *, unroll: int = 1,
-                    compute_dtype=None, remat: bool = False):
+                    compute_dtype=None, remat: bool = False,
+                    dropout: float = 0.0, dropout_key=None):
     """Layer-sequential stacked LSTM over a time-sharded sequence.
 
     Each layer is a full relay; total latency O(L*T).  Prefer
-    :func:`sp_stacked_lstm_wavefront` when L > 1.
+    :func:`sp_stacked_lstm_wavefront` when L > 1 (unless dropout is on -
+    the wavefront interleaves layers across shards and threads no
+    between-layer masks, so dropout relays layer-sequentially).
     Returns ``(outputs_local, [per-layer final carries])``.
 
     ``compute_dtype``/``remat`` are the same TPU levers as
     ``ops.rnn.stacked_rnn``: bf16 compute with f32 carries, and
     per-layer ``jax.checkpoint`` (the relay - including its ppermute
     hops - is replayed during backward instead of saving activations).
+    ``dropout``/``dropout_key`` follow the ``stacked_rnn`` contract:
+    between layers only, skipped when the key is ``None`` (eval mode).
     """
     layer_fn = partial(sp_lstm_layer, axis=axis, unroll=unroll)
     if remat:
         layer_fn = jax.checkpoint(layer_fn)
     layers, out = _cast_for_compute(layers, x_local, compute_dtype)
     finals = []
-    for layer in layers:
+    for idx, layer in enumerate(layers):
         out, final = layer_fn(layer, out)
         finals.append(final)
+        if dropout > 0.0 and dropout_key is not None and idx < len(layers) - 1:
+            out, dropout_key = interlayer_dropout(out, dropout_key, dropout)
     return out, finals
 
 
@@ -208,23 +216,27 @@ def sp_gru_layer(params, x_local, axis: str, *, unroll: int = 1):
 
 
 def sp_stacked_gru(layers, x_local, axis: str, *, unroll: int = 1,
-                   compute_dtype=None, remat: bool = False):
+                   compute_dtype=None, remat: bool = False,
+                   dropout: float = 0.0, dropout_key=None):
     """Layer-sequential stacked GRU over a time-sharded sequence.
-    ``compute_dtype``/``remat`` as :func:`sp_stacked_lstm`."""
+    ``compute_dtype``/``remat``/``dropout`` as :func:`sp_stacked_lstm`."""
     layer_fn = partial(sp_gru_layer, axis=axis, unroll=unroll)
     if remat:
         layer_fn = jax.checkpoint(layer_fn)
     layers, out = _cast_for_compute(layers, x_local, compute_dtype)
     finals = []
-    for layer in layers:
+    for idx, layer in enumerate(layers):
         out, final = layer_fn(layer, out)
         finals.append(final)
+        if dropout > 0.0 and dropout_key is not None and idx < len(layers) - 1:
+            out, dropout_key = interlayer_dropout(out, dropout_key, dropout)
     return out, finals
 
 
 def sp_stacked_lstm_wavefront(layers, x_local, axis: str, *,
                               unroll: int = 1, compute_dtype=None,
-                              remat: bool = False):
+                              remat: bool = False,
+                              dropout: float = 0.0, dropout_key=None):
     """Wavefront-scheduled stacked LSTM over a time-sharded sequence.
 
     Cell ``(l, s)`` = layer ``l``'s recurrence over shard ``s``'s chunk.  At
@@ -242,9 +254,21 @@ def sp_stacked_lstm_wavefront(layers, x_local, axis: str, *,
     :func:`sp_stacked_lstm` exactly.
     """
     if len(layers) == 1:
+        # single layer: no between-layer seam exists, so dropout is a
+        # provable no-op - delegate (with the args threaded, where the
+        # idx < L-1 guard makes them inert) rather than reject
         return sp_stacked_lstm(
             layers, x_local, axis, unroll=unroll,
             compute_dtype=compute_dtype, remat=remat,
+            dropout=dropout, dropout_key=dropout_key,
+        )
+    if dropout > 0.0 and dropout_key is not None:
+        # the wavefront interleaves all layers in one scan - there is no
+        # between-layer seam to mask at; callers route dropout>0 to the
+        # sequential relay (strategy._sp_stack / the mesh trainer gate)
+        raise ValueError(
+            "the wavefront schedule threads no between-layer dropout - "
+            "use the sequential sp schedule"
         )
 
     layers, x_local = _cast_for_compute(layers, x_local, compute_dtype)

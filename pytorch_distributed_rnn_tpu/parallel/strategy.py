@@ -116,10 +116,12 @@ def mesh_rnn_forward(params, x, *, sp=None, tp=None, pp=None,
     axes; logits (B_local, out) return replicated over the model axes (so
     the caller's dp-only loss/metric collectives stay correct).
 
-    ``compute_dtype``/``remat`` apply on the unsharded and ``sp``
-    branches (the relay stacks thread them; the head stays f32 like
-    ``MotionModel.apply``); the tp/pp stacks are f32-structured and the
-    callers reject those combinations loudly.
+    ``compute_dtype``/``remat``/``dropout`` apply on the unsharded and
+    ``sp`` branches (the relay stacks thread them; the head stays f32
+    like ``MotionModel.apply``; each sp shard folds its index into the
+    dropout key for an independent mask over its local positions); the
+    tp/pp stacks are f32-structured and the callers reject those
+    combinations loudly.
     """
     if sum(a is not None for a in (sp, tp, pp)) > 1:
         raise ValueError("compose dp with at most one of sp/tp/pp")
@@ -132,9 +134,12 @@ def mesh_rnn_forward(params, x, *, sp=None, tp=None, pp=None,
             raise ValueError(f"seq len {t} not divisible by sp={n}")
         t_local = t // n
         x_loc = lax.dynamic_slice_in_dim(x, k * t_local, t_local, axis=1)
+        sp_key = (None if dropout_key is None
+                  else jax.random.fold_in(dropout_key, k))
         out_local, _ = _sp_stack(cell, schedule)(
             params["rnn"], x_loc, sp, unroll=unroll,
             compute_dtype=compute_dtype, remat=remat,
+            dropout=dropout, dropout_key=sp_key,
         )
         # true last step on shard n-1 only; head in f32 (model contract)
         last = out_local[:, -1, :].astype(jnp.float32)
@@ -183,11 +188,11 @@ def _char_local_logits(params, tokens, *, sp=None, tp=None, pp=None,
     position (the final global position predicts nothing); the shifted
     target slice is local arithmetic because tokens are replicated, so no
     boundary exchange is needed.  Without ``sp``: full-window logits
-    (B, T-1, V), ``w_pos`` None.  ``compute_dtype``/``remat`` thread
-    through the unsharded AND ``sp`` branches (the relay stacks take the
-    same levers; the head stays f32); ``dropout`` is unsharded-only, and
-    the tp/pp stacks are f32-structured - callers reject those
-    combinations loudly.
+    (B, T-1, V), ``w_pos`` None.  ``compute_dtype``/``remat``/``dropout``
+    thread through the unsharded AND ``sp`` branches (the relay stacks
+    take the same levers; the head stays f32; each sp shard folds its
+    index into the dropout key); the tp/pp stacks are f32-structured -
+    callers reject those combinations loudly.
     """
     if sum(a is not None for a in (sp, tp, pp)) > 1:
         raise ValueError("compose dp with at most one of sp/tp/pp")
@@ -207,9 +212,12 @@ def _char_local_logits(params, tokens, *, sp=None, tp=None, pp=None,
         tok_loc = lax.dynamic_slice_in_dim(tokens, k * t_local, t_local,
                                            axis=1)
         x_loc = params["embed"][tok_loc]
+        sp_key = (None if dropout_key is None
+                  else jax.random.fold_in(dropout_key, k))
         out_local, _ = _sp_stack(cell, schedule)(
             params["rnn"], x_loc, sp, unroll=unroll,
             compute_dtype=compute_dtype, remat=remat,
+            dropout=dropout, dropout_key=sp_key,
         )
         # (B, t_local, V); head in f32 like the unsharded branch
         logits = out_local.astype(jnp.float32) @ head_w.T + head_b
@@ -291,23 +299,40 @@ def _axis_kwargs(axes: dict[str, int], cell: str = "lstm"):
 
 
 def _reject_unsupported_mesh_levers(model_axis, precision: str,
-                                    remat: bool, dropout: float):
-    """Loud, never silent: bf16 + remat thread through the sp relay
-    stacks (the long-context flagship composition, VERDICT.md round-3
-    item 3) and the unsharded branch, but the tp/pp stacks are
-    f32-structured and no model axis threads dropout - honoring those
-    flags is not possible, so do not pretend to."""
+                                    remat: bool, dropout: float,
+                                    schedule: str = "wavefront",
+                                    cell: str = "lstm",
+                                    num_layers: int | None = None):
+    """Loud, never silent: bf16 + remat + dropout all thread through the
+    sp relay stacks (the long-context flagship composition: bf16/remat
+    since r2's VERDICT item 3, dropout since r3) and the unsharded
+    branch - but sp dropout needs the SEQUENTIAL relay (the wavefront
+    interleaves all layers in one scan, leaving no between-layer seam to
+    mask at; GRU always relays sequentially), and the tp/pp stacks are
+    f32-structured with no dropout seam at all.  Honoring those flag
+    combinations is not possible, so do not pretend to."""
     if model_axis in ("tp", "pp") and (precision != "f32" or remat):
         raise ValueError(
             f"precision=bf16/remat are not supported on the {model_axis} "
             f"mesh (f32-structured stage/gate kernels) - use a dp or "
             f"dp x sp mesh, or drop the flag"
         )
-    if model_axis is not None and dropout > 0.0:
+    if model_axis in ("tp", "pp") and dropout > 0.0:
         raise ValueError(
             f"dropout is not supported on the {model_axis} mesh (the "
-            "relay/stage kernels thread no dropout) - use a dp-only mesh "
-            "or --dropout 0"
+            "stage/gate kernels thread no dropout) - use a dp or dp x sp "
+            "mesh, or --dropout 0"
+        )
+    if (model_axis == "sp" and dropout > 0.0
+            and cell == "lstm" and schedule != "sequential"
+            and (num_layers is None or num_layers > 1)):
+        # single-layer stacks have no between-layer seam: dropout is a
+        # provable no-op there (and the wavefront delegates to the
+        # sequential relay at L=1), so only multi-layer stacks reject
+        raise ValueError(
+            "sp dropout needs the sequential relay (the wavefront "
+            "schedule has no between-layer seam to mask at) - pass "
+            "--sp-schedule sequential or --dropout 0"
         )
 
 
@@ -390,7 +415,8 @@ def make_char_mesh_loss_fn(mesh, axes: dict[str, int], *,
                            num_microbatches: int = 4, unroll: int = 1,
                            weighted: bool = False, dropout: float = 0.0,
                            cell: str = "lstm", precision: str = "f32",
-                           remat: bool = False):
+                           remat: bool = False,
+                           num_layers: int | None = None):
     """Shard_mapped ``loss_fn(params, tokens, y[, w][, key]) -> (loss,
     metrics)`` for the char-LM over a composed mesh - the trainer-contract
     sibling of :func:`make_motion_mesh_loss_fn` (same batch plumbing:
@@ -403,7 +429,9 @@ def make_char_mesh_loss_fn(mesh, axes: dict[str, int], *,
     """
     kw = _axis_kwargs(axes, cell)
     model_axis = next((a for a, v in kw.items() if v is not None), None)
-    _reject_unsupported_mesh_levers(model_axis, precision, remat, dropout)
+    _reject_unsupported_mesh_levers(model_axis, precision, remat, dropout,
+                                    schedule=schedule, cell=cell,
+                                    num_layers=num_layers)
     compute_dtype = jnp.bfloat16 if precision == "bf16" else None
 
     from functools import partial as _partial
@@ -454,7 +482,8 @@ def make_motion_mesh_loss_fn(mesh, axes: dict[str, int], *,
                              num_microbatches: int = 4, unroll: int = 1,
                              weighted: bool = False, dropout: float = 0.0,
                              cell: str = "lstm", precision: str = "f32",
-                             remat: bool = False):
+                             remat: bool = False,
+                             num_layers: int | None = None):
     """Shard_mapped ``loss_fn(params, x, y[, w][, key]) -> (loss,
     metrics)`` for the motion model over a composed mesh: ``x``/``y`` (and
     ``w``) shard their batch dim over ``dp``; the scalar loss and summed
@@ -468,7 +497,9 @@ def make_motion_mesh_loss_fn(mesh, axes: dict[str, int], *,
     the char mesh (tp/pp reject loudly)."""
     kw = _axis_kwargs(axes, cell)
     model_axis = next((a for a, v in kw.items() if v is not None), None)
-    _reject_unsupported_mesh_levers(model_axis, precision, remat, dropout)
+    _reject_unsupported_mesh_levers(model_axis, precision, remat, dropout,
+                                    schedule=schedule, cell=cell,
+                                    num_layers=num_layers)
     compute_dtype = jnp.bfloat16 if precision == "bf16" else None
 
     from functools import partial as _partial

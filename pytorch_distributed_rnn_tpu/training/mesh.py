@@ -135,11 +135,22 @@ class MeshTrainer(SpmdTrainer):
                 "meshes (f32-structured stage/gate kernels) - use a dp or "
                 "dp x sp mesh, or drop the flag"
             )
-        if self._dropout > 0.0 and self.model_axis is not None:
+        if self._dropout > 0.0 and self.model_axis in ("tp", "pp"):
             raise NotImplementedError(
-                "dropout is not supported on sp/tp/pp mesh strategies - "
-                "pass --dropout 0 (the CLI default 0.1 mirrors the "
-                "reference surface, main.py:26)"
+                "dropout is not supported on tp/pp mesh strategies (no "
+                "dropout seam in the stage/gate kernels) - pass "
+                "--dropout 0 (the CLI default 0.1 mirrors the reference "
+                "surface, main.py:26)"
+            )
+        if (self._dropout > 0.0 and self.model_axis == "sp"
+                and getattr(model, "cell", "lstm") == "lstm"
+                and getattr(model, "layer_dim", 2) > 1
+                and self.schedule != "sequential"):
+            # fail at construction with the exact remedy (the strategy
+            # layer re-checks this at trace time)
+            raise ValueError(
+                "sp dropout needs the sequential relay - pass "
+                "--sp-schedule sequential or --dropout 0"
             )
 
     def _data_world_size(self) -> int:
@@ -189,6 +200,7 @@ class MeshTrainer(SpmdTrainer):
                 cell=getattr(self.model, "cell", "lstm"),
                 precision=getattr(self.model, "precision", "f32"),
                 remat=getattr(self.model, "remat", False),
+                num_layers=getattr(self.model, "layer_dim", None),
             )
         return make_motion_mesh_loss_fn(
             self.mesh, self.mesh_axes, schedule=self.schedule,
@@ -197,6 +209,7 @@ class MeshTrainer(SpmdTrainer):
             cell=getattr(self.model, "cell", "lstm"),
             precision=getattr(self.model, "precision", "f32"),
             remat=getattr(self.model, "remat", False),
+            num_layers=getattr(self.model, "layer_dim", None),
         )
 
     def _jit_replicated(self, fn):

@@ -176,12 +176,22 @@ class TestMeshTrainerEquivalence:
             leaves_sum(bparams), abs=1e-9
         )
 
-    def test_dropout_rejected_on_model_axes(self, datasets):
+    def test_dropout_gates_on_model_axes(self, datasets):
+        """sp takes dropout since r3 (sequential relay only - the default
+        wavefront schedule still rejects with the remedy); tp/pp have no
+        dropout seam and keep the hard reject.  The sp-trains cases live
+        in tests/test_dropout.py::TestSpMeshDropout."""
         model = MotionModel(input_dim=9, hidden_dim=8, layer_dim=2,
                             output_dim=6, impl="scan", dropout=0.5)
-        with pytest.raises(NotImplementedError, match="dropout"):
+        with pytest.raises(ValueError, match="sequential"):
             MeshTrainer(
                 mesh_axes={"dp": 2, "sp": 2}, model=model,
+                training_set=datasets, batch_size=24,
+                learning_rate=2.5e-3, seed=SEED,
+            )
+        with pytest.raises(NotImplementedError, match="dropout"):
+            MeshTrainer(
+                mesh_axes={"dp": 2, "pp": 2}, model=model,
                 training_set=datasets, batch_size=24,
                 learning_rate=2.5e-3, seed=SEED,
             )
