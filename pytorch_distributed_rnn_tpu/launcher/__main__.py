@@ -79,7 +79,7 @@ def main(argv=None):
     p.add_argument("--num-train", type=int, default=7352)
     p.add_argument("--num-test", type=int, default=2947)
 
-    for task in ("run-debug", "run-all", "show-commands"):
+    for task in ("run-debug", "run-all", "run-matrix", "show-commands"):
         p = sub.add_parser(task)
         _add_common(p)
 
@@ -217,13 +217,19 @@ def main(argv=None):
         )
         return _report(executed, args.results)
 
-    configs = [
-        config
-        for run in runs
-        for config in bench.expand_run_configs(
-            run, _dataset_parameters(args), args.backend
+    if args.task == "run-matrix":
+        # one run per strategy x family README-matrix cell
+        configs = bench.matrix_configs(
+            _dataset_parameters(args), args.backend
         )
-    ]
+    else:
+        configs = [
+            config
+            for run in runs
+            for config in bench.expand_run_configs(
+                run, _dataset_parameters(args), args.backend
+            )
+        ]
     executed = bench.run_benchmark(
         configs, args.results, timeout=args.timeout
     )

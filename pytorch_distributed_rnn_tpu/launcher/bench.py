@@ -111,6 +111,51 @@ CHIP_LM_RUN = {
     },
 }
 
+# The strategy x family matrix as explicit runs - one committed run per
+# README matrix cell (every cell trainable since r3).  Explicit configs,
+# not a cartesian product: each family carries its own flag constraints
+# (attention/moe reject dropout; char sp needs sp | seq_length+1) and
+# each strategy its own world shape.  `devices` is the dp world for the
+# dp strategies and the TOTAL mesh size for mesh rows.
+_MATRIX_BASE = {
+    "epochs": 1, "seed": 123456789, "learning-rate": 0.0025,
+    "validation-fraction": 0.05, "no-validation": True, "log": "INFO",
+    "batch-size": 48, "hidden-units": 16, "stacked-layer": 2,
+    "dropout": 0,
+}
+
+
+def matrix_configs(extra_parameters=None, backend="cpu"):
+    """One RunConfig per strategy x family matrix cell."""
+    rows = []
+    for family, fam_params, meshes in (
+        ("rnn", {}, ["mesh --mesh dp=2,sp=2 --sp-schedule sequential"]),
+        ("char", {"seq-length": 15}, ["mesh --mesh dp=2,sp=2"]),
+        ("attention", {}, ["mesh --mesh dp=2,sp=2,tp=2",
+                           "mesh --mesh dp=2,pp=2"]),
+        ("moe", {}, ["mesh --mesh dp=2,ep=2"]),
+    ):
+        params = {**_MATRIX_BASE, "model": family, **fam_params,
+                  **(extra_parameters or {})}
+        for trainer, devices in (
+            ("local", 1), ("distributed", 2), ("horovod", 2),
+            ("fsdp", 2), ("distributed-native", 2),
+            ("parameter-server", 2),
+        ):
+            rows.append(make_config(trainer, devices, 1, params, backend))
+        for mesh_trainer in meshes:
+            from math import prod
+
+            from pytorch_distributed_rnn_tpu.parallel.strategy import (
+                parse_mesh_spec,
+            )
+
+            spec = mesh_trainer.split("--mesh ")[1].split()[0]
+            size = prod(parse_mesh_spec(spec).values())
+            rows.append(make_config(mesh_trainer, size, 1, params, backend))
+    return rows
+
+
 # fabfile.py:130-191: delays 0-400 ms, loss 0-15 %.
 NETWORK_RULES = [
     ("delay", 0.0),

@@ -95,9 +95,13 @@ def get_command(config: RunConfig, python: str | None = None):
             "--trainer", config.trainer,
             "--backend", config.backend, "--", *flag_argv,
         ]
-    elif config.trainer in ("local", "distributed", "horovod", "fsdp"):
+    elif (config.trainer in ("local", "distributed", "horovod", "fsdp")
+          or config.trainer.startswith("mesh")):
+        # a "mesh --mesh dp=2,sp=2 ..." trainer string carries its own
+        # subcommand options (the run-world --trainer convention);
+        # `devices` is the TOTAL mesh size for mesh rows
         argv = [python, "-m", "pytorch_distributed_rnn_tpu.main",
-                *flag_argv, config.trainer]
+                *flag_argv, *shlex.split(config.trainer)]
         if config.backend == "cpu":
             # local rows too: the whole study must run on ONE platform,
             # like the reference's local row running on the same Pi

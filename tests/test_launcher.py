@@ -428,3 +428,37 @@ def test_fsdp_multi_slot_is_a_real_process_world():
     assert "run-world" in argv
     assert argv[argv.index("--trainer") + 1] == "fsdp"
     assert argv[argv.index("--num-processes") + 1] == "2"
+
+
+def test_matrix_configs_cover_every_readme_cell():
+    """run-matrix = one run per strategy x family matrix cell (every cell
+    trainable since r3).  4 families x 6 dp-strategies + 5 mesh rows."""
+    from pytorch_distributed_rnn_tpu.launcher import bench
+    from pytorch_distributed_rnn_tpu.launcher.commands import (
+        command_string,
+        get_command,
+    )
+
+    cfgs = bench.matrix_configs()
+    assert len(cfgs) == 29
+    by_family = {}
+    for c in cfgs:
+        fam = c.parameters_dict()["model"]
+        by_family.setdefault(fam, []).append(c.trainer)
+    assert set(by_family) == {"rnn", "char", "attention", "moe"}
+    for fam, trainers in by_family.items():
+        for t in ("local", "distributed", "horovod", "fsdp",
+                  "distributed-native", "parameter-server"):
+            assert t in trainers, (fam, t)
+        assert any(t.startswith("mesh") for t in trainers), fam
+    # attention covers BOTH mesh compositions (3d and GPipe pp)
+    att = [t for t in by_family["attention"] if t.startswith("mesh")]
+    assert any("tp=2" in t for t in att) and any("pp=2" in t for t in att)
+    # every config synthesizes a unique, runnable command
+    seen = set()
+    for c in cfgs:
+        argv, env = get_command(c)
+        assert argv[0].endswith("python") or "python" in argv[0]
+        s = command_string(c)
+        assert s not in seen
+        seen.add(s)
