@@ -65,23 +65,40 @@ def block_qkv(params, x, num_heads: int):
     return q, k, v
 
 
-def block_epilogue(params, x, attn_out):
+def _dropout(x, key, rate: float):
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+def block_epilogue(params, x, attn_out, dropout: float = 0.0,
+                   dropout_key=None):
     """Output projection + residual + MLP: position-wise, runs locally on
-    any sequence chunk."""
-    x = x + _linear(params["wo"], _merge_heads(attn_out))
+    any sequence chunk.  ``dropout`` masks each sublayer's output before
+    its residual add (torch ``TransformerEncoderLayer`` placement);
+    ``dropout_key=None`` = eval/deterministic mode."""
+    attn_proj = _linear(params["wo"], _merge_heads(attn_out))
+    if dropout > 0.0 and dropout_key is not None:
+        k1, k2 = jax.random.split(dropout_key)
+        attn_proj = _dropout(attn_proj, k1, dropout)
+    x = x + attn_proj
     y = _layer_norm(x, **params["ln2"])
     y = _linear(params["fc2"], jax.nn.gelu(_linear(params["fc1"], y)))
+    if dropout > 0.0 and dropout_key is not None:
+        y = _dropout(y, k2, dropout)
     return x + y
 
 
-def apply_block(params, x, num_heads: int, attention=None):
+def apply_block(params, x, num_heads: int, attention=None,
+                dropout: float = 0.0, dropout_key=None):
     """One encoder block.  ``attention(q, k, v) -> out`` defaults to full
     attention; sequence-parallel callers inject ring/Ulysses attention."""
     q, k, v = block_qkv(params, x, num_heads)
     attn = attention if attention is not None else (
         lambda q, k, v: mha_attention(q, k, v)
     )
-    return block_epilogue(params, x, attn(q, k, v))
+    return block_epilogue(params, x, attn(q, k, v),
+                          dropout=dropout, dropout_key=dropout_key)
 
 
 @dataclass(frozen=True)
@@ -95,6 +112,8 @@ class AttentionClassifier:
     num_heads: int = 4
     output_dim: int = 6
     max_len: int = 4096
+    dropout: float = 0.0  # per-sublayer residual dropout; train-mode only
+    # (apply threads a key; eval passes none and stays deterministic)
 
     def __post_init__(self):
         if self.dim % self.num_heads != 0:
@@ -116,13 +135,19 @@ class AttentionClassifier:
             "head": linear_init(ks[-1], self.dim, self.output_dim),
         }
 
-    def apply(self, params, x: jax.Array, attention=None) -> jax.Array:
+    def apply(self, params, x: jax.Array, attention=None,
+              dropout_key=None) -> jax.Array:
         """x: (B, T, input_dim) -> logits (B, output_dim).  ``attention``
         overrides the per-block attention (ring/Ulysses injection point);
-        positions are added by the caller for sequence-parallel chunks."""
+        positions are added by the caller for sequence-parallel chunks.
+        ``dropout_key=None`` selects eval/deterministic mode; pass a PRNG
+        key for train-mode per-sublayer dropout."""
         t = x.shape[1]
         h = _linear(params["embed"], x) + params["pos"][:t]
-        for blk in params["blocks"]:
-            h = apply_block(blk, h, self.num_heads, attention)
+        for i, blk in enumerate(params["blocks"]):
+            blk_key = (None if dropout_key is None
+                       else jax.random.fold_in(dropout_key, i))
+            h = apply_block(blk, h, self.num_heads, attention,
+                            dropout=self.dropout, dropout_key=blk_key)
         pooled = jnp.mean(h, axis=1)
         return _linear(params["head"], pooled)

@@ -92,7 +92,6 @@ def build_model(args, training_set):
 
         unsupported = [
             flag for flag, active in (
-                ("--dropout", bool(getattr(args, "dropout", 0.0))),
                 ("--precision bf16",
                  getattr(args, "precision", "f32") != "f32"),
                 ("--remat", getattr(args, "remat", False)),
@@ -102,8 +101,7 @@ def build_model(args, training_set):
         if unsupported:
             raise SystemExit(
                 f"--model attention does not support: "
-                f"{', '.join(unsupported)} (pass --dropout 0; the CLI "
-                "default 0.1 mirrors the reference surface)"
+                f"{', '.join(unsupported)}"
             )
         return AttentionClassifier(
             input_dim=training_set.num_features,
@@ -111,6 +109,7 @@ def build_model(args, training_set):
             depth=args.stacked_layer,
             num_heads=getattr(args, "num_heads", 4),
             output_dim=len(MotionDataset.LABELS),
+            dropout=getattr(args, "dropout", 0.0) or 0.0,
         )
     if fam == "moe":
         from pytorch_distributed_rnn_tpu.models import MoEClassifier

@@ -142,6 +142,16 @@ class MeshTrainer(SpmdTrainer):
                 "--dropout 0 (the CLI default 0.1 mirrors the reference "
                 "surface, main.py:26)"
             )
+        if self._dropout > 0.0 and self.is_attention:
+            # the attention family's dropout (models/attention.py) rides
+            # the dp strategies' key plumbing; the composed-mesh programs
+            # (attention_mesh_logits / the pp loss) thread no keys - a
+            # key-less run would silently train without dropout
+            raise NotImplementedError(
+                "dropout is not supported on attention mesh strategies - "
+                "use local/distributed/horovod/fsdp/distributed-native/"
+                "parameter-server, or pass --dropout 0"
+            )
         if (self._dropout > 0.0 and self.model_axis == "sp"
                 and getattr(model, "cell", "lstm") == "lstm"
                 and getattr(model, "layer_dim", 2) > 1
