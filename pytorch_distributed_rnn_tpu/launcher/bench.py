@@ -125,8 +125,24 @@ _MATRIX_BASE = {
 }
 
 
+def _mesh_spec_of(trainer_string: str) -> str:
+    """Extract the --mesh value from a trainer string, accepting both
+    ``--mesh spec`` and ``--mesh=spec`` forms."""
+    tokens = shlex.split(trainer_string)
+    for i, tok in enumerate(tokens):
+        if tok == "--mesh" and i + 1 < len(tokens):
+            return tokens[i + 1]
+        if tok.startswith("--mesh="):
+            return tok.split("=", 1)[1]
+    raise ValueError(f"no --mesh value in trainer string: {trainer_string!r}")
+
+
 def matrix_configs(extra_parameters=None, backend="cpu"):
     """One RunConfig per strategy x family matrix cell."""
+    from math import prod
+
+    from pytorch_distributed_rnn_tpu.parallel.strategy import parse_mesh_spec
+
     rows = []
     for family, fam_params, meshes in (
         ("rnn", {}, ["mesh --mesh dp=2,sp=2 --sp-schedule sequential"]),
@@ -144,14 +160,7 @@ def matrix_configs(extra_parameters=None, backend="cpu"):
         ):
             rows.append(make_config(trainer, devices, 1, params, backend))
         for mesh_trainer in meshes:
-            from math import prod
-
-            from pytorch_distributed_rnn_tpu.parallel.strategy import (
-                parse_mesh_spec,
-            )
-
-            spec = mesh_trainer.split("--mesh ")[1].split()[0]
-            size = prod(parse_mesh_spec(spec).values())
+            size = prod(parse_mesh_spec(_mesh_spec_of(mesh_trainer)).values())
             rows.append(make_config(mesh_trainer, size, 1, params, backend))
     return rows
 

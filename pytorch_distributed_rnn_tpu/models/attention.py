@@ -74,18 +74,26 @@ def _dropout(x, key, rate: float):
 def block_epilogue(params, x, attn_out, dropout: float = 0.0,
                    dropout_key=None):
     """Output projection + residual + MLP: position-wise, runs locally on
-    any sequence chunk.  ``dropout`` masks each sublayer's output before
-    its residual add (torch ``TransformerEncoderLayer`` placement);
+    any sequence chunk.  ``dropout`` masks the two residual-path sublayer
+    outputs (torch dropout1/dropout2) and the FFN activation between
+    fc1 and fc2 (torch's inner ``self.dropout``).  Torch's fourth site -
+    dropout on the attention probabilities inside MHA - is NOT applied
+    here: the attention callable is strategy-injected (ring/Ulysses), so
+    probabilities never pass through this epilogue.
     ``dropout_key=None`` = eval/deterministic mode."""
     attn_proj = _linear(params["wo"], _merge_heads(attn_out))
-    if dropout > 0.0 and dropout_key is not None:
-        k1, k2 = jax.random.split(dropout_key)
+    train = dropout > 0.0 and dropout_key is not None
+    if train:
+        k1, k2, k3 = jax.random.split(dropout_key, 3)
         attn_proj = _dropout(attn_proj, k1, dropout)
     x = x + attn_proj
     y = _layer_norm(x, **params["ln2"])
-    y = _linear(params["fc2"], jax.nn.gelu(_linear(params["fc1"], y)))
-    if dropout > 0.0 and dropout_key is not None:
+    y = jax.nn.gelu(_linear(params["fc1"], y))
+    if train:
         y = _dropout(y, k2, dropout)
+    y = _linear(params["fc2"], y)
+    if train:
+        y = _dropout(y, k3, dropout)
     return x + y
 
 
@@ -112,8 +120,9 @@ class AttentionClassifier:
     num_heads: int = 4
     output_dim: int = 6
     max_len: int = 4096
-    dropout: float = 0.0  # per-sublayer residual dropout; train-mode only
-    # (apply threads a key; eval passes none and stays deterministic)
+    dropout: float = 0.0  # residual-path (dropout1/dropout2) + inner-FFN
+    # dropout; train-mode only (apply threads a key; eval passes none and
+    # stays deterministic).  See block_epilogue for the site placement.
 
     def __post_init__(self):
         if self.dim % self.num_heads != 0:

@@ -105,13 +105,21 @@ class MeshTrainer(SpmdTrainer):
                 f"--num-experts {model.num_experts} does not shard over "
                 f"ep={self.mesh_axes['ep']}"
             )
-        if (self.is_attention and self.mesh_axes.get("pp", 1) != 1
-                and model.depth % self.mesh_axes["pp"]):
-            # after -1 resolution for the same reason as the moe check
-            raise ValueError(
-                f"--stacked-layer {model.depth} blocks do not split "
-                f"into pp={self.mesh_axes['pp']} stages"
-            )
+        if self.is_attention and "pp" in self.mesh_axes:
+            # after -1 resolution: a pp=-1 that resolved to 1 would keep
+            # {dp, pp} axes while _loss_fn (gated on pp > 1) routed to the
+            # sp/tp loss builder and failed with a misdirected "needs axis
+            # 'sp'" error - reject the degenerate request here instead
+            if self.mesh_axes["pp"] == 1:
+                raise ValueError(
+                    "pp resolved to 1 stage (pp=-1 with no devices left "
+                    "over) - drop the pp axis or leave >=2 devices for it"
+                )
+            if model.depth % self.mesh_axes["pp"]:
+                raise ValueError(
+                    f"--stacked-layer {model.depth} blocks do not split "
+                    f"into pp={self.mesh_axes['pp']} stages"
+                )
         super().__init__(mesh=mesh, axis="dp", **kwargs)
         if self.is_char and self.model_axis == "sp":
             window = self.training_set.features.shape[1]

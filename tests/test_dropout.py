@@ -61,6 +61,47 @@ class TestStackedRnnDropout:
         np.testing.assert_array_equal(np.asarray(base), np.asarray(out))
 
 
+class TestAttentionBlockDropoutSites:
+    """Pins block_epilogue's three dropout sites (torch
+    TransformerEncoderLayer's dropout1 / inner self.dropout / dropout2
+    placement) against a hand-rolled reference with the same key split."""
+
+    def test_three_site_placement(self):
+        from pytorch_distributed_rnn_tpu.models import attention as A
+
+        key = jax.random.PRNGKey(0)
+        params = A.init_block(key, dim=8, num_heads=2)
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 5, 8))
+        attn_out = jax.random.normal(jax.random.PRNGKey(2), (2, 2, 5, 4))
+        dk = jax.random.PRNGKey(7)
+        rate = 0.5
+
+        got = A.block_epilogue(params, x, attn_out, dropout=rate,
+                               dropout_key=dk)
+
+        k1, k2, k3 = jax.random.split(dk, 3)
+        attn_proj = A._linear(params["wo"], A._merge_heads(attn_out))
+        attn_proj = A._dropout(attn_proj, k1, rate)  # dropout1
+        h = x + attn_proj
+        y = A._layer_norm(h, **params["ln2"])
+        y = jax.nn.gelu(A._linear(params["fc1"], y))
+        y = A._dropout(y, k2, rate)  # inner FFN dropout
+        y = A._linear(params["fc2"], y)
+        y = A._dropout(y, k3, rate)  # dropout2
+        np.testing.assert_allclose(np.asarray(got), np.asarray(h + y),
+                                   rtol=1e-6)
+
+    def test_eval_mode_unchanged(self):
+        from pytorch_distributed_rnn_tpu.models import attention as A
+
+        params = A.init_block(jax.random.PRNGKey(0), dim=8, num_heads=2)
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 5, 8))
+        attn_out = jax.random.normal(jax.random.PRNGKey(2), (2, 2, 5, 4))
+        base = A.block_epilogue(params, x, attn_out)
+        no_key = A.block_epilogue(params, x, attn_out, dropout=0.5)
+        np.testing.assert_array_equal(np.asarray(base), np.asarray(no_key))
+
+
 class TestModelDropout:
     def test_motion_model_train_vs_eval(self):
         model = MotionModel(

@@ -462,3 +462,14 @@ def test_matrix_configs_cover_every_readme_cell():
         s = command_string(c)
         assert s not in seen
         seen.add(s)
+
+
+def test_mesh_spec_extraction_accepts_both_flag_forms():
+    from pytorch_distributed_rnn_tpu.launcher.bench import _mesh_spec_of
+
+    assert _mesh_spec_of("mesh --mesh dp=2,sp=2") == "dp=2,sp=2"
+    assert _mesh_spec_of("mesh --mesh=dp=2,tp=2 --sp-schedule x") == (
+        "dp=2,tp=2"
+    )
+    with pytest.raises(ValueError, match="no --mesh value"):
+        _mesh_spec_of("mesh --other flag")

@@ -301,6 +301,18 @@ class TestAttentionMesh:
                 batch_size=24, learning_rate=2.5e-3, seed=SEED,
             )
 
+    def test_pp_resolving_to_one_stage_rejected(self, datasets):
+        """pp=-1 with no devices left over resolves to a 1-stage pipeline;
+        that used to slip past the pp>1 loss-fn gate and die with a
+        misdirected "needs axis 'sp'" error - now rejected loudly."""
+        n = len(jax.devices())
+        with pytest.raises(ValueError, match="pp resolved to 1"):
+            MeshTrainer(
+                mesh_axes={"dp": n, "pp": -1}, model=self._model(),
+                training_set=datasets, batch_size=24,
+                learning_rate=2.5e-3, seed=SEED,
+            )
+
 
 @pytest.mark.slow
 def test_cli_attention_3d_mesh_end_to_end(tmp_path):
