@@ -170,13 +170,16 @@ def char50m_tokens_per_sec(precision: str, batch: int = 32,
 
 
 def attention_throughput(batch: int = 256, steps: int = 30,
-                         seq_len: int = SEQ_LEN) -> float:
+                         seq_len: int = SEQ_LEN,
+                         impl: str = "auto") -> float:
     """seq/s training the attention classifier on HAR-shaped windows -
     the long-context family's single-chip baseline number (its sp/tp mesh
     composition is compile-validated by dryrun_multichip; ring-attention
     wall-clock needs a real multi-chip slice).  ``seq_len`` above the HAR
     window probes the dense-attention long-context regime one chip can
-    measure (quadratic attention FLOPs start to dominate ~1k)."""
+    measure (quadratic attention FLOPs start to dominate ~1k).  ``impl``
+    selects the attention inner: ``dense`` XLA vs the fused ``flash``
+    Pallas kernel (``auto`` = flash on TPU)."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -186,7 +189,7 @@ def attention_throughput(batch: int = 256, steps: int = 30,
 
     model = AttentionClassifier(input_dim=NUM_FEATURES, dim=128, depth=2,
                                 num_heads=4, output_dim=6,
-                                max_len=seq_len)
+                                max_len=seq_len, impl=impl)
     params = model.init(jax.random.PRNGKey(0))
     opt = optax.adam(1e-3)
     opt_state = opt.init(params)
@@ -380,13 +383,21 @@ def main():
                         "batch": 512, "accum": 2, "seq": 128}
 
             attempt("char_rnn_50m_bf16_b512_accum2", _accum_row)
+            # dense vs fused flash kernel at the HAR window and at 8x it:
+            # the flash/dense ratio is the attention family's kernel win
+            # (quadratic dense attention starts to dominate ~1k)
             attempt("attention_seq_per_sec",
-                    lambda: round(attention_throughput(), 1))
-            # dense attention at 8x the HAR window: the single-chip
-            # long-context point (the sp/ring path needs a real slice)
+                    lambda: round(attention_throughput(impl="dense"), 1))
+            attempt("attention_flash_seq_per_sec",
+                    lambda: round(attention_throughput(impl="flash"), 1))
             attempt("attention_seq1024_seq_per_sec",
                     lambda: round(attention_throughput(
-                        batch=64, steps=15, seq_len=1024), 1))
+                        batch=64, steps=15, seq_len=1024,
+                        impl="dense"), 1))
+            attempt("attention_flash_seq1024_seq_per_sec",
+                    lambda: round(attention_throughput(
+                        batch=64, steps=15, seq_len=1024,
+                        impl="flash"), 1))
         else:
             extras["char_rnn_50m"] = "skipped: no TPU"
             extras["attention"] = "skipped: no TPU"

@@ -123,6 +123,9 @@ class AttentionClassifier:
     dropout: float = 0.0  # residual-path (dropout1/dropout2) + inner-FFN
     # dropout; train-mode only (apply threads a key; eval passes none and
     # stays deterministic).  See block_epilogue for the site placement.
+    impl: str = "auto"  # "dense" | "flash" (Pallas) | "auto" (flash on
+    # TPU) - only governs the default attention; an injected ring/Ulysses
+    # callable (sequence-parallel strategies) takes precedence
 
     def __post_init__(self):
         if self.dim % self.num_heads != 0:
@@ -153,6 +156,16 @@ class AttentionClassifier:
         key for train-mode per-sublayer dropout."""
         t = x.shape[1]
         h = _linear(params["embed"], x) + params["pos"][:t]
+        if attention is None:
+            # lazy import keeps Pallas off the CPU/RNN-only startup path
+            # (the package convention - see ops/rnn.py:resolve_rnn_impl)
+            from pytorch_distributed_rnn_tpu.ops.pallas_attention import (
+                flash_attention,
+                resolve_attention_impl,
+            )
+
+            if resolve_attention_impl(self.impl) == "flash":
+                attention = lambda q, k, v: flash_attention(q, k, v)  # noqa: E731
         for i, blk in enumerate(params["blocks"]):
             blk_key = (None if dropout_key is None
                        else jax.random.fold_in(dropout_key, i))

@@ -9,6 +9,11 @@ from pytorch_distributed_rnn_tpu.ops.attention import (
     ring_attention,
     ulysses_attention,
 )
+
+# NOTE: the fused kernels (ops.pallas_attention.flash_attention /
+# ring_flash_attention, ops.pallas_rnn) are deliberately NOT re-exported
+# here - importing them pulls jax.experimental.pallas, which the CPU/RNN
+# startup path avoids; import from their modules directly.
 from pytorch_distributed_rnn_tpu.ops.rnn import (
     init_gru_layer,
     init_lstm_layer,

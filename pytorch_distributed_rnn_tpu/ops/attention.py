@@ -124,14 +124,18 @@ def ring_attention(q, k, v, axis: str, *, causal: bool = False):
     return (acc / l[..., None]).astype(q.dtype)
 
 
-def ulysses_attention(q, k, v, axis: str, *, causal: bool = False):
+def ulysses_attention(q, k, v, axis: str, *, causal: bool = False,
+                      attn=None):
     """All-to-all sequence parallelism (DeepSpeed-Ulysses style), for use
     inside ``shard_map``.
 
     Input is sequence-sharded (B, H, T/S, D); one all-to-all re-shards to
     head-sharded (B, H/S, T, D), attention runs locally over the full
     sequence for this shard's heads, and a second all-to-all restores
-    sequence sharding.  Requires ``H %% S == 0``.
+    sequence sharding.  Requires ``H %% S == 0``.  ``attn(q, k, v, *,
+    causal)`` overrides the local full attention (default dense
+    :func:`mha_attention`; pass the fused Pallas ``flash_attention`` for
+    the kernelized inner).
     """
     n = lax.axis_size(axis)
     if q.shape[1] % n != 0:
@@ -139,10 +143,11 @@ def ulysses_attention(q, k, v, axis: str, *, causal: bool = False):
             f"ulysses needs heads ({q.shape[1]}) divisible by the axis size"
             f" ({n})"
         )
+    attn = attn if attn is not None else mha_attention
     # split heads (axis 1) across shards, gather time (axis 2)
     to_heads = lambda x: lax.all_to_all(   # noqa: E731
         x, axis, split_axis=1, concat_axis=2, tiled=True)
     to_seq = lambda x: lax.all_to_all(     # noqa: E731
         x, axis, split_axis=2, concat_axis=1, tiled=True)
-    out = mha_attention(to_heads(q), to_heads(k), to_heads(v), causal=causal)
+    out = attn(to_heads(q), to_heads(k), to_heads(v), causal=causal)
     return to_seq(out)

@@ -61,12 +61,14 @@ def _row_slice(p, k, per):
 
 
 def tp_sp_block(blk, h, num_heads: int, *, sp_axis: str, tp_axis: str,
-                causal: bool = False):
+                causal: bool = False, impl: str = "dense"):
     """One encoder block with heads tp-sharded and time sp-sharded.
 
     ``h``: (B_local, T_local, dim).  QKV column-parallel -> ring attention
     over ``sp`` on this shard's head group -> wo row-parallel (one psum
-    over ``tp``) -> MLP column+row parallel (one more psum).
+    over ``tp``) -> MLP column+row parallel (one more psum).  ``impl``
+    picks the ring's inner step: ``dense`` XLA online-softmax or the
+    fused ``flash`` Pallas kernel.
     """
     ntp = lax.axis_size(tp_axis)
     ktp = lax.axis_index(tp_axis)
@@ -86,7 +88,14 @@ def tp_sp_block(blk, h, num_heads: int, *, sp_axis: str, tp_axis: str,
     k = split_heads(_linear(_col_slice(blk["wk"], ktp, per), y))
     v = split_heads(_linear(_col_slice(blk["wv"], ktp, per), y))
 
-    attn = ring_attention(q, k, v, sp_axis, causal=causal)
+    if impl == "flash":
+        from pytorch_distributed_rnn_tpu.ops.pallas_attention import (
+            ring_flash_attention,
+        )
+
+        attn = ring_flash_attention(q, k, v, sp_axis, causal=causal)
+    else:
+        attn = ring_attention(q, k, v, sp_axis, causal=causal)
     b, hl, t, _ = attn.shape
     merged = attn.transpose(0, 2, 1, 3).reshape(b, t, per)
 
@@ -105,7 +114,7 @@ def tp_sp_block(blk, h, num_heads: int, *, sp_axis: str, tp_axis: str,
 
 def attention_mesh_logits(params, x_local, num_heads: int, *,
                           sp_axis: str = "sp", tp_axis: str = "tp",
-                          causal: bool = False):
+                          causal: bool = False, impl: str = "dense"):
     """The composed sp x tp forward for an AttentionClassifier params
     tree, for use INSIDE a shard_map where both axes are bound (size 1 is
     fine).  ``x_local``: this shard's (B_local, T_local, in) chunk;
@@ -113,7 +122,7 @@ def attention_mesh_logits(params, x_local, num_heads: int, *,
     h = sp_embed_prologue(params, x_local, sp_axis)
     for blk in params["blocks"]:
         h = tp_sp_block(blk, h, num_heads, sp_axis=sp_axis,
-                        tp_axis=tp_axis, causal=causal)
+                        tp_axis=tp_axis, causal=causal, impl=impl)
     return _linear(params["head"], sp_mean_pool(h, sp_axis))
 
 
@@ -122,6 +131,11 @@ def make_3d_loss_fn(model, mesh, *, dp_axis: str = "dp", sp_axis: str = "sp",
     """Replicated-scalar loss for an AttentionClassifier over a
     (dp, sp, tp) mesh: ``loss(params, x, y)`` with ``x`` (B, T, in) sharded
     (dp, sp) and ``y`` (B,) sharded (dp)."""
+    from pytorch_distributed_rnn_tpu.ops.pallas_attention import (
+        resolve_attention_impl,
+    )
+
+    impl = resolve_attention_impl(getattr(model, "impl", "auto"))
 
     @partial(
         shard_map,
@@ -133,7 +147,7 @@ def make_3d_loss_fn(model, mesh, *, dp_axis: str = "dp", sp_axis: str = "sp",
     def loss_fn(params, x_local, y_local):
         logits = attention_mesh_logits(
             params, x_local, model.num_heads, sp_axis=sp_axis,
-            tp_axis=tp_axis, causal=causal,
+            tp_axis=tp_axis, causal=causal, impl=impl,
         )
         return lax.pmean(cross_entropy_loss(logits, y_local), dp_axis)
 

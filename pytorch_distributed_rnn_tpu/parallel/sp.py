@@ -431,7 +431,8 @@ def sp_mean_pool(h, axis: str):
 
 
 def make_sp_attention_forward(model, mesh, axis: str = "sp", *,
-                              method: str = "ring", causal: bool = False):
+                              method: str = "ring", causal: bool = False,
+                              impl: str | None = None):
     """Build a jitted sequence-parallel forward for an
     :class:`~pytorch_distributed_rnn_tpu.models.AttentionClassifier`.
 
@@ -439,16 +440,30 @@ def make_sp_attention_forward(model, mesh, axis: str = "sp", *,
     (embed, layernorm, QKV/output projections, MLP, residuals) runs locally
     on the chunk, and the attention core runs as ring attention (K/V blocks
     rotating via ppermute) or Ulysses all-to-all, selected by ``method``.
-    The global mean-pool is a local mean + ``pmean`` over the axis.
+    ``impl`` (default: the model's ``impl`` field) picks the ring's inner
+    step: ``dense`` XLA online-softmax or the fused ``flash`` Pallas
+    kernel (``ops/pallas_attention.py``); Ulysses runs its local full
+    attention through the same selection.  The global mean-pool is a
+    local mean + ``pmean`` over the axis.
     """
     from pytorch_distributed_rnn_tpu.models.attention import (
         _linear, apply_block)
     from pytorch_distributed_rnn_tpu.ops.attention import (
         ring_attention, ulysses_attention)
+    from pytorch_distributed_rnn_tpu.ops.pallas_attention import (
+        flash_attention, resolve_attention_impl, ring_flash_attention)
 
     if method not in ("ring", "ulysses"):
         raise ValueError(f"unknown sp attention method {method!r}")
-    attn_fn = ring_attention if method == "ring" else ulysses_attention
+    impl = resolve_attention_impl(impl if impl is not None
+                                  else getattr(model, "impl", "auto"))
+    if method == "ring":
+        attn_fn = (ring_flash_attention if impl == "flash"
+                   else ring_attention)
+    elif impl == "flash":
+        attn_fn = partial(ulysses_attention, attn=flash_attention)
+    else:
+        attn_fn = ulysses_attention
 
     @partial(
         shard_map,
