@@ -134,7 +134,8 @@ def _gpipe_schedule(axis: str, x_micro, run_stage, *, hop, out_tail,
 
 
 def pp_stacked_rnn(layers, x, axis: str, *, num_microbatches: int,
-                   unroll: int = 1, cell: str = "lstm"):
+                   unroll: int = 1, cell: str = "lstm",
+                   compute_dtype=None, remat: bool = False):
     """GPipe-scheduled stacked RNN (LSTM or GRU), for use inside
     ``shard_map`` over the ``pp`` axis (params and ``x`` (B, T, in)
     replicated per stage).
@@ -143,6 +144,11 @@ def pp_stacked_rnn(layers, x, axis: str, *, num_microbatches: int,
     evenly); the batch splits into ``num_microbatches``.  Returns the full
     (B, T, H) last-layer outputs, identical to
     :func:`~pytorch_distributed_rnn_tpu.ops.rnn.stacked_rnn`.
+    ``compute_dtype`` moves the stage matmuls AND the stage-to-stage hop
+    payloads (ppermute wire bytes) to e.g. bf16; ``lstm_step``/``gru_step``
+    keep the per-step carry f32 per their mixed-precision contract.
+    ``remat`` checkpoints each (stage, microbatch) tick - the classic
+    GPipe activation-recompute trade.
     """
     n = lax.axis_size(axis)
     L = len(layers)
@@ -171,6 +177,10 @@ def pp_stacked_rnn(layers, x, axis: str, *, num_microbatches: int,
 
     stacked = _stack_padded(layers, width, cell)
     x_micro = _pad_last(x, width).reshape(M, bm, t, width)
+    if compute_dtype is not None:
+        stacked = jax.tree.map(lambda p: p.astype(compute_dtype), stacked)
+        x_micro = x_micro.astype(compute_dtype)
+        dtype = compute_dtype
 
     def run_stage(stage, acts):
         for j in range(per_stage):
@@ -179,6 +189,9 @@ def pp_stacked_rnn(layers, x, axis: str, *, num_microbatches: int,
                               _pad_last(acts, width), unroll=unroll,
                               cell=cell)
         return acts
+
+    if remat:
+        run_stage = jax.checkpoint(run_stage)
 
     outs = _gpipe_schedule(
         axis, x_micro, run_stage,

@@ -116,12 +116,13 @@ def mesh_rnn_forward(params, x, *, sp=None, tp=None, pp=None,
     axes; logits (B_local, out) return replicated over the model axes (so
     the caller's dp-only loss/metric collectives stay correct).
 
-    ``compute_dtype``/``remat``/``dropout`` apply on the unsharded and
-    ``sp`` branches (the relay stacks thread them; the head stays f32
-    like ``MotionModel.apply``; each sp shard folds its index into the
-    dropout key for an independent mask over its local positions); the
-    tp/pp stacks are f32-structured and the callers reject those
-    combinations loudly.
+    ``compute_dtype``/``remat`` thread through EVERY model-axis branch
+    (sp relay, tp gate-sharded, pp GPipe stages, unsharded) - the head
+    stays f32 like ``MotionModel.apply``.  ``dropout`` applies on the
+    unsharded and ``sp`` branches only (each sp shard folds its index
+    into the dropout key for an independent mask over its local
+    positions); the tp/pp stacks have no dropout seam and the callers
+    reject that combination loudly.
     """
     if sum(a is not None for a in (sp, tp, pp)) > 1:
         raise ValueError("compose dp with at most one of sp/tp/pp")
@@ -148,15 +149,20 @@ def mesh_rnn_forward(params, x, *, sp=None, tp=None, pp=None,
 
     if tp is not None:
         stack = tp_stacked_gru if cell == "gru" else tp_stacked_lstm
-        out, _ = stack(params["rnn"], x, tp, unroll=unroll)
-        return row_parallel_head(params["fc"], out[:, -1, :], tp)
+        out, _ = stack(params["rnn"], x, tp, unroll=unroll,
+                       compute_dtype=compute_dtype, remat=remat)
+        # head in f32 (model contract); no-op in pure f32
+        return row_parallel_head(
+            params["fc"], out[:, -1, :].astype(jnp.float32), tp
+        )
 
     if pp is not None:
         out = pp_stacked_rnn(
             params["rnn"], x, pp, num_microbatches=num_microbatches,
-            unroll=unroll, cell=cell,
+            unroll=unroll, cell=cell, compute_dtype=compute_dtype,
+            remat=remat,
         )
-        last = out[:, -1, :]
+        last = out[:, -1, :].astype(jnp.float32)
         return last @ params["fc"]["weight"].T + params["fc"]["bias"]
 
     from pytorch_distributed_rnn_tpu.ops.rnn import stacked_rnn
@@ -188,11 +194,12 @@ def _char_local_logits(params, tokens, *, sp=None, tp=None, pp=None,
     position (the final global position predicts nothing); the shifted
     target slice is local arithmetic because tokens are replicated, so no
     boundary exchange is needed.  Without ``sp``: full-window logits
-    (B, T-1, V), ``w_pos`` None.  ``compute_dtype``/``remat``/``dropout``
-    thread through the unsharded AND ``sp`` branches (the relay stacks
-    take the same levers; the head stays f32; each sp shard folds its
-    index into the dropout key); the tp/pp stacks are f32-structured -
-    callers reject those combinations loudly.
+    (B, T-1, V), ``w_pos`` None.  ``compute_dtype``/``remat`` thread
+    through EVERY model-axis branch (sp relay, tp gate-sharded, pp GPipe
+    stages, unsharded); the head stays f32.  ``dropout`` applies on the
+    unsharded and ``sp`` branches only (each sp shard folds its index
+    into the dropout key); the tp/pp stacks have no dropout seam -
+    callers reject that combination loudly.
     """
     if sum(a is not None for a in (sp, tp, pp)) > 1:
         raise ValueError("compose dp with at most one of sp/tp/pp")
@@ -233,8 +240,10 @@ def _char_local_logits(params, tokens, *, sp=None, tp=None, pp=None,
     x = params["embed"][tokens[:, :-1]]
     if tp is not None:
         stack = tp_stacked_gru if cell == "gru" else tp_stacked_lstm
-        out, _ = stack(params["rnn"], x, tp, unroll=unroll)
-        # row-parallel per-timestep head: shard the hidden dim, one psum
+        out, _ = stack(params["rnn"], x, tp, unroll=unroll,
+                       compute_dtype=compute_dtype, remat=remat)
+        # row-parallel per-timestep head: shard the hidden dim, one psum;
+        # head in f32 like every other branch (casts are f32 no-ops)
         ntp = lax.axis_size(tp)
         ktp = lax.axis_index(tp)
         hidden = head_w.shape[1]
@@ -244,14 +253,16 @@ def _char_local_logits(params, tokens, *, sp=None, tp=None, pp=None,
         w_local = lax.dynamic_slice_in_dim(head_w, ktp * per, per, axis=1)
         h_local = lax.dynamic_slice_in_dim(out, ktp * per, per, axis=2)
         logits = lax.psum(
-            jnp.einsum("bth,vh->btv", h_local, w_local), tp
+            jnp.einsum("bth,vh->btv", h_local.astype(jnp.float32),
+                       w_local), tp
         ) + head_b
     elif pp is not None:
         out = pp_stacked_rnn(
             params["rnn"], x, pp, num_microbatches=num_microbatches,
-            unroll=unroll, cell=cell,
+            unroll=unroll, cell=cell, compute_dtype=compute_dtype,
+            remat=remat,
         )
-        logits = out @ head_w.T + head_b
+        logits = out.astype(jnp.float32) @ head_w.T + head_b
     else:
         from pytorch_distributed_rnn_tpu.ops.rnn import stacked_rnn
 
@@ -303,20 +314,15 @@ def _reject_unsupported_mesh_levers(model_axis, precision: str,
                                     schedule: str = "wavefront",
                                     cell: str = "lstm",
                                     num_layers: int | None = None):
-    """Loud, never silent: bf16 + remat + dropout all thread through the
-    sp relay stacks (the long-context flagship composition: bf16/remat
-    since r2's VERDICT item 3, dropout since r3) and the unsharded
-    branch - but sp dropout needs the SEQUENTIAL relay (the wavefront
-    interleaves all layers in one scan, leaving no between-layer seam to
-    mask at; GRU always relays sequentially), and the tp/pp stacks are
-    f32-structured with no dropout seam at all.  Honoring those flag
-    combinations is not possible, so do not pretend to."""
-    if model_axis in ("tp", "pp") and (precision != "f32" or remat):
-        raise ValueError(
-            f"precision=bf16/remat are not supported on the {model_axis} "
-            f"mesh (f32-structured stage/gate kernels) - use a dp or "
-            f"dp x sp mesh, or drop the flag"
-        )
+    """Loud, never silent: bf16 + remat thread through EVERY model axis
+    (sp relay since r2, tp gate-sharded + pp GPipe stages since r4) and
+    dropout through the unsharded and sp branches - but sp dropout needs
+    the SEQUENTIAL relay (the wavefront interleaves all layers in one
+    scan, leaving no between-layer seam to mask at; GRU always relays
+    sequentially), and the tp/pp stacks have no dropout seam at all.
+    Honoring those flag combinations is not possible, so do not pretend
+    to."""
+    del precision, remat  # every model axis honors both since r4
     if model_axis in ("tp", "pp") and dropout > 0.0:
         raise ValueError(
             f"dropout is not supported on the {model_axis} mesh (the "
@@ -493,8 +499,8 @@ def make_motion_mesh_loss_fn(mesh, axes: dict[str, int], *,
     ``dropout > 0`` (dp-only meshes; the trainer guards the model axes)
     appends a trailing replicated per-step PRNG key argument; each dp
     shard folds its rank in for an independent mask.  ``precision``/
-    ``remat`` thread through the unsharded and sp branches exactly like
-    the char mesh (tp/pp reject loudly)."""
+    ``remat`` thread through every model-axis branch exactly like the
+    char mesh."""
     kw = _axis_kwargs(axes, cell)
     model_axis = next((a for a, v in kw.items() if v is not None), None)
     _reject_unsupported_mesh_levers(model_axis, precision, remat, dropout,
@@ -575,6 +581,11 @@ def make_attention_mesh_loss_fn(model, mesh, *, weighted: bool = False):
     from pytorch_distributed_rnn_tpu.parallel.combined import (
         attention_mesh_logits,
     )
+    from pytorch_distributed_rnn_tpu.ops.pallas_attention import (
+        resolve_attention_impl,
+    )
+
+    impl = resolve_attention_impl(getattr(model, "impl", "auto"))
 
     for axis in ("dp", "sp", "tp"):
         if axis not in mesh.shape:
@@ -595,7 +606,8 @@ def make_attention_mesh_loss_fn(model, mesh, *, weighted: bool = False):
         check_vma=False,
     )
     def loss_fn(params, x_local, y_local, *w):
-        logits = attention_mesh_logits(params, x_local, model.num_heads)
+        logits = attention_mesh_logits(params, x_local, model.num_heads,
+                                       impl=impl)
         local, correct = _classifier_loss_metrics(
             logits, y_local, w[0] if weighted else None
         )

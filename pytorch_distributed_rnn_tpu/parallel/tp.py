@@ -59,20 +59,32 @@ def shard_gates(w, n: int, k, num_gates: int = 4):
     return sliced.reshape(num_gates * per, *w.shape[1:])
 
 
-def tp_lstm_layer(params, x, axis: str, *, unroll: int = 1):
+def _cast_local(local, x, compute_dtype):
+    """Move the sliced weights + input to ``compute_dtype`` (bf16 matmuls
+    at full MXU rate, half the collective bytes); None = stay as-is."""
+    if compute_dtype is None:
+        return local, x
+    local = {k: v.astype(compute_dtype) for k, v in local.items()}
+    return local, x.astype(compute_dtype)
+
+
+def tp_lstm_layer(params, x, axis: str, *, unroll: int = 1,
+                  compute_dtype=None):
     """One LSTM layer with the hidden dimension sharded over ``axis``, for
     use inside ``shard_map`` (params replicated, ``x`` (B, T, in) full).
 
     Returns ``(outputs (B, T, H) full-width, (h_T, c_T) full-width)`` -
     outputs are all-gathered so stacking composes; the per-step state stays
-    sharded inside the scan.
+    sharded inside the scan.  Mixed-precision contract as
+    :func:`~pytorch_distributed_rnn_tpu.ops.rnn.lstm_step`: the sharded
+    carry stays f32, matmuls (and the per-step all-gather's wire bytes)
+    run in ``compute_dtype``, emitted outputs follow it.
     """
     n = lax.axis_size(axis)
     k = lax.axis_index(axis)
     hidden = params["w_hh"].shape[1]
     per = hidden // n
     batch = x.shape[0]
-    dtype = x.dtype
 
     local = {
         "w_ih": shard_gates(params["w_ih"], n, k),
@@ -80,23 +92,27 @@ def tp_lstm_layer(params, x, axis: str, *, unroll: int = 1):
         "b_ih": shard_gates(params["b_ih"], n, k),
         "b_hh": shard_gates(params["b_hh"], n, k),
     }
+    local, x = _cast_local(local, x, compute_dtype)
     x_proj = lstm_input_proj(local, x)               # (B, T, 4H/n)
     w_hh_l_t = local["w_hh"].T                       # (H, 4H/n)
 
     def step(carry, xp_t):
-        h_local, c_local = carry
-        # the one per-step collective: reassemble full h for the recurrence
-        h_full = lax.all_gather(h_local, axis, axis=1, tiled=True)
-        gates = xp_t + h_full @ w_hh_l_t             # (B, 4H/n)
+        h_local, c_local = carry                     # f32 slices
+        # the one per-step collective: reassemble full h for the
+        # recurrence - gathered in the compute dtype (half the ICI
+        # bytes under bf16)
+        h_full = lax.all_gather(h_local.astype(xp_t.dtype), axis,
+                                axis=1, tiled=True)
+        gates = (xp_t + h_full @ w_hh_l_t).astype(jnp.float32)
         i, f, g, o = jnp.split(gates, 4, axis=-1)
         c_local = jax.nn.sigmoid(f) * c_local + (
             jax.nn.sigmoid(i) * jnp.tanh(g)
         )
         h_local = jax.nn.sigmoid(o) * jnp.tanh(c_local)
-        return (h_local, c_local), h_local
+        return (h_local, c_local), h_local.astype(xp_t.dtype)
 
-    h0 = jnp.zeros((batch, per), dtype)
-    c0 = jnp.zeros((batch, per), dtype)
+    h0 = jnp.zeros((batch, per), jnp.float32)
+    c0 = jnp.zeros((batch, per), jnp.float32)
     (h_t, c_t), out_local = lax.scan(
         step, (h0, c0), jnp.swapaxes(x_proj, 0, 1), unroll=unroll
     )
@@ -107,17 +123,25 @@ def tp_lstm_layer(params, x, axis: str, *, unroll: int = 1):
     return outputs, (h_t, c_t)
 
 
-def tp_stacked_lstm(layers, x, axis: str, *, unroll: int = 1):
-    """Stack of :func:`tp_lstm_layer`; returns (outputs, [finals])."""
+def tp_stacked_lstm(layers, x, axis: str, *, unroll: int = 1,
+                    compute_dtype=None, remat: bool = False):
+    """Stack of :func:`tp_lstm_layer`; returns (outputs, [finals]).
+    ``remat`` checkpoints each layer (recompute activations - including
+    the per-step all-gathers - during backward)."""
+    layer_fn = partial(tp_lstm_layer, axis=axis, unroll=unroll,
+                       compute_dtype=compute_dtype)
+    if remat:
+        layer_fn = jax.checkpoint(layer_fn)
     finals = []
     out = x
     for layer in layers:
-        out, final = tp_lstm_layer(layer, out, axis, unroll=unroll)
+        out, final = layer_fn(layer, out)
         finals.append(final)
     return out, finals
 
 
-def tp_gru_layer(params, x, axis: str, *, unroll: int = 1):
+def tp_gru_layer(params, x, axis: str, *, unroll: int = 1,
+                 compute_dtype=None):
     """One GRU layer with the hidden dimension sharded over ``axis``.
 
     Same layout as :func:`tp_lstm_layer` with 3 gates (r, z, n): each
@@ -125,14 +149,15 @@ def tp_gru_layer(params, x, axis: str, *, unroll: int = 1):
     all-gathered full ``h`` (the one per-step collective), and emits its
     H/n slice of the new state.  torch semantics preserved: the
     hidden-side n-bias joins inside the ``r *`` product, sliced like the
-    weights.
+    weights.  Mixed-precision contract as
+    :func:`~pytorch_distributed_rnn_tpu.ops.rnn.gru_step`: f32 carry,
+    compute-dtype matmuls and collective bytes.
     """
     n = lax.axis_size(axis)
     k = lax.axis_index(axis)
     hidden = params["w_hh"].shape[1]
     per = hidden // n
     batch = x.shape[0]
-    dtype = x.dtype
 
     local = {
         "w_ih": shard_gates(params["w_ih"], n, k, num_gates=3),
@@ -140,22 +165,25 @@ def tp_gru_layer(params, x, axis: str, *, unroll: int = 1):
         "b_ih": shard_gates(params["b_ih"], n, k, num_gates=3),
         "b_hh": shard_gates(params["b_hh"], n, k, num_gates=3),
     }
+    local, x = _cast_local(local, x, compute_dtype)
     x_proj = gru_input_proj(local, x)                # (B, T, 3H/n)
     w_hh_l_t = local["w_hh"].T                       # (H, 3H/n)
     b_hh_l = local["b_hh"]
 
     def step(h_local, xp_t):
-        h_full = lax.all_gather(h_local, axis, axis=1, tiled=True)
-        h_proj = h_full @ w_hh_l_t + b_hh_l          # (B, 3H/n)
-        xr, xz, xn = jnp.split(xp_t, 3, axis=-1)
+        # f32 carry; the gather and hidden matmul run in compute dtype
+        h_full = lax.all_gather(h_local.astype(xp_t.dtype), axis,
+                                axis=1, tiled=True)
+        h_proj = (h_full @ w_hh_l_t + b_hh_l).astype(jnp.float32)
+        xr, xz, xn = jnp.split(xp_t.astype(jnp.float32), 3, axis=-1)
         hr, hz, hn = jnp.split(h_proj, 3, axis=-1)
         r = jax.nn.sigmoid(xr + hr)
         z = jax.nn.sigmoid(xz + hz)
         new = jnp.tanh(xn + r * hn)
         h_local = (1.0 - z) * new + z * h_local
-        return h_local, h_local
+        return h_local, h_local.astype(xp_t.dtype)
 
-    h0 = jnp.zeros((batch, per), dtype)
+    h0 = jnp.zeros((batch, per), jnp.float32)
     h_t, out_local = lax.scan(
         step, h0, jnp.swapaxes(x_proj, 0, 1), unroll=unroll
     )
@@ -165,12 +193,17 @@ def tp_gru_layer(params, x, axis: str, *, unroll: int = 1):
     return outputs, h_t
 
 
-def tp_stacked_gru(layers, x, axis: str, *, unroll: int = 1):
+def tp_stacked_gru(layers, x, axis: str, *, unroll: int = 1,
+                   compute_dtype=None, remat: bool = False):
     """Stack of :func:`tp_gru_layer`; returns (outputs, [finals])."""
+    layer_fn = partial(tp_gru_layer, axis=axis, unroll=unroll,
+                       compute_dtype=compute_dtype)
+    if remat:
+        layer_fn = jax.checkpoint(layer_fn)
     finals = []
     out = x
     for layer in layers:
-        out, final = tp_gru_layer(layer, out, axis, unroll=unroll)
+        out, final = layer_fn(layer, out)
         finals.append(final)
     return out, finals
 

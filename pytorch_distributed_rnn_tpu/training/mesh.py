@@ -130,19 +130,10 @@ class MeshTrainer(SpmdTrainer):
                     f"divisible by sp={sp_size} - pick --seq-length so "
                     f"that sp divides seq_length + 1"
                 )
-        if self.model_axis in ("tp", "pp") and (
-            getattr(model, "precision", "f32") != "f32"
-            or getattr(model, "remat", False)
-        ):
-            # fail at construction, not at the first train step; bf16 +
-            # remat DO thread through dp and dp x sp meshes (the sp relay
-            # stacks take the same levers as the unsharded stack - the
-            # long-context + mixed-precision flagship composition)
-            raise ValueError(
-                "--precision bf16/--remat are not supported on tp/pp "
-                "meshes (f32-structured stage/gate kernels) - use a dp or "
-                "dp x sp mesh, or drop the flag"
-            )
+        # bf16 + remat thread through EVERY model axis since r4 (the tp
+        # gate-sharded and pp GPipe stacks take the same levers as the
+        # sp relay: compute-dtype matmuls/collective bytes, f32 carries,
+        # per-layer/per-tick checkpointing) - no tp/pp precision reject.
         if self._dropout > 0.0 and self.model_axis in ("tp", "pp"):
             raise NotImplementedError(
                 "dropout is not supported on tp/pp mesh strategies (no "

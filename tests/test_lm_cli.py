@@ -164,7 +164,7 @@ class TestCharMesh:
     """--model char under the mesh strategy: the LM trains on composed
     dp x {sp,tp} meshes with the same CLI surface as motion/attention."""
 
-    def _cli(self, tmp_path, mesh_spec, extra=()):
+    def _cli(self, tmp_path, mesh_spec, extra=(), mesh_extra=()):
         from pytorch_distributed_rnn_tpu.main import main
 
         corpus = tmp_path / "corpus.txt"
@@ -179,7 +179,7 @@ class TestCharMesh:
             "--dropout", "0",
             "--model", "char", "--seq-length", "31", "--no-validation",
             *extra,
-            "mesh", "--mesh", mesh_spec,
+            "mesh", "--mesh", mesh_spec, *mesh_extra,
         ])
         return json.loads((tmp_path / "history.json").read_text())
 
@@ -225,21 +225,42 @@ class TestCharMesh:
                 "--no-validation", "mesh", "--mesh", "dp=2,sp=2",
             ])
 
-    def test_mesh_char_bf16_rejected_on_tp(self, tmp_path):
-        """tp stays f32-structured; bf16 there is a loud reject (sp now
-        threads it - see test_mesh_char_sp_bf16_close_to_dp_bf16)."""
-        from pytorch_distributed_rnn_tpu.main import main
+    def test_mesh_char_tp_bf16_close_to_dp_bf16(self, tmp_path,
+                                                monkeypatch):
+        """bf16 threads through the tp gate-sharded stack since r4
+        (VERDICT round-3 item 4): a dp x tp bf16 char mesh reproduces the
+        dp-only bf16 loss history to bf16 tolerance (the gate shards
+        reorder the same bf16 matmuls)."""
+        monkeypatch.chdir(tmp_path)
+        tp_hist = self._cli(
+            tmp_path, "dp=2,tp=2", extra=("--precision", "bf16")
+        )["train_history"]
+        (tmp_path / "history.json").unlink()
+        dp_hist = self._cli(
+            tmp_path, "dp=4", extra=("--precision", "bf16")
+        )["train_history"]
+        assert tp_hist[-1] < tp_hist[0]
+        assert tp_hist == pytest.approx(dp_hist, rel=5e-2)
 
-        corpus = tmp_path / "corpus.txt"
-        corpus.write_bytes(bytes(range(256)) * 48)
-        with pytest.raises(ValueError, match="bf16"):
-            main([
-                "--dataset-path", str(tmp_path), "--epochs", "1",
-                "--batch-size", "64", "--dropout", "0",
-                "--precision", "bf16",
-                "--model", "char", "--seq-length", "31",
-                "--no-validation", "mesh", "--mesh", "dp=2,tp=2",
-            ])
+    def test_mesh_char_pp_bf16_remat_close_to_dp_bf16(self, tmp_path,
+                                                      monkeypatch):
+        """The pp equivalent of the tp test above, with --remat composed
+        in: GPipe stages run bf16 stage matmuls + hop payloads with
+        per-tick recompute and still track the dp-only bf16 history."""
+        monkeypatch.chdir(tmp_path)
+        # the trailing partial batch (308 % 64 = 52 -> 26 per dp shard)
+        # must divide into the microbatches; 26 % 2 == 0
+        pp_hist = self._cli(
+            tmp_path, "dp=2,pp=2",
+            extra=("--precision", "bf16", "--remat"),
+            mesh_extra=("--num-microbatches", "2"),
+        )["train_history"]
+        (tmp_path / "history.json").unlink()
+        dp_hist = self._cli(
+            tmp_path, "dp=4", extra=("--precision", "bf16")
+        )["train_history"]
+        assert pp_hist[-1] < pp_hist[0]
+        assert pp_hist == pytest.approx(dp_hist, rel=5e-2)
 
     def test_mesh_char_bf16_trains_on_dp_only(self, tmp_path, monkeypatch):
         monkeypatch.chdir(tmp_path)

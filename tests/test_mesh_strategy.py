@@ -105,6 +105,32 @@ class TestMeshTrainerEquivalence:
             leaves_sum(ref_params), rel=1e-5
         )
 
+    @pytest.mark.parametrize("axes", [
+        {"dp": 2, "tp": 2},
+        {"dp": 2, "pp": 2},
+    ], ids=["bf16_dp_tp", "bf16_dp_pp"])
+    def test_motion_bf16_remat_on_tp_pp_tracks_dp(self, datasets, axes):
+        """bf16 + remat thread through the tp/pp motion meshes (r4): the
+        loss history tracks a dp-only bf16 run to bf16 tolerance."""
+        def model():
+            return MotionModel(input_dim=9, hidden_dim=8, layer_dim=2,
+                               output_dim=6, impl="scan",
+                               precision="bf16", remat=True)
+
+        ref = DDPTrainer(
+            model=model(), training_set=datasets, batch_size=24,
+            learning_rate=2.5e-3, seed=SEED,
+            mesh=make_mesh({"dp": 2}, devices=jax.devices()[:2]),
+        )
+        _, ref_history, _ = ref.train(epochs=2)
+        trainer = MeshTrainer(
+            mesh_axes=axes, model=model(), training_set=datasets,
+            batch_size=24, learning_rate=2.5e-3, seed=SEED,
+        )
+        _, history, _ = trainer.train(epochs=2)
+        assert history[-1] < history[0]
+        assert history == pytest.approx(ref_history, rel=5e-2)
+
     def test_sequential_sp_schedule_matches_too(self, datasets,
                                                 ddp_reference):
         ref_params, ref_history = ddp_reference

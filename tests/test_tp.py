@@ -100,3 +100,76 @@ def test_tp_hidden_not_divisible_raises():
 
     with pytest.raises(ValueError, match="not divisible"):
         jax.jit(run)(params[0], x)
+
+
+class TestTpLevers:
+    """bf16 + remat on the gate-sharded stacks (r4: the tp axis takes the
+    same levers as sp - compute-dtype matmuls/collective bytes, f32
+    carries, per-layer checkpointing)."""
+
+    def _tp_outputs(self, cell, **levers):
+        from pytorch_distributed_rnn_tpu.parallel.tp import (
+            tp_stacked_gru,
+            tp_stacked_lstm,
+        )
+
+        mesh = make_mesh({"tp": 4})
+        params = init_stacked_rnn(jax.random.PRNGKey(0), IN, H, 2,
+                                  cell=cell)
+        x = jax.random.normal(jax.random.PRNGKey(1), (B, T, IN))
+        stack = tp_stacked_gru if cell == "gru" else tp_stacked_lstm
+
+        @partial(shard_map, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+                 check_vma=False)
+        def run(p, x):
+            out, _ = stack(p, x, "tp", **levers)
+            return out.astype(jnp.float32)
+
+        return jax.jit(run)(params, x), params, x
+
+    @pytest.mark.parametrize("cell", ["lstm", "gru"])
+    def test_bf16_tracks_unsharded_bf16(self, cell):
+        out_tp, params, x = self._tp_outputs(
+            cell, compute_dtype=jnp.bfloat16
+        )
+        out_ref, _ = stacked_rnn(params, x, cell, impl="scan",
+                                 compute_dtype=jnp.bfloat16)
+        np.testing.assert_allclose(
+            np.asarray(out_tp), np.asarray(out_ref, np.float32),
+            rtol=3e-2, atol=3e-2,
+        )
+
+    @pytest.mark.parametrize("cell", ["lstm", "gru"])
+    def test_remat_is_exact(self, cell):
+        """remat recomputes the same program: outputs and grads match the
+        non-remat tp stack bit-for-tolerance."""
+        from pytorch_distributed_rnn_tpu.parallel.tp import (
+            tp_stacked_gru,
+            tp_stacked_lstm,
+        )
+
+        mesh = make_mesh({"tp": 4})
+        params = init_stacked_rnn(jax.random.PRNGKey(2), IN, H, 2,
+                                  cell=cell)
+        x = jax.random.normal(jax.random.PRNGKey(3), (B, T, IN))
+        stack = tp_stacked_gru if cell == "gru" else tp_stacked_lstm
+
+        def loss(p, x, remat):
+            @partial(shard_map, mesh=mesh, in_specs=(P(), P()),
+                     out_specs=P(), check_vma=False)
+            def run(p, x):
+                out, _ = stack(p, x, "tp", remat=remat)
+                return jnp.sum(out.astype(jnp.float32) ** 2)
+
+            return run(p, x)
+
+        l0, g0 = jax.jit(
+            jax.value_and_grad(lambda p: loss(p, x, False))
+        )(params)
+        l1, g1 = jax.jit(
+            jax.value_and_grad(lambda p: loss(p, x, True))
+        )(params)
+        np.testing.assert_allclose(float(l1), float(l0), rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=1e-5, atol=1e-6)
