@@ -270,7 +270,8 @@ def _char_sp_program(dp: int, sp: int):
     return jax.jit(step), (params, state, batch), params
 
 
-def _motion_pp_program(dp: int, pp: int):
+def _motion_pp_program(dp: int, pp: int, schedule: str = "gpipe",
+                       num_microbatches: int = 2):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -281,6 +282,7 @@ def _motion_pp_program(dp: int, pp: int):
     from pytorch_distributed_rnn_tpu.parallel.strategy import (
         make_mesh_grad_step,
         make_motion_mesh_loss_fn,
+        make_motion_pp_1f1b_loss_fn,
     )
 
     axes = {"dp": dp, "pp": pp}
@@ -290,13 +292,18 @@ def _motion_pp_program(dp: int, pp: int):
     params = model.init(jax.random.PRNGKey(6))
     opt = optax.adam(1e-3)
     state = opt.init(params)
-    step = make_mesh_grad_step(
-        make_motion_mesh_loss_fn(mesh, axes, num_microbatches=2), opt
-    )
+    if schedule == "1f1b":
+        loss_fn = make_motion_pp_1f1b_loss_fn(
+            mesh, axes, num_microbatches=num_microbatches)
+    else:
+        loss_fn = make_motion_mesh_loss_fn(
+            mesh, axes, num_microbatches=num_microbatches)
+    step = make_mesh_grad_step(loss_fn, opt)
     rng = np.random.RandomState(0)
+    bsz = 2 * num_microbatches * dp
     batch = (
-        jnp.asarray(rng.randn(4 * dp, 16, 9).astype(np.float32)),
-        jnp.asarray(rng.randint(0, 6, size=4 * dp)),
+        jnp.asarray(rng.randn(bsz, 16, 9).astype(np.float32)),
+        jnp.asarray(rng.randint(0, 6, size=bsz)),
     )
     return jax.jit(step), (params, state, batch), params
 
@@ -348,18 +355,26 @@ def report_programs(n_devices: int = 8) -> list[dict]:
             f"collective-report needs a multiple of 4 devices (the sp/ep "
             f"rows factor the mesh as dp x 4), got {n_devices}"
         )
+    from pytorch_distributed_rnn_tpu.parallel.pp import pp_schedule_stats
+
     rows = []
-    for name, build in (
+    for name, build, extra in (
         (f"motion dp={n_devices} (DDP grad psum)",
-         lambda: _motion_dp_program(n_devices)),
+         lambda: _motion_dp_program(n_devices), None),
         (f"char fsdp dp={n_devices} (ZeRO gather/scatter)",
-         lambda: _fsdp_program(n_devices)),
+         lambda: _fsdp_program(n_devices), None),
         (f"char mesh dp={n_devices // 4},sp=4 (relay ppermute)",
-         lambda: _char_sp_program(n_devices // 4, 4)),
+         lambda: _char_sp_program(n_devices // 4, 4), None),
         (f"moe mesh dp={n_devices // 4},ep=4 (all_to_all dispatch)",
-         lambda: _moe_ep_program(n_devices // 4, 4)),
+         lambda: _moe_ep_program(n_devices // 4, 4), None),
         (f"motion mesh dp={n_devices // 2},pp=2 (GPipe stage ppermute)",
-         lambda: _motion_pp_program(n_devices // 2, 2)),
+         lambda: _motion_pp_program(n_devices // 2, 2),
+         {"schedule": [pp_schedule_stats(2, m, "gpipe")
+                       for m in (2, 4, 8)]}),
+        (f"motion mesh dp={n_devices // 2},pp=2 (1F1B self-scheduled)",
+         lambda: _motion_pp_program(n_devices // 2, 2, schedule="1f1b"),
+         {"schedule": [pp_schedule_stats(2, m, "1f1b")
+                       for m in (2, 4, 8)]}),
     ):
         fn, call_args, params = build()
         # Two complementary views, each honest about its blind spot:
@@ -377,4 +392,9 @@ def report_programs(n_devices: int = 8) -> list[dict]:
             "traced": trace_collective_stats(fn, *call_args),
             "compiled": collective_stats(compiled_text(fn, *call_args)),
         })
+        if extra:
+            # pp rows carry the schedule timetable accounting: ticks,
+            # busy/idle stage-slots and the bubble fraction per
+            # microbatch count (idle shrinks as M grows)
+            rows[-1].update(extra)
     return rows

@@ -252,6 +252,333 @@ def pp_transformer_blocks(blocks, h, axis: str, *, num_heads: int,
     return outs.reshape(batch, t, d)
 
 
+# ---------------------------------------------------------------------------
+# 1F1B (PipeDream-flush) schedule
+# ---------------------------------------------------------------------------
+
+
+def simulate_1f1b_schedule(num_stages: int, num_microbatches: int):
+    """Greedy event simulation of the non-interleaved 1F1B timetable.
+
+    Each stage performs ONE op per tick - forward or backward of one
+    microbatch - under the real dataflow constraints: a forward needs the
+    upstream activation to have arrived (capacity-1 buffer, so the sender
+    also waits until the receiver has consumed the previous one), a
+    backward needs the downstream cotangent, and a stage may run at most
+    ``num_stages - stage`` forwards ahead of its backwards (the 1F1B
+    in-flight bound).  Backward is preferred when both are ready - that
+    preference is what turns GPipe's fill-drain into the 1F1B rhythm.
+
+    Returns ``(fwd_sched, bwd_sched)`` as (ticks, stages) numpy arrays of
+    microbatch ids (-1 = idle slot for that op kind).
+    """
+    import numpy as np
+
+    S, M = num_stages, num_microbatches
+    next_f = [0] * S
+    next_b = [0] * S
+    f_done = [[-1] * M for _ in range(S)]
+    b_done = [[-1] * M for _ in range(S)]
+    # fwd_buf[s] = microbatch whose activation sits unconsumed at stage s
+    fwd_buf = [-1] * S
+    bwd_buf = [-1] * S
+    fwd_sched, bwd_sched = [], []
+    t = 0
+    while any(nb < M for nb in next_b):
+        if t > 4 * (M + S):  # safety: the greedy schedule must terminate
+            raise RuntimeError("1f1b schedule simulation did not converge")
+        frow, brow = [-1] * S, [-1] * S
+        consumed_f, consumed_b, sent_f, sent_b = [], [], [], []
+        for s in range(S):
+            mb = next_b[s]
+            bwd_ready = (
+                mb < M
+                and 0 <= f_done[s][mb] < t
+                and (s == S - 1 or (0 <= b_done[s + 1][mb] < t
+                                    and bwd_buf[s] == mb))
+                and (s == 0 or bwd_buf[s - 1] == -1)  # room to send dacts
+            )
+            mf = next_f[s]
+            fwd_ready = (
+                mf < M
+                and (s == 0 or (0 <= f_done[s - 1][mf] < t
+                                and fwd_buf[s] == mf))
+                and (s == S - 1 or fwd_buf[s + 1] == -1)  # room to send
+                and next_f[s] - next_b[s] < S - s  # 1F1B in-flight bound
+            )
+            if bwd_ready:
+                brow[s] = mb
+                b_done[s][mb] = t
+                next_b[s] += 1
+                if s > 0:
+                    sent_b.append((s - 1, mb))
+                if s < S - 1:
+                    consumed_b.append(s)
+            elif fwd_ready:
+                frow[s] = mf
+                f_done[s][mf] = t
+                next_f[s] += 1
+                if s < S - 1:
+                    sent_f.append((s + 1, mf))
+                if s > 0:
+                    consumed_f.append(s)
+        for s in consumed_f:
+            fwd_buf[s] = -1
+        for s in consumed_b:
+            bwd_buf[s] = -1
+        for s, m in sent_f:
+            assert fwd_buf[s] == -1, "activation buffer overwrite"
+            fwd_buf[s] = m
+        for s, m in sent_b:
+            assert bwd_buf[s] == -1, "cotangent buffer overwrite"
+            bwd_buf[s] = m
+        fwd_sched.append(frow)
+        bwd_sched.append(brow)
+        t += 1
+    return np.asarray(fwd_sched), np.asarray(bwd_sched)
+
+
+def pp_schedule_stats(num_stages: int, num_microbatches: int,
+                      schedule: str = "gpipe") -> dict:
+    """Tick/bubble accounting for a pipeline schedule.
+
+    ``gpipe``: the forward fill-drain loop (M + S - 1 ticks; its backward
+    is XLA's transpose with the mirrored bubble).  ``1f1b``: ticks and
+    idle slots measured from the simulated timetable (one F or B op per
+    stage per tick).  ``bubble_fraction`` = idle stage-ticks / total
+    stage-ticks.
+    """
+    S, M = num_stages, num_microbatches
+    if schedule == "gpipe":
+        ticks = M + S - 1
+        busy = S * M
+    elif schedule == "1f1b":
+        fwd, bwd = simulate_1f1b_schedule(S, M)
+        ticks = fwd.shape[0]
+        busy = int((fwd >= 0).sum() + (bwd >= 0).sum())
+    else:
+        raise ValueError(f"unknown pp schedule {schedule!r}")
+    total = S * ticks
+    return {
+        "schedule": schedule,
+        "stages": S,
+        "microbatches": M,
+        "ticks": ticks,
+        "busy_slots": busy,
+        "idle_slots": total - busy,
+        "bubble_fraction": round((total - busy) / total, 4),
+    }
+
+
+def pp_rnn_1f1b_value_and_grad(layers, head, x, y, axis: str, *,
+                               num_microbatches: int, unroll: int = 1,
+                               cell: str = "lstm", compute_dtype=None,
+                               sample_weights=None):
+    """Self-differentiating 1F1B pipeline for the motion family, for use
+    inside ``shard_map`` over the ``pp`` axis.
+
+    Runs the combined forward+backward 1F1B timetable explicitly: each
+    tick a stage performs (masked SPMD) its scheduled forward - stashing
+    the stage INPUT, the only activation kept per in-flight microbatch -
+    and/or its scheduled backward, which recomputes the stage via
+    ``jax.vjp`` at the stashed input and chains the cotangent upstream.
+    Activation memory is bounded by the 1F1B in-flight limit (<= S
+    microbatch inputs per stage) instead of GPipe's all-M.
+
+    Returns ``(loss_sum, correct_sum, w_sum, grads)``: the weighted NLL
+    sum, correct-count and weight total (all banked at the last stage and
+    replicated over ``pp`` - divide loss/grads by ``w_sum`` for mean
+    semantics), and ``grads``, a params-tree cotangent for ``{"rnn":
+    layers, "fc": head}`` containing THIS STAGE's contribution only - the
+    caller's ``custom_vjp`` hands it to shard_map's replicated-param
+    transpose, which sums over the mesh.  ``sample_weights`` (B,) marks
+    padded rows of a partial batch (the weighted trainer path).
+    """
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    L = len(layers)
+    if L % n != 0:
+        raise ValueError(f"{L} layers do not split into {n} stages")
+    # same guard as pp_stacked_rnn: a mismatched ``cell`` would split the
+    # pre-activations into bogus gates with NO shape error whenever the
+    # gate widths divide evenly
+    gates = layers[0]["w_ih"].shape[0] // layers[0]["w_hh"].shape[1]
+    expected = {"lstm": 4, "gru": 3}[cell]
+    if gates != expected:
+        raise ValueError(
+            f"cell={cell!r} expects {expected}H-wide gates but the params "
+            f"tree carries {gates}H - wrong cell for this tree"
+        )
+    per_stage = L // n
+    M = num_microbatches
+    batch, t, in_dim = x.shape
+    if batch % M != 0:
+        raise ValueError(f"batch {batch} not divisible into {M} microbatches")
+    bm = batch // M
+    hidden = layers[0]["w_hh"].shape[1]
+    width = max(in_dim, hidden)
+
+    stacked = _stack_padded(layers, width, cell)
+    x_micro = _pad_last(x, width).reshape(M, bm, t, width)
+    y_micro = y.reshape(M, bm)
+    w_micro = (jnp.ones((M, bm), jnp.float32) if sample_weights is None
+               else sample_weights.reshape(M, bm).astype(jnp.float32))
+    if compute_dtype is not None:
+        stacked = jax.tree.map(lambda p: p.astype(compute_dtype), stacked)
+        x_micro = x_micro.astype(compute_dtype)
+    dtype = x_micro.dtype
+
+    fwd_np, bwd_np = simulate_1f1b_schedule(n, M)
+    fwd_sched = jnp.asarray(fwd_np)
+    bwd_sched = jnp.asarray(bwd_np)
+    # receive flags: stage s gets an activation when s-1 ran a forward
+    # this tick, a cotangent when s+1 ran a backward
+    recv_f_np = jnp.asarray(
+        jnp.roll(jnp.asarray(fwd_np >= 0), 1, axis=1).at[:, 0].set(False))
+    recv_b_np = jnp.asarray(
+        jnp.roll(jnp.asarray(bwd_np >= 0), -1, axis=1).at[:, -1].set(False))
+    TT = fwd_np.shape[0]
+    K = min(n, M)  # 1F1B in-flight bound -> stash ring size
+
+    is_last = idx == n - 1
+
+    def run_stage(stk, acts):
+        for j in range(per_stage):
+            acts = _run_layer(stk, idx * per_stage + j,
+                              _pad_last(acts, width), unroll=unroll,
+                              cell=cell)
+        return acts
+
+    def head_loss(hd, acts, y_m, w_m):
+        logits = (acts[:, -1, :].astype(jnp.float32)
+                  @ hd["weight"].T + hd["bias"])
+        nll = -jax.nn.log_softmax(logits)[jnp.arange(bm), y_m]
+        correct = jnp.sum(
+            (jnp.argmax(logits, axis=1) == y_m) * (w_m > 0)
+        )
+        return jnp.sum(nll * w_m), correct
+
+    def full(stk, hd, a, y_m, w_m):
+        acts = run_stage(stk, a)
+        loss_m, _ = head_loss(hd, acts, y_m, w_m)
+        return acts, loss_m
+
+    def tick(carry, tk):
+        (fwd_buf, bwd_buf, stash, g_stk, g_head, loss_sum, correct_sum,
+         w_sum) = carry
+        m_f = fwd_sched[tk, idx]
+        m_b = bwd_sched[tk, idx]
+        f_active = m_f >= 0
+        b_active = m_b >= 0
+        m_f_safe = jnp.clip(m_f, 0, M - 1)
+        m_b_safe = jnp.clip(m_b, 0, M - 1)
+
+        # ---- backward op: read the stash BEFORE the forward writes it
+        stash_in = lax.dynamic_index_in_dim(stash, m_b_safe % K,
+                                            keepdims=False)
+        y_b = lax.dynamic_index_in_dim(y_micro, m_b_safe, keepdims=False)
+        w_b = lax.dynamic_index_in_dim(w_micro, m_b_safe, keepdims=False)
+        (_, _), vjp_fn = jax.vjp(
+            lambda stk, hd, a: full(stk, hd, a, y_b, w_b),
+            stacked, head, stash_in,
+        )
+        b_mask = b_active.astype(jnp.float32)
+        # the buffered cotangent is W-wide (it is d(next stage's padded
+        # input)); this stage's acts are H-wide - take the H slice
+        cot_acts = (jnp.where(is_last, 0.0, 1.0) * b_mask
+                    * bwd_buf[..., :hidden])
+        cot_loss = jnp.where(is_last, 1.0, 0.0) * b_mask
+        d_stk, d_head, d_acts = vjp_fn(
+            (cot_acts.astype(dtype), cot_loss)
+        )
+        g_stk = jax.tree.map(
+            lambda g, d: g + b_mask * d.astype(jnp.float32), g_stk, d_stk)
+        g_head = jax.tree.map(
+            lambda g, d: g + b_mask * d.astype(jnp.float32), g_head, d_head)
+
+        # ---- forward op
+        inp = jnp.where(
+            idx == 0,
+            lax.dynamic_index_in_dim(x_micro, m_f_safe, keepdims=False),
+            fwd_buf,
+        )
+        stash = jnp.where(
+            f_active,
+            lax.dynamic_update_index_in_dim(stash, inp, m_f_safe % K,
+                                            axis=0),
+            stash,
+        )
+        acts = run_stage(stacked, inp)
+        # loss/metrics bank at the last stage's forward (value only)
+        y_f = lax.dynamic_index_in_dim(y_micro, m_f_safe, keepdims=False)
+        w_f = lax.dynamic_index_in_dim(w_micro, m_f_safe, keepdims=False)
+        loss_m, correct_m = head_loss(head, acts, y_f, w_f)
+        bank = (f_active & is_last).astype(jnp.float32)
+        loss_sum = loss_sum + bank * loss_m
+        correct_sum = correct_sum + bank * correct_m
+        w_sum = w_sum + bank * jnp.sum(w_f)
+
+        # ---- communicate (capacity-1 buffers, schedule-gated receive)
+        perm_f = [(i, (i + 1) % n) for i in range(n)]
+        perm_b = [(i, (i - 1) % n) for i in range(n)]
+        acts_hop = lax.ppermute(_pad_last(acts, width), axis, perm_f)
+        dacts_hop = lax.ppermute(d_acts, axis, perm_b)
+        fwd_buf = jnp.where(recv_f_np[tk, idx], acts_hop, fwd_buf)
+        bwd_buf = jnp.where(
+            recv_b_np[tk, idx],
+            dacts_hop.astype(jnp.float32)[..., :width],
+            bwd_buf,
+        )
+        return (fwd_buf, bwd_buf, stash, g_stk, g_head, loss_sum,
+                correct_sum, w_sum), None
+
+    zeros_like_f32 = lambda t_: jax.tree.map(  # noqa: E731
+        lambda p: jnp.zeros(p.shape, jnp.float32), t_)
+    carry0 = (
+        jnp.zeros((bm, t, width), dtype),
+        jnp.zeros((bm, t, width), jnp.float32),
+        jnp.zeros((K, bm, t, width), dtype),
+        zeros_like_f32(stacked),
+        zeros_like_f32(head),
+        jnp.float32(0.0),
+        jnp.float32(0.0),
+        jnp.float32(0.0),
+    )
+    (_, _, _, g_stk, g_head, loss_sum, correct_sum, w_sum), _ = lax.scan(
+        tick, carry0, jnp.arange(TT)
+    )
+
+    # loss/metrics live on the last stage; replicate over pp
+    loss_sum = broadcast_from(loss_sum, axis, n - 1)
+    correct_sum = broadcast_from(correct_sum, axis, n - 1)
+    w_sum = broadcast_from(w_sum, axis, n - 1)
+
+    # unstack this stage's grads back into the params tree layout
+    grads = {"rnn": _unstack_grads(g_stk, layers, cell), "fc": g_head}
+    return loss_sum, correct_sum, w_sum, grads
+
+
+def _unstack_grads(g_stk, layers, cell: str):
+    """Map stacked-layout grads back to the per-layer params tree:
+    un-pad w_ih columns, un-transpose w_hh, split the folded LSTM bias
+    (d b_ih = d b_hh = d b)."""
+    out = []
+    for li, layer in enumerate(layers):
+        cols = layer["w_ih"].shape[1]
+        g = {
+            "w_ih": g_stk["w_ih"][li][:, :cols],
+            "w_hh": g_stk["w_hh_t"][li].T,
+        }
+        if cell == "gru":
+            g["b_ih"] = g_stk["b"][li]
+            g["b_hh"] = g_stk["b_hh"][li]
+        else:
+            g["b_ih"] = g_stk["b"][li]
+            g["b_hh"] = g_stk["b"][li]
+        out.append(g)
+    return out
+
+
 def make_pp_forward(mesh, axis: str = "pp", *, num_microbatches: int = 4,
                     unroll: int = 1, cell: str = "lstm"):
     """Jitted pipeline-parallel forward for a MotionModel-shaped params

@@ -483,6 +483,90 @@ def make_char_mesh_loss_fn(mesh, axes: dict[str, int], *,
 # Motion-model mesh factories (drive the shared Trainer loop)
 # ---------------------------------------------------------------------------
 
+def make_motion_pp_1f1b_loss_fn(mesh, axes: dict[str, int], *,
+                                num_microbatches: int = 4, unroll: int = 1,
+                                weighted: bool = False, cell: str = "lstm",
+                                precision: str = "f32"):
+    """Shard_mapped motion loss over a dp x pp mesh running the 1F1B
+    (PipeDream-flush) schedule instead of GPipe - same ``loss_fn(params,
+    x, y[, w]) -> (loss, metrics)`` contract as
+    :func:`make_motion_mesh_loss_fn`, so ``make_mesh_grad_step``'s
+    ``jax.value_and_grad`` drives it unchanged.
+
+    The 1F1B program computes its OWN gradients (the schedule interleaves
+    each microbatch's backward right after its forward, bounding live
+    activations to the in-flight limit instead of GPipe's all-M); a
+    ``custom_vjp`` hands those precomputed stage-local grads to
+    shard_map's replicated-param transpose, which sums them over the
+    mesh.  ``jax.checkpoint``-style remat is inherent (the backward op
+    recomputes its stage from the stashed input), so ``remat`` is not a
+    separate lever here.
+    """
+    from functools import partial as _partial
+
+    from pytorch_distributed_rnn_tpu.parallel.pp import (
+        pp_rnn_1f1b_value_and_grad,
+    )
+
+    if (set(a for a, v in axes.items() if v != 1) - {"dp", "pp"}
+            or "pp" not in axes):
+        raise ValueError(
+            f"1f1b runs on dp x pp meshes only (pp axis required); "
+            f"got {dict(axes)}"
+        )
+    compute_dtype = jnp.bfloat16 if precision == "bf16" else None
+
+    batch_specs = (P("dp"), P("dp")) + ((P("dp"),) if weighted else ())
+
+    @_partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(),) + batch_specs,
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    def loss_fn(params, x, y, *extra):
+        w = extra[0] if weighted else None
+
+        def engine(p):
+            return pp_rnn_1f1b_value_and_grad(
+                p["rnn"], p["fc"], x, y, "pp",
+                num_microbatches=num_microbatches, unroll=unroll,
+                cell=cell, compute_dtype=compute_dtype,
+                sample_weights=w,
+            )
+
+        @jax.custom_vjp
+        def f(p):
+            loss_sum, correct, w_sum, _ = engine(p)
+            return loss_sum / jnp.maximum(w_sum, 1.0), correct
+
+        def f_fwd(p):
+            loss_sum, correct, w_sum, grads = engine(p)
+            grads = jax.tree.map(
+                lambda g: g / jnp.maximum(w_sum, 1.0), grads
+            )
+            return (loss_sum / jnp.maximum(w_sum, 1.0), correct), grads
+
+        def f_bwd(grads, cts):
+            ct_loss, _ = cts  # `correct` is a metric, not differentiated
+            # the replicated (P()) output's transpose splits the incoming
+            # cotangent 1/pp across the pp shards; undo it so the
+            # replicated-param transpose's sum counts each stage's
+            # contribution exactly once (verified empirically at pp=2,4)
+            ct_loss = ct_loss * lax.axis_size("pp")
+            return (jax.tree.map(lambda g: g * ct_loss, grads),)
+
+        f.defvjp(f_fwd, f_bwd)
+        local, correct = f(params)
+        return (
+            lax.pmean(local, "dp"),
+            {"correct": lax.psum(correct, "dp")},
+        )
+
+    return loss_fn
+
+
 def make_motion_mesh_loss_fn(mesh, axes: dict[str, int], *,
                              schedule: str = "wavefront",
                              num_microbatches: int = 4, unroll: int = 1,

@@ -76,6 +76,14 @@ def add_sub_commands(sub_parser):
         default="wavefront",
     )
     mesh_p.add_argument("--num-microbatches", type=int, default=4)
+    mesh_p.add_argument(
+        "--pp-schedule", choices=["gpipe", "1f1b"], default="gpipe",
+        help="pipeline schedule for pp meshes: gpipe (fill-drain forward, "
+        "XLA-transposed backward) or 1f1b (PipeDream-flush: each "
+        "microbatch's backward interleaves right after its forward, "
+        "bounding live activations to the in-flight limit; motion "
+        "family)",
+    )
 
     def _mesh(args):
         from pytorch_distributed_rnn_tpu.training.mesh import (

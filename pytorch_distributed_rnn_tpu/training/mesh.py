@@ -36,7 +36,13 @@ class MeshTrainer(SpmdTrainer):
     """Composed-mesh training strategy for the motion model."""
 
     def __init__(self, *, mesh_axes, schedule: str = "wavefront",
-                 num_microbatches: int = 4, **kwargs):
+                 num_microbatches: int = 4, pp_schedule: str = "gpipe",
+                 **kwargs):
+        if pp_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"unknown pp schedule {pp_schedule!r} - use gpipe or 1f1b"
+            )
+        self.pp_schedule = pp_schedule
         axes = dict(mesh_axes)
         if "dp" not in axes:
             axes = {"dp": 1, **axes}
@@ -130,6 +136,15 @@ class MeshTrainer(SpmdTrainer):
                     f"divisible by sp={sp_size} - pick --seq-length so "
                     f"that sp divides seq_length + 1"
                 )
+        if self.pp_schedule == "1f1b" and (
+            self.is_attention or self.is_char or self.is_moe
+            or self.model_axis != "pp"
+        ):
+            raise ValueError(
+                "--pp-schedule 1f1b drives the motion family's dp x pp "
+                "mesh only (parallel/pp.py:pp_rnn_1f1b_value_and_grad); "
+                "other families/axes run the gpipe schedule"
+            )
         # bf16 + remat thread through EVERY model axis since r4 (the tp
         # gate-sharded and pp GPipe stacks take the same levers as the
         # sp relay: compute-dtype matmuls/collective bytes, f32 carries,
@@ -210,6 +225,19 @@ class MeshTrainer(SpmdTrainer):
                 precision=getattr(self.model, "precision", "f32"),
                 remat=getattr(self.model, "remat", False),
                 num_layers=getattr(self.model, "layer_dim", None),
+            )
+        if self.model_axis == "pp" and self.pp_schedule == "1f1b":
+            from pytorch_distributed_rnn_tpu.parallel.strategy import (
+                make_motion_pp_1f1b_loss_fn,
+            )
+
+            # remat is inherent to the 1f1b backward (it recomputes each
+            # stage from the stashed input), so the flag needs no seam
+            return make_motion_pp_1f1b_loss_fn(
+                self.mesh, self.mesh_axes,
+                num_microbatches=self.num_microbatches, weighted=weighted,
+                cell=getattr(self.model, "cell", "lstm"),
+                precision=getattr(self.model, "precision", "f32"),
             )
         return make_motion_mesh_loss_fn(
             self.mesh, self.mesh_axes, schedule=self.schedule,
@@ -331,6 +359,7 @@ def mesh_trainer_factory(args):
             mesh_axes=spec,
             schedule=args.sp_schedule,
             num_microbatches=args.num_microbatches,
+            pp_schedule=getattr(args, "pp_schedule", "gpipe"),
             **kwargs,
         )
 

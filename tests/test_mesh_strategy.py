@@ -131,6 +131,30 @@ class TestMeshTrainerEquivalence:
         assert history[-1] < history[0]
         assert history == pytest.approx(ref_history, rel=5e-2)
 
+    def test_1f1b_pp_schedule_matches_gpipe(self, datasets,
+                                            ddp_reference):
+        """--pp-schedule 1f1b reproduces the GPipe (and so plain-DDP)
+        training numerics exactly - same grads, different timetable."""
+        ref_params, ref_history = ddp_reference
+        params, history = _train(
+            {"mesh_axes": {"dp": 2, "pp": 2}, "pp_schedule": "1f1b"},
+            datasets,
+        )
+        assert history == pytest.approx(ref_history, rel=1e-4)
+        assert leaves_sum(params) == pytest.approx(
+            leaves_sum(ref_params), rel=1e-5
+        )
+
+    def test_1f1b_rejected_off_the_motion_pp_mesh(self, datasets):
+        with pytest.raises(ValueError, match="1f1b"):
+            MeshTrainer(
+                mesh_axes={"dp": 2, "sp": 2}, pp_schedule="1f1b",
+                model=MotionModel(input_dim=9, hidden_dim=8, layer_dim=2,
+                                  output_dim=6, impl="scan"),
+                training_set=datasets, batch_size=24,
+                learning_rate=2.5e-3, seed=SEED,
+            )
+
     def test_sequential_sp_schedule_matches_too(self, datasets,
                                                 ddp_reference):
         ref_params, ref_history = ddp_reference

@@ -290,3 +290,112 @@ class TestPpLevers:
         for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
             np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                        rtol=1e-5, atol=1e-6)
+
+
+class Test1F1B:
+    """The 1F1B (PipeDream-flush) schedule: timetable properties, exact
+    numerics vs the reference autodiff, and the MeshTrainer route."""
+
+    def test_schedule_stats_bubble_shrinks_with_microbatches(self):
+        from pytorch_distributed_rnn_tpu.parallel.pp import (
+            pp_schedule_stats,
+        )
+
+        g4 = pp_schedule_stats(4, 4, "gpipe")
+        g8 = pp_schedule_stats(4, 8, "gpipe")
+        f4 = pp_schedule_stats(4, 4, "1f1b")
+        f8 = pp_schedule_stats(4, 8, "1f1b")
+        # gpipe forward bubble = (S-1)/(M+S-1); 1f1b has the same
+        # fraction over its combined F+B timetable
+        assert g4["bubble_fraction"] == pytest.approx(3 / 7, abs=1e-4)
+        assert f4["bubble_fraction"] == pytest.approx(3 / 7, abs=1e-4)
+        assert g8["bubble_fraction"] == pytest.approx(3 / 11, abs=1e-4)
+        assert f8["bubble_fraction"] == pytest.approx(3 / 11, abs=1e-4)
+        assert f8["bubble_fraction"] < f4["bubble_fraction"]
+        # the combined timetable is 2(M + S - 1) ticks
+        assert f4["ticks"] == 2 * (4 + 4 - 1)
+        # every op lands exactly once: M forwards + M backwards per stage
+        assert f4["busy_slots"] == 4 * 2 * 4
+
+    @pytest.mark.parametrize("stages,cell", [(2, "lstm"), (4, "lstm"),
+                                             (2, "gru")])
+    def test_value_and_grad_matches_reference(self, stages, cell):
+        from jax import lax
+
+        from pytorch_distributed_rnn_tpu.parallel.pp import (
+            pp_rnn_1f1b_value_and_grad,
+        )
+
+        mesh = make_mesh({"pp": stages})
+        model = MotionModel(input_dim=IN, hidden_dim=H, layer_dim=4,
+                            output_dim=6, cell=cell, impl="scan")
+        params = model.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (B, T, IN))
+        y = jax.random.randint(jax.random.PRNGKey(2), (B,), 0, 6)
+
+        @partial(shard_map, mesh=mesh, in_specs=(P(), P(), P()),
+                 out_specs=(P(), P()), check_vma=False)
+        def run(p, x, y):
+            loss_sum, _, w_sum, grads = pp_rnn_1f1b_value_and_grad(
+                p["rnn"], p["fc"], x, y, "pp", num_microbatches=4,
+                cell=cell,
+            )
+            grads = jax.tree.map(
+                lambda g: lax.psum(g, "pp") / w_sum, grads
+            )
+            return loss_sum / w_sum, grads
+
+        loss, grads = jax.jit(run)(params, x, y)
+
+        def ref(p):
+            logits = model.apply(p, x)
+            nll = -jax.nn.log_softmax(logits)[jnp.arange(B), y]
+            return jnp.mean(nll)
+
+        rl, rg = jax.value_and_grad(ref)(params)
+        assert float(loss) == pytest.approx(float(rl), abs=1e-5)
+        for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(grads),
+            jax.tree_util.tree_leaves_with_path(rg),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5,
+                err_msg=jax.tree_util.keystr(pa),
+            )
+
+    def test_loss_fn_under_value_and_grad(self):
+        """The custom-vjp loss fn drives jax.value_and_grad unchanged on
+        a dp x pp mesh (the make_mesh_grad_step contract)."""
+        from pytorch_distributed_rnn_tpu.parallel.strategy import (
+            make_motion_pp_1f1b_loss_fn,
+        )
+
+        axes = {"dp": 2, "pp": 2}
+        mesh = make_mesh(axes)
+        model = MotionModel(input_dim=IN, hidden_dim=H, layer_dim=2,
+                            output_dim=6, impl="scan")
+        params = model.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2 * B, T, IN))
+        y = jax.random.randint(jax.random.PRNGKey(2), (2 * B,), 0, 6)
+        loss_fn = make_motion_pp_1f1b_loss_fn(mesh, axes,
+                                              num_microbatches=4)
+        (loss, metrics), grads = jax.jit(
+            jax.value_and_grad(loss_fn, has_aux=True)
+        )(params, x, y)
+
+        def ref(p):
+            logits = model.apply(p, x)
+            nll = -jax.nn.log_softmax(logits)[jnp.arange(2 * B), y]
+            return jnp.mean(nll)
+
+        rl, rg = jax.value_and_grad(ref)(params)
+        assert float(loss) == pytest.approx(float(rl), abs=1e-5)
+        assert 0 <= int(metrics["correct"]) <= 2 * B
+        for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(grads),
+            jax.tree_util.tree_leaves_with_path(rg),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5,
+                err_msg=jax.tree_util.keystr(pa),
+            )
