@@ -174,3 +174,136 @@ def make_3d_train_step(model, optimizer, mesh, *, dp_axis: str = "dp",
         return params, opt_state, loss
 
     return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+
+# ---------------------------------------------------------------------------
+# dp x sp x tp for the RNN families: gate-sharded cell inside the sp relay
+# ---------------------------------------------------------------------------
+#
+# The sp relay (parallel/sp.py:_relay) rotates the recurrent carry around
+# the time shards; the tp gate sharding (parallel/tp.py) splits every
+# gate's H rows across shards with one all-gather of h per step.  They
+# compose because they act on DIFFERENT parts of the step: the relay
+# moves the (h, c) carry BETWEEN time chunks along sp, while inside a
+# chunk's scan each (sp, tp) shard computes only its 4H/ntp gate slice
+# and carries its H/ntp slice of the state - ppermute over sp moves the
+# tp-local slices between sp neighbours at a fixed tp coordinate, so the
+# two axes never exchange with each other.  (This replaces the old
+# "RNN cells take dp plus at most one model axis" claim, which was a
+# scoping decision, not a structural limit - VERDICT r3 item 6.)
+
+
+def sp_tp_lstm_layer(params, x_local, sp_axis: str, tp_axis: str, *,
+                     unroll: int = 1, compute_dtype=None):
+    """One LSTM layer with time sharded over ``sp_axis`` AND the hidden
+    dimension gate-sharded over ``tp_axis``, inside ``shard_map``.
+
+    ``x_local``: this shard's (B, T/S, in) time chunk (replicated over
+    tp).  Returns ``(outputs_local (B, T/S, H/ntp), (h_T, c_T))`` -
+    outputs stay tp-local (callers gather between layers or run a
+    row-parallel head); the relayed carry is the tp-local (B, H/ntp)
+    slice pair, f32 per the lstm_step mixed-precision contract.
+    """
+    from pytorch_distributed_rnn_tpu.ops.rnn import lstm_input_proj
+    from pytorch_distributed_rnn_tpu.parallel.sp import _relay
+    from pytorch_distributed_rnn_tpu.parallel.tp import (
+        sharded_gate_params,
+        tp_lstm_step,
+    )
+
+    nsp = lax.axis_size(sp_axis)
+    ntp = lax.axis_size(tp_axis)
+    ktp = lax.axis_index(tp_axis)
+    hidden = params["w_hh"].shape[1]
+    per = hidden // ntp
+    batch = x_local.shape[0]
+
+    local, x_local = sharded_gate_params(params, ntp, ktp, x_local,
+                                         compute_dtype=compute_dtype)
+    x_proj = lstm_input_proj(local, x_local)             # (B, T/S, 4H/ntp)
+    w_hh_l_t = local["w_hh"].T                           # (H, 4H/ntp)
+
+    def chunk(carry):
+        carry, out = lax.scan(
+            lambda c, xp: tp_lstm_step(w_hh_l_t, tp_axis, c, xp),
+            carry, jnp.swapaxes(x_proj, 0, 1), unroll=unroll
+        )
+        return carry, jnp.swapaxes(out, 0, 1)
+
+    h0 = jnp.zeros((batch, per), jnp.float32)
+    c0 = jnp.zeros((batch, per), jnp.float32)
+    final, outputs = _relay(sp_axis, nsp, (h0, c0), chunk)
+    return outputs, final
+
+
+def sp_tp_gru_layer(params, x_local, sp_axis: str, tp_axis: str, *,
+                    unroll: int = 1, compute_dtype=None):
+    """GRU sibling of :func:`sp_tp_lstm_layer` (3 gates r, z, n; torch
+    semantics - the hidden-side n-bias joins inside the ``r *`` product,
+    sliced like the weights)."""
+    from pytorch_distributed_rnn_tpu.ops.rnn import gru_input_proj
+    from pytorch_distributed_rnn_tpu.parallel.sp import _relay
+    from pytorch_distributed_rnn_tpu.parallel.tp import (
+        sharded_gate_params,
+        tp_gru_step,
+    )
+
+    nsp = lax.axis_size(sp_axis)
+    ntp = lax.axis_size(tp_axis)
+    ktp = lax.axis_index(tp_axis)
+    hidden = params["w_hh"].shape[1]
+    per = hidden // ntp
+    batch = x_local.shape[0]
+
+    local, x_local = sharded_gate_params(params, ntp, ktp, x_local,
+                                         num_gates=3,
+                                         compute_dtype=compute_dtype)
+    x_proj = gru_input_proj(local, x_local)              # (B, T/S, 3H/ntp)
+    w_hh_l_t = local["w_hh"].T
+    b_hh_l = local["b_hh"]
+
+    def chunk(carry):
+        carry, out = lax.scan(
+            lambda h, xp: tp_gru_step(w_hh_l_t, b_hh_l, tp_axis, h, xp),
+            carry, jnp.swapaxes(x_proj, 0, 1), unroll=unroll
+        )
+        return carry, jnp.swapaxes(out, 0, 1)
+
+    h0 = jnp.zeros((batch, per), jnp.float32)
+    final, outputs = _relay(sp_axis, nsp, h0, chunk)
+    return outputs, final
+
+
+def sp_tp_stacked_rnn(layers, x_local, sp_axis: str, tp_axis: str, *,
+                      cell: str = "lstm", unroll: int = 1,
+                      compute_dtype=None, remat: bool = False,
+                      dropout: float = 0.0, dropout_key=None):
+    """Stack of sp x tp layers - layer-sequential relay (each layer is a
+    full relay over sp) with gate-sharded cells over tp.
+
+    Intermediate layer outputs are all-gathered over tp (the next layer's
+    input projection wants full H); the LAST layer's output stays
+    tp-local (B, T/S, H/ntp) so callers can run a row-parallel head
+    without re-gathering.  ``dropout`` masks between layers on the
+    gathered full-width activations (the same seam as the sequential sp
+    relay; the key folds in the sp index only, so tp shards agree on the
+    mask).  ``remat`` checkpoints each layer's relay.
+    """
+    from pytorch_distributed_rnn_tpu.ops.rnn import interlayer_dropout
+
+    layer_fn = (sp_tp_gru_layer if cell == "gru" else sp_tp_lstm_layer)
+    layer_fn = partial(layer_fn, sp_axis=sp_axis, tp_axis=tp_axis,
+                       unroll=unroll, compute_dtype=compute_dtype)
+    if remat:
+        layer_fn = jax.checkpoint(layer_fn)
+    out = x_local
+    finals = []
+    for idx, layer in enumerate(layers):
+        out_local, final = layer_fn(layer, out)
+        finals.append(final)
+        if idx < len(layers) - 1:
+            out = lax.all_gather(out_local, tp_axis, axis=2, tiled=True)
+            if dropout > 0.0 and dropout_key is not None:
+                out, dropout_key = interlayer_dropout(out, dropout_key,
+                                                      dropout)
+    return out_local, finals

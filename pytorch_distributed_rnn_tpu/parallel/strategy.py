@@ -17,11 +17,12 @@ a mesh spec like ``dp=2,sp=4``:
   axis (wavefront relay), ``tp`` shards LSTM gates + head rows
   (Megatron-style), ``pp`` stages the layer stack (GPipe schedule).
 
-Supported RNN meshes: ``dp`` composed with AT MOST one of ``sp``/``tp``/
-``pp`` (the RNN cell kernels do not compose sp x tp in one program; the
-attention family covers the full dp x sp x tp composition via
-``parallel/combined.py``).  Cells: both LSTM and GRU run on every model
-axis - sp (sequential relay), tp (gate-sharded), pp (GPipe stages).
+Supported RNN meshes: ``dp`` composed with one of ``sp``/``tp``/``pp``,
+plus the composed ``sp x tp`` pair for the char-LM family (gate-sharded
+cell inside the sp relay, ``parallel/combined.py:sp_tp_stacked_rnn`` -
+r4; the attention family composes the full dp x sp x tp via the same
+module).  Cells: both LSTM and GRU run on every model axis - sp
+(sequential relay), tp (gate-sharded), pp (GPipe stages).
 """
 
 from __future__ import annotations
@@ -75,18 +76,28 @@ def parse_mesh_spec(spec: str) -> dict[str, int]:
     return axes
 
 
-def validate_rnn_mesh(axes: dict[str, int], cell: str = "lstm"):
+def validate_rnn_mesh(axes: dict[str, int], cell: str = "lstm",
+                      allow_sp_tp: bool = False):
     """Reject mesh specs the RNN kernels cannot run.
 
     Both cells run on every model axis: sp (sequential relay), tp
     (gate-sharded), pp (GPipe stage runner - cell-generic since r3).
+    With ``allow_sp_tp`` (the char-LM family, r4) the sp and tp axes
+    additionally COMPOSE - the gate-sharded cell runs inside the sp
+    relay (``parallel/combined.py:sp_tp_stacked_rnn``) - returning the
+    composite axis name ``"sp+tp"``.
     """
     model_axes = [a for a in MODEL_AXES if axes.get(a, 1) > 1]
     if len(model_axes) > 1:
+        if allow_sp_tp and set(model_axes) == {"sp", "tp"}:
+            if cell not in ("lstm", "gru"):
+                raise ValueError(f"unknown cell {cell!r}")
+            return "sp+tp"
         raise ValueError(
-            f"RNN meshes support dp plus at most ONE of sp/tp/pp, got "
-            f"{model_axes} (the attention family composes dp x sp x tp, "
-            f"see parallel/combined.py)"
+            f"RNN meshes support dp plus at most ONE of sp/tp/pp "
+            f"(plus sp x tp for the char family), got {model_axes} "
+            f"(the attention family composes dp x sp x tp, see "
+            f"parallel/combined.py)"
         )
     if model_axes and cell not in ("lstm", "gru"):
         raise ValueError(f"unknown cell {cell!r}")
@@ -201,12 +212,14 @@ def _char_local_logits(params, tokens, *, sp=None, tp=None, pp=None,
     into the dropout key); the tp/pp stacks have no dropout seam -
     callers reject that combination loudly.
     """
-    if sum(a is not None for a in (sp, tp, pp)) > 1:
-        raise ValueError("compose dp with at most one of sp/tp/pp")
+    if pp is not None and (sp is not None or tp is not None):
+        raise ValueError("pp does not compose with sp/tp for the char LM")
     head_w, head_b = params["head"]["weight"], params["head"]["bias"]
     t = tokens.shape[1]
 
-    if sp is not None:
+    def sp_chunk():
+        """Shared sp prologue: this shard's token chunk embedded, plus
+        the shard-folded dropout key and chunk coordinates."""
         n = lax.axis_size(sp)
         k = lax.axis_index(sp)
         if t % n != 0:
@@ -218,32 +231,24 @@ def _char_local_logits(params, tokens, *, sp=None, tp=None, pp=None,
         t_local = t // n
         tok_loc = lax.dynamic_slice_in_dim(tokens, k * t_local, t_local,
                                            axis=1)
-        x_loc = params["embed"][tok_loc]
         sp_key = (None if dropout_key is None
                   else jax.random.fold_in(dropout_key, k))
-        out_local, _ = _sp_stack(cell, schedule)(
-            params["rnn"], x_loc, sp, unroll=unroll,
-            compute_dtype=compute_dtype, remat=remat,
-            dropout=dropout, dropout_key=sp_key,
-        )
-        # (B, t_local, V); head in f32 like the unsharded branch
-        logits = out_local.astype(jnp.float32) @ head_w.T + head_b
+        return k, t_local, params["embed"][tok_loc], sp_key
+
+    def sp_targets(k, t_local):
+        """Local target slice + padding-position weights: the final
+        global position predicts nothing, masked via w_pos."""
         shifted = jnp.concatenate(
             [tokens[:, 1:], tokens[:, -1:]], axis=1
         )
         tgt_loc = lax.dynamic_slice_in_dim(shifted, k * t_local, t_local,
                                            axis=1)
         pos = k * t_local + jnp.arange(t_local)
-        w_pos = (pos < t - 1).astype(jnp.float32)[None, :]  # (1, t_local)
-        return logits, tgt_loc, w_pos
+        return tgt_loc, (pos < t - 1).astype(jnp.float32)[None, :]
 
-    x = params["embed"][tokens[:, :-1]]
-    if tp is not None:
-        stack = tp_stacked_gru if cell == "gru" else tp_stacked_lstm
-        out, _ = stack(params["rnn"], x, tp, unroll=unroll,
-                       compute_dtype=compute_dtype, remat=remat)
-        # row-parallel per-timestep head: shard the hidden dim, one psum;
-        # head in f32 like every other branch (casts are f32 no-ops)
+    def row_parallel_timestep_head(h_local):
+        """Row-parallel per-timestep head on this tp shard's (B, T', H/n)
+        hidden slice: one psum combines partial logits; f32 head."""
         ntp = lax.axis_size(tp)
         ktp = lax.axis_index(tp)
         hidden = head_w.shape[1]
@@ -251,11 +256,54 @@ def _char_local_logits(params, tokens, *, sp=None, tp=None, pp=None,
             raise ValueError(f"hidden {hidden} not divisible by tp={ntp}")
         per = hidden // ntp
         w_local = lax.dynamic_slice_in_dim(head_w, ktp * per, per, axis=1)
-        h_local = lax.dynamic_slice_in_dim(out, ktp * per, per, axis=2)
-        logits = lax.psum(
+        return lax.psum(
             jnp.einsum("bth,vh->btv", h_local.astype(jnp.float32),
                        w_local), tp
         ) + head_b
+
+    if sp is not None and tp is not None:
+        # the composed axis pair: gate-sharded cell inside the sp relay
+        # (parallel/combined.py) with a row-parallel per-timestep head
+        from pytorch_distributed_rnn_tpu.parallel.combined import (
+            sp_tp_stacked_rnn,
+        )
+
+        k, t_local, x_loc, sp_key = sp_chunk()
+        out_local, _ = sp_tp_stacked_rnn(
+            params["rnn"], x_loc, sp, tp, cell=cell, unroll=unroll,
+            compute_dtype=compute_dtype, remat=remat,
+            dropout=dropout, dropout_key=sp_key,
+        )
+        # out_local is already the tp-LOCAL (B, T/S, H/ntp) slice
+        logits = row_parallel_timestep_head(out_local)
+        tgt_loc, w_pos = sp_targets(k, t_local)
+        return logits, tgt_loc, w_pos
+
+    if sp is not None:
+        k, t_local, x_loc, sp_key = sp_chunk()
+        out_local, _ = _sp_stack(cell, schedule)(
+            params["rnn"], x_loc, sp, unroll=unroll,
+            compute_dtype=compute_dtype, remat=remat,
+            dropout=dropout, dropout_key=sp_key,
+        )
+        # (B, t_local, V); head in f32 like the unsharded branch
+        logits = out_local.astype(jnp.float32) @ head_w.T + head_b
+        tgt_loc, w_pos = sp_targets(k, t_local)
+        return logits, tgt_loc, w_pos
+
+    x = params["embed"][tokens[:, :-1]]
+    if tp is not None:
+        stack = tp_stacked_gru if cell == "gru" else tp_stacked_lstm
+        out, _ = stack(params["rnn"], x, tp, unroll=unroll,
+                       compute_dtype=compute_dtype, remat=remat)
+        # the tp stack all-gathers its output full-width; re-slice this
+        # shard's piece for the row-parallel head (which validates the
+        # hidden/tp divisibility)
+        ntp = lax.axis_size(tp)
+        per = max(head_w.shape[1] // ntp, 1)
+        h_local = lax.dynamic_slice_in_dim(
+            out, lax.axis_index(tp) * per, per, axis=2)
+        logits = row_parallel_timestep_head(h_local)
     elif pp is not None:
         out = pp_stacked_rnn(
             params["rnn"], x, pp, num_microbatches=num_microbatches,
@@ -303,10 +351,17 @@ def char_mesh_loss(params, tokens, *, sp=None, tp=None, pp=None,
     return lax.pmean(loss, dp)
 
 
-def _axis_kwargs(axes: dict[str, int], cell: str = "lstm"):
-    """{"sp": "sp" or None, ...} for the single active model axis."""
-    model_axis = validate_rnn_mesh(axes, cell)
-    return {a: (a if a == model_axis else None) for a in MODEL_AXES}
+def _axis_kwargs(axes: dict[str, int], cell: str = "lstm",
+                 allow_sp_tp: bool = False):
+    """``(kwargs, model_axis)``: {"sp": "sp" or None, ...} for the active
+    model axis (or the composed sp x tp pair when ``allow_sp_tp``
+    resolves to it, model_axis ``"sp+tp"``) - ONE validation call, so the
+    kwargs and the axis name can never disagree."""
+    model_axis = validate_rnn_mesh(axes, cell, allow_sp_tp=allow_sp_tp)
+    if model_axis == "sp+tp":
+        return {"sp": "sp", "tp": "tp", "pp": None}, model_axis
+    kw = {a: (a if a == model_axis else None) for a in MODEL_AXES}
+    return kw, model_axis
 
 
 def _reject_unsupported_mesh_levers(model_axis, precision: str,
@@ -358,7 +413,7 @@ def make_char_mesh_train_step(optimizer, mesh, axes: dict[str, int], *,
     that re-reduce replicated-parameter cotangents - taking grad inside
     would double-count replicated pieces and drop cross-shard terms.
     """
-    kw = _axis_kwargs(axes, cell)
+    kw, _ = _axis_kwargs(axes, cell, allow_sp_tp=True)
 
     from functools import partial as _partial
 
@@ -433,8 +488,7 @@ def make_char_mesh_loss_fn(mesh, axes: dict[str, int], *,
     GLOBAL batch (``training/lm.py`` semantics), so the shared loop's
     ``correct / len(dataset)`` prints mean token accuracy.
     """
-    kw = _axis_kwargs(axes, cell)
-    model_axis = next((a for a, v in kw.items() if v is not None), None)
+    kw, model_axis = _axis_kwargs(axes, cell, allow_sp_tp=True)
     _reject_unsupported_mesh_levers(model_axis, precision, remat, dropout,
                                     schedule=schedule, cell=cell,
                                     num_layers=num_layers)
@@ -585,8 +639,7 @@ def make_motion_mesh_loss_fn(mesh, axes: dict[str, int], *,
     shard folds its rank in for an independent mask.  ``precision``/
     ``remat`` thread through every model-axis branch exactly like the
     char mesh."""
-    kw = _axis_kwargs(axes, cell)
-    model_axis = next((a for a, v in kw.items() if v is not None), None)
+    kw, model_axis = _axis_kwargs(axes, cell)
     _reject_unsupported_mesh_levers(model_axis, precision, remat, dropout,
                                     schedule=schedule, cell=cell,
                                     num_layers=num_layers)

@@ -68,6 +68,54 @@ def _cast_local(local, x, compute_dtype):
     return local, x.astype(compute_dtype)
 
 
+def sharded_gate_params(params, n, k, x, *, num_gates: int = 4,
+                        compute_dtype=None):
+    """The gate-sharded prologue shared by the tp layers and the composed
+    sp x tp layers (``parallel/combined.py``): slice shard ``k``'s rows of
+    every gate tensor, then cast slices + input to the compute dtype."""
+    local = {
+        name: shard_gates(params[name], n, k, num_gates=num_gates)
+        for name in ("w_ih", "w_hh", "b_ih", "b_hh")
+    }
+    return _cast_local(local, x, compute_dtype)
+
+
+def tp_lstm_step(w_hh_l_t, axis: str, carry, xp_t):
+    """One gate-sharded LSTM step: the tp sibling of
+    :func:`~pytorch_distributed_rnn_tpu.ops.rnn.lstm_step`, shared by
+    ``tp_lstm_layer`` and the composed sp x tp relay.  ``carry``: f32
+    (B, H/n) slices; ``xp_t``: (B, 4H/n) pre-activation.  The one
+    per-step collective all-gathers ``h`` in the compute dtype (half the
+    ICI bytes under bf16); gate math runs f32 per the lstm_step
+    mixed-precision contract."""
+    h_local, c_local = carry
+    h_full = lax.all_gather(h_local.astype(xp_t.dtype), axis,
+                            axis=1, tiled=True)
+    gates = (xp_t + h_full @ w_hh_l_t).astype(jnp.float32)
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    c_local = jax.nn.sigmoid(f) * c_local + (
+        jax.nn.sigmoid(i) * jnp.tanh(g)
+    )
+    h_local = jax.nn.sigmoid(o) * jnp.tanh(c_local)
+    return (h_local, c_local), h_local.astype(xp_t.dtype)
+
+
+def tp_gru_step(w_hh_l_t, b_hh_l, axis: str, h_local, xp_t):
+    """One gate-sharded GRU step (torch semantics: the hidden-side n-bias
+    joins inside the ``r *`` product, sliced like the weights); the tp
+    sibling of :func:`~pytorch_distributed_rnn_tpu.ops.rnn.gru_step`."""
+    h_full = lax.all_gather(h_local.astype(xp_t.dtype), axis,
+                            axis=1, tiled=True)
+    h_proj = (h_full @ w_hh_l_t + b_hh_l).astype(jnp.float32)
+    xr, xz, xn = jnp.split(xp_t.astype(jnp.float32), 3, axis=-1)
+    hr, hz, hn = jnp.split(h_proj, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    new = jnp.tanh(xn + r * hn)
+    h_local = (1.0 - z) * new + z * h_local
+    return h_local, h_local.astype(xp_t.dtype)
+
+
 def tp_lstm_layer(params, x, axis: str, *, unroll: int = 1,
                   compute_dtype=None):
     """One LSTM layer with the hidden dimension sharded over ``axis``, for
@@ -86,35 +134,16 @@ def tp_lstm_layer(params, x, axis: str, *, unroll: int = 1,
     per = hidden // n
     batch = x.shape[0]
 
-    local = {
-        "w_ih": shard_gates(params["w_ih"], n, k),
-        "w_hh": shard_gates(params["w_hh"], n, k),   # (4H/n, H)
-        "b_ih": shard_gates(params["b_ih"], n, k),
-        "b_hh": shard_gates(params["b_hh"], n, k),
-    }
-    local, x = _cast_local(local, x, compute_dtype)
+    local, x = sharded_gate_params(params, n, k, x,
+                                   compute_dtype=compute_dtype)
     x_proj = lstm_input_proj(local, x)               # (B, T, 4H/n)
     w_hh_l_t = local["w_hh"].T                       # (H, 4H/n)
-
-    def step(carry, xp_t):
-        h_local, c_local = carry                     # f32 slices
-        # the one per-step collective: reassemble full h for the
-        # recurrence - gathered in the compute dtype (half the ICI
-        # bytes under bf16)
-        h_full = lax.all_gather(h_local.astype(xp_t.dtype), axis,
-                                axis=1, tiled=True)
-        gates = (xp_t + h_full @ w_hh_l_t).astype(jnp.float32)
-        i, f, g, o = jnp.split(gates, 4, axis=-1)
-        c_local = jax.nn.sigmoid(f) * c_local + (
-            jax.nn.sigmoid(i) * jnp.tanh(g)
-        )
-        h_local = jax.nn.sigmoid(o) * jnp.tanh(c_local)
-        return (h_local, c_local), h_local.astype(xp_t.dtype)
 
     h0 = jnp.zeros((batch, per), jnp.float32)
     c0 = jnp.zeros((batch, per), jnp.float32)
     (h_t, c_t), out_local = lax.scan(
-        step, (h0, c0), jnp.swapaxes(x_proj, 0, 1), unroll=unroll
+        lambda c, xp: tp_lstm_step(w_hh_l_t, axis, c, xp),
+        (h0, c0), jnp.swapaxes(x_proj, 0, 1), unroll=unroll
     )
     out_local = jnp.swapaxes(out_local, 0, 1)        # (B, T, H/n)
     outputs = lax.all_gather(out_local, axis, axis=2, tiled=True)
@@ -159,33 +188,16 @@ def tp_gru_layer(params, x, axis: str, *, unroll: int = 1,
     per = hidden // n
     batch = x.shape[0]
 
-    local = {
-        "w_ih": shard_gates(params["w_ih"], n, k, num_gates=3),
-        "w_hh": shard_gates(params["w_hh"], n, k, num_gates=3),  # (3H/n, H)
-        "b_ih": shard_gates(params["b_ih"], n, k, num_gates=3),
-        "b_hh": shard_gates(params["b_hh"], n, k, num_gates=3),
-    }
-    local, x = _cast_local(local, x, compute_dtype)
+    local, x = sharded_gate_params(params, n, k, x, num_gates=3,
+                                   compute_dtype=compute_dtype)
     x_proj = gru_input_proj(local, x)                # (B, T, 3H/n)
     w_hh_l_t = local["w_hh"].T                       # (H, 3H/n)
     b_hh_l = local["b_hh"]
 
-    def step(h_local, xp_t):
-        # f32 carry; the gather and hidden matmul run in compute dtype
-        h_full = lax.all_gather(h_local.astype(xp_t.dtype), axis,
-                                axis=1, tiled=True)
-        h_proj = (h_full @ w_hh_l_t + b_hh_l).astype(jnp.float32)
-        xr, xz, xn = jnp.split(xp_t.astype(jnp.float32), 3, axis=-1)
-        hr, hz, hn = jnp.split(h_proj, 3, axis=-1)
-        r = jax.nn.sigmoid(xr + hr)
-        z = jax.nn.sigmoid(xz + hz)
-        new = jnp.tanh(xn + r * hn)
-        h_local = (1.0 - z) * new + z * h_local
-        return h_local, h_local.astype(xp_t.dtype)
-
     h0 = jnp.zeros((batch, per), jnp.float32)
     h_t, out_local = lax.scan(
-        step, h0, jnp.swapaxes(x_proj, 0, 1), unroll=unroll
+        lambda h, xp: tp_gru_step(w_hh_l_t, b_hh_l, axis, h, xp),
+        h0, jnp.swapaxes(x_proj, 0, 1), unroll=unroll
     )
     out_local = jnp.swapaxes(out_local, 0, 1)        # (B, T, H/n)
     outputs = lax.all_gather(out_local, axis, axis=2, tiled=True)

@@ -95,8 +95,11 @@ class MeshTrainer(SpmdTrainer):
                         "tp": axes.get("tp", 1)}
             self.model_axis = None
         else:
+            # the char family additionally composes sp x tp (gate-sharded
+            # cell inside the sp relay) -> model_axis "sp+tp"
             self.model_axis = validate_rnn_mesh(
-                axes, getattr(model, "cell", "lstm")
+                axes, getattr(model, "cell", "lstm"),
+                allow_sp_tp=self.is_char,
             )
         self.mesh_axes = axes
         self.schedule = schedule
@@ -127,7 +130,7 @@ class MeshTrainer(SpmdTrainer):
                     f"into pp={self.mesh_axes['pp']} stages"
                 )
         super().__init__(mesh=mesh, axis="dp", **kwargs)
-        if self.is_char and self.model_axis == "sp":
+        if self.is_char and self.model_axis in ("sp", "sp+tp"):
             window = self.training_set.features.shape[1]
             sp_size = self.mesh_axes["sp"]
             if window % sp_size:

@@ -34,3 +34,37 @@ import jax  # noqa: E402
 # takes effect as long as no backend has been initialized yet.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
+
+
+# ---------------------------------------------------------------------------
+# `pytest -m quick`: the <2-minute core signal.  One representative test
+# per strategy x family cell (the README matrix) plus the torch-parity
+# anchors - curated HERE so the selection lives in one place instead of
+# scattered marks.  The full suite stays the default.
+# ---------------------------------------------------------------------------
+
+QUICK_NODEIDS = (
+    # strategy coverage (motion family unless noted)
+    "test_training.py::TestLocalTrainer::test_loss_decreases",
+    "test_training.py::TestDistributedEquivalence::test_matches_local_exactly",
+    "test_fsdp_strategy.py::TestFsdpStrategy::test_matches_local_training_exactly",
+    "test_native_ddp.py::test_two_rank_world_trains_and_logs_perf_lines",
+    "test_param_server.py::TestEndToEnd::test_async_ps_trains",
+    "test_mesh_strategy.py::TestMeshTrainerEquivalence::test_matches_ddp[dp_sp]",
+    # family coverage
+    "test_char_rnn.py::test_lm_learns_structure",
+    "test_attention.py::test_attention_classifier_shapes_and_training",
+    "test_moe.py::test_moe_training_balances_and_learns",
+    # numerics anchors (torch parity + fused kernels)
+    "test_ops_parity.py",
+    "test_pallas_rnn.py::test_fused_forward_matches_scan",
+    "test_pallas_attention.py::TestForwardParity::test_matches_dense",
+)
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest as _pytest
+
+    for item in items:
+        if any(nid in item.nodeid for nid in QUICK_NODEIDS):
+            item.add_marker(_pytest.mark.quick)

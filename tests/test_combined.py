@@ -73,3 +73,164 @@ def test_3d_tp_indivisible_heads_raises(setup):
     with pytest.raises(ValueError, match="do not shard over tp"):
         jax.jit(make_3d_loss_fn(bad, mesh))(bad.init(jax.random.PRNGKey(3)),
                                             x, y)
+
+
+class TestSpTpRnn:
+    """The composed sp x tp RNN (gate-sharded cell inside the sp relay,
+    r4 - VERDICT r3 item 6): parity vs the unsharded stack, both cells,
+    plus the char-LM loss fn on the full dp x sp x tp mesh."""
+
+    B, T, IN, H = 4, 16, 5, 8
+
+    @pytest.mark.parametrize("cell", ["lstm", "gru"])
+    def test_matches_unsharded_stack(self, cell):
+        from functools import partial
+
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from pytorch_distributed_rnn_tpu.ops.rnn import (
+            init_stacked_rnn,
+            stacked_rnn,
+        )
+        from pytorch_distributed_rnn_tpu.parallel import make_mesh
+        from pytorch_distributed_rnn_tpu.parallel.combined import (
+            sp_tp_stacked_rnn,
+        )
+
+        mesh = make_mesh({"sp": 2, "tp": 2})
+        params = init_stacked_rnn(jax.random.PRNGKey(0), self.IN, self.H,
+                                  2, cell=cell)
+        x = jax.random.normal(jax.random.PRNGKey(1),
+                              (self.B, self.T, self.IN))
+
+        @partial(shard_map, mesh=mesh, in_specs=(P(), P(None, "sp")),
+                 out_specs=P(None, "sp", "tp"), check_vma=False)
+        def run(p, x_loc):
+            out_local, _ = sp_tp_stacked_rnn(p, x_loc, "sp", "tp",
+                                             cell=cell)
+            return out_local
+
+        out = jax.jit(run)(params, x)
+        ref, _ = stacked_rnn(params, x, cell, impl="scan")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("cell", ["lstm", "gru"])
+    def test_grads_match_unsharded(self, cell):
+        from functools import partial
+
+        from jax import lax, shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from pytorch_distributed_rnn_tpu.ops.rnn import (
+            init_stacked_rnn,
+            stacked_rnn,
+        )
+        from pytorch_distributed_rnn_tpu.parallel import make_mesh
+        from pytorch_distributed_rnn_tpu.parallel.combined import (
+            sp_tp_stacked_rnn,
+        )
+
+        mesh = make_mesh({"sp": 2, "tp": 2})
+        params = init_stacked_rnn(jax.random.PRNGKey(2), self.IN, self.H,
+                                  2, cell=cell)
+        x = jax.random.normal(jax.random.PRNGKey(3),
+                              (self.B, self.T, self.IN))
+
+        def loss_sp(p):
+            @partial(shard_map, mesh=mesh, in_specs=(P(), P(None, "sp")),
+                     out_specs=P(), check_vma=False)
+            def f(p, x_loc):
+                out_local, _ = sp_tp_stacked_rnn(p, x_loc, "sp", "tp",
+                                                 cell=cell)
+                return lax.psum(
+                    jnp.sum(out_local.astype(jnp.float32) ** 2),
+                    ("sp", "tp"),
+                )
+
+            return f(p, x)
+
+        g = jax.jit(jax.grad(loss_sp))(params)
+        gr = jax.grad(
+            lambda p: jnp.sum(stacked_rnn(p, x, cell, impl="scan")[0] ** 2)
+        )(params)
+        for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(g),
+            jax.tree_util.tree_leaves_with_path(gr),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5,
+                err_msg=jax.tree_util.keystr(pa),
+            )
+
+    def test_char_loss_fn_dp_sp_tp_matches_dp_only(self):
+        from pytorch_distributed_rnn_tpu.models import CharRNN
+        from pytorch_distributed_rnn_tpu.parallel import make_mesh
+        from pytorch_distributed_rnn_tpu.parallel.strategy import (
+            make_char_mesh_loss_fn,
+        )
+
+        lm = CharRNN(vocab_size=32, embed_dim=8, hidden_dim=8,
+                     layer_dim=2, impl="scan")
+        params = lm.init(jax.random.PRNGKey(0))
+        toks = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 32)
+        y = jnp.zeros(8, jnp.int32)
+        axes = {"dp": 2, "sp": 2, "tp": 2}
+        loss_fn = make_char_mesh_loss_fn(make_mesh(axes), axes)
+        (loss, _), grads = jax.jit(
+            jax.value_and_grad(loss_fn, has_aux=True)
+        )(params, toks, y)
+        axes1 = {"dp": 8}
+        loss_fn1 = make_char_mesh_loss_fn(make_mesh(axes1), axes1)
+        (l1, _), g1 = jax.jit(
+            jax.value_and_grad(loss_fn1, has_aux=True)
+        )(params, toks, y)
+        assert float(loss) == pytest.approx(float(l1), abs=1e-5)
+        for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(grads),
+            jax.tree_util.tree_leaves_with_path(g1),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5,
+                err_msg=jax.tree_util.keystr(pa),
+            )
+
+    def test_bf16_remat_compose(self):
+        """The composed pair takes the same levers as its parents: bf16
+        output tracks the unsharded bf16 stack; remat is exact."""
+        from functools import partial
+
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from pytorch_distributed_rnn_tpu.ops.rnn import (
+            init_stacked_rnn,
+            stacked_rnn,
+        )
+        from pytorch_distributed_rnn_tpu.parallel import make_mesh
+        from pytorch_distributed_rnn_tpu.parallel.combined import (
+            sp_tp_stacked_rnn,
+        )
+
+        mesh = make_mesh({"sp": 2, "tp": 2})
+        params = init_stacked_rnn(jax.random.PRNGKey(4), self.IN, self.H, 2)
+        x = jax.random.normal(jax.random.PRNGKey(5),
+                              (self.B, self.T, self.IN))
+
+        @partial(shard_map, mesh=mesh, in_specs=(P(), P(None, "sp")),
+                 out_specs=P(None, "sp", "tp"), check_vma=False)
+        def run(p, x_loc):
+            out_local, _ = sp_tp_stacked_rnn(
+                p, x_loc, "sp", "tp", compute_dtype=jnp.bfloat16,
+                remat=True,
+            )
+            return out_local.astype(jnp.float32)
+
+        out = jax.jit(run)(params, x)
+        ref, _ = stacked_rnn(params, x, "lstm", impl="scan",
+                             compute_dtype=jnp.bfloat16)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref, np.float32),
+            rtol=3e-2, atol=3e-2,
+        )

@@ -225,6 +225,15 @@ class TestCharMesh:
                 "--no-validation", "mesh", "--mesh", "dp=2,sp=2",
             ])
 
+    def test_mesh_char_sp_tp_composes(self, tmp_path, monkeypatch):
+        """The composed dp x sp x tp char mesh (gate-sharded cell inside
+        the sp relay, r4) reproduces the dp-only history exactly."""
+        monkeypatch.chdir(tmp_path)
+        c_hist = self._cli(tmp_path, "dp=2,sp=2,tp=2")["train_history"]
+        (tmp_path / "history.json").unlink()
+        dp_hist = self._cli(tmp_path, "dp=4")["train_history"]
+        assert c_hist == pytest.approx(dp_hist, rel=1e-4)
+
     def test_mesh_char_tp_bf16_close_to_dp_bf16(self, tmp_path,
                                                 monkeypatch):
         """bf16 threads through the tp gate-sharded stack since r4
