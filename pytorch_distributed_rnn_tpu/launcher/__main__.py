@@ -199,8 +199,10 @@ def main(argv=None):
     if args.task == "run-debug":
         runs = [bench.DEBUG_RUN]
     elif args.task == "run-chip":
-        # motion rows + the char-LM companion row in one resumable sweep
-        runs = [bench.CHIP_RUN, bench.CHIP_LM_RUN]
+        # motion rows + the amortized 20-epoch row + the char-LM
+        # companion row in one resumable sweep
+        runs = [bench.CHIP_RUN, bench.CHIP_AMORTIZED_RUN,
+                bench.CHIP_LM_RUN]
     elif args.task == "run-all":
         runs = [bench.BENCHMARK_RUN]
     elif args.task == "run-slots":

@@ -205,11 +205,15 @@ def _char_local_logits(params, tokens, *, sp=None, tp=None, pp=None,
     position (the final global position predicts nothing); the shifted
     target slice is local arithmetic because tokens are replicated, so no
     boundary exchange is needed.  Without ``sp``: full-window logits
-    (B, T-1, V), ``w_pos`` None.  ``compute_dtype``/``remat`` thread
-    through EVERY model-axis branch (sp relay, tp gate-sharded, pp GPipe
-    stages, unsharded); the head stays f32.  ``dropout`` applies on the
-    unsharded and ``sp`` branches only (each sp shard folds its index
-    into the dropout key); the tp/pp stacks have no dropout seam -
+    (B, T-1, V), ``w_pos`` None.  With BOTH ``sp`` and ``tp`` (the
+    composed char pair): the gate-sharded cell runs inside the sp relay
+    and the per-timestep head is row-parallel over tp.
+    ``compute_dtype``/``remat`` thread through EVERY model-axis branch
+    (sp relay, sp x tp, tp gate-sharded, pp GPipe stages, unsharded);
+    the head stays f32.  ``dropout`` applies on the unsharded, ``sp``,
+    and ``sp x tp`` branches (each sp shard folds its index into the
+    dropout key; the composed relay masks the gathered full-width
+    interlayer seam); the tp-only/pp stacks have no dropout seam -
     callers reject that combination loudly.
     """
     if pp is not None and (sp is not None or tp is not None):
@@ -378,6 +382,11 @@ def _reject_unsupported_mesh_levers(model_axis, precision: str,
     Honoring those flag combinations is not possible, so do not pretend
     to."""
     del precision, remat  # every model axis honors both since r4
+    # NOTE: the composed "sp+tp" axis always relays layer-sequentially
+    # (the gate-sharded chunk scan has no wavefront form); like the GRU,
+    # the wavefront DEFAULT coerces to sequential there rather than
+    # rejecting - --sp-schedule only ever selects among schedules that
+    # exist for the cell/composition (see _sp_stack).
     if model_axis in ("tp", "pp") and dropout > 0.0:
         raise ValueError(
             f"dropout is not supported on the {model_axis} mesh (the "
@@ -405,7 +414,7 @@ def make_char_mesh_train_step(optimizer, mesh, axes: dict[str, int], *,
 
     ``step(params, opt_state, tokens)`` with ``tokens`` (B, T) sharded
     ``P("dp")`` on batch; params/opt replicated.  The model axis (sp, tp,
-    or pp - at most one) comes from ``axes``.
+    pp, or the composed sp x tp pair) comes from ``axes``.
 
     The gradient is taken OUTSIDE the ``shard_map`` (like
     ``parallel/combined.py``): differentiating the replicated-scalar loss

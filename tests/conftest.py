@@ -65,6 +65,23 @@ QUICK_NODEIDS = (
 def pytest_collection_modifyitems(config, items):
     import pytest as _pytest
 
+    matched = set()
     for item in items:
-        if any(nid in item.nodeid for nid in QUICK_NODEIDS):
-            item.add_marker(_pytest.mark.quick)
+        for nid in QUICK_NODEIDS:
+            if nid in item.nodeid:
+                item.add_marker(_pytest.mark.quick)
+                matched.add(nid)
+    # a rename must FAIL the run, not silently shrink the quick suite;
+    # only enforce for fragments whose FILE was collected, so running a
+    # subset (pytest tests/test_pp.py) never trips over other files
+    item_files = {item.nodeid.split("::")[0].rsplit("/", 1)[-1]
+                  for item in items}
+    missing = [
+        nid for nid in QUICK_NODEIDS
+        if nid not in matched and nid.split("::")[0] in item_files
+    ]
+    if missing:
+        raise _pytest.UsageError(
+            f"QUICK_NODEIDS entries match no collected test (renamed?): "
+            f"{missing}"
+        )
