@@ -171,7 +171,8 @@ def char50m_tokens_per_sec(precision: str, batch: int = 32,
 
 def attention_throughput(batch: int = 256, steps: int = 30,
                          seq_len: int = SEQ_LEN,
-                         impl: str = "auto") -> float:
+                         impl: str = "auto",
+                         precision: str = "f32") -> float:
     """seq/s training the attention classifier on HAR-shaped windows -
     the long-context family's single-chip baseline number (its sp/tp mesh
     composition is compile-validated by dryrun_multichip; ring-attention
@@ -189,7 +190,8 @@ def attention_throughput(batch: int = 256, steps: int = 30,
 
     model = AttentionClassifier(input_dim=NUM_FEATURES, dim=128, depth=2,
                                 num_heads=4, output_dim=6,
-                                max_len=seq_len, impl=impl)
+                                max_len=seq_len, impl=impl,
+                                precision=precision)
     params = model.init(jax.random.PRNGKey(0))
     opt = optax.adam(1e-3)
     opt_state = opt.init(params)
@@ -398,6 +400,10 @@ def main():
                     lambda: round(attention_throughput(
                         batch=64, steps=15, seq_len=1024,
                         impl="flash"), 1))
+            attempt("attention_flash_bf16_seq1024_seq_per_sec",
+                    lambda: round(attention_throughput(
+                        batch=64, steps=15, seq_len=1024,
+                        impl="flash", precision="bf16"), 1))
         else:
             extras["char_rnn_50m"] = "skipped: no TPU"
             extras["attention"] = "skipped: no TPU"

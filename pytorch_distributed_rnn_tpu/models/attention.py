@@ -21,9 +21,14 @@ from pytorch_distributed_rnn_tpu.ops.initializers import linear_init
 
 
 def _layer_norm(x, scale, bias, eps=1e-5):
-    mean = jnp.mean(x, axis=-1, keepdims=True)
-    var = jnp.var(x, axis=-1, keepdims=True)
-    return (x - mean) * jax.lax.rsqrt(var + eps) * scale + bias
+    # stats in f32 regardless of the compute dtype (bf16 mean/var loses
+    # the small differences normalization exists to measure); the affine
+    # output follows the input dtype.  All casts are no-ops in pure f32.
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = ((xf - mean) * jax.lax.rsqrt(var + eps)).astype(x.dtype)
+    return y * scale + bias
 
 
 def init_block(key, dim: int, num_heads: int, mlp_ratio: int = 4):
@@ -126,6 +131,11 @@ class AttentionClassifier:
     impl: str = "auto"  # "dense" | "flash" (Pallas) | "auto" (flash on
     # TPU) - only governs the default attention; an injected ring/Ulysses
     # callable (sequence-parallel strategies) takes precedence
+    precision: str = "f32"  # "bf16": block params + activations in
+    # bfloat16 (full MXU rate, half the HBM traffic); layernorm stats
+    # and the pooled head stay f32 (the RNN families' lever contract)
+    remat: bool = False  # recompute each encoder block during backward
+    # (jax.checkpoint per block) instead of saving its activations
 
     def __post_init__(self):
         if self.dim % self.num_heads != 0:
@@ -166,10 +176,26 @@ class AttentionClassifier:
 
             if resolve_attention_impl(self.impl) == "flash":
                 attention = lambda q, k, v: flash_attention(q, k, v)  # noqa: E731
+        compute_dtype = (jnp.bfloat16 if self.precision == "bf16"
+                         else None)
+        if compute_dtype is not None:
+            h = h.astype(compute_dtype)
+        def block_fn(blk, h, blk_key):
+            return apply_block(blk, h, self.num_heads, attention,
+                               dropout=self.dropout, dropout_key=blk_key)
+
+        if self.remat:
+            # num_heads/attention/dropout ride the closure (they are
+            # static); only arrays (and the optional key) are traced
+            block_fn = jax.checkpoint(block_fn)
         for i, blk in enumerate(params["blocks"]):
             blk_key = (None if dropout_key is None
                        else jax.random.fold_in(dropout_key, i))
-            h = apply_block(blk, h, self.num_heads, attention,
-                            dropout=self.dropout, dropout_key=blk_key)
-        pooled = jnp.mean(h, axis=1)
+            if compute_dtype is not None:
+                blk = jax.tree.map(
+                    lambda p: p.astype(compute_dtype), blk
+                )
+            h = block_fn(blk, h, blk_key)
+        # pooled head in f32 regardless of compute dtype (model contract)
+        pooled = jnp.mean(h.astype(jnp.float32), axis=1)
         return _linear(params["head"], pooled)

@@ -90,18 +90,10 @@ def build_model(args, training_set):
     if fam == "attention":
         from pytorch_distributed_rnn_tpu.models import AttentionClassifier
 
-        unsupported = [
-            flag for flag, active in (
-                ("--precision bf16",
-                 getattr(args, "precision", "f32") != "f32"),
-                ("--remat", getattr(args, "remat", False)),
-                ("--cell gru", getattr(args, "cell", "lstm") != "lstm"),
-            ) if active
-        ]
-        if unsupported:
+        if getattr(args, "cell", "lstm") != "lstm":
             raise SystemExit(
-                f"--model attention does not support: "
-                f"{', '.join(unsupported)}"
+                "--model attention does not support: --cell gru "
+                "(the encoder has no recurrent cell)"
             )
         return AttentionClassifier(
             input_dim=training_set.num_features,
@@ -110,6 +102,8 @@ def build_model(args, training_set):
             num_heads=getattr(args, "num_heads", 4),
             output_dim=len(MotionDataset.LABELS),
             dropout=getattr(args, "dropout", 0.0) or 0.0,
+            precision=getattr(args, "precision", "f32"),
+            remat=getattr(args, "remat", False),
         )
     if fam == "moe":
         from pytorch_distributed_rnn_tpu.models import MoEClassifier

@@ -50,13 +50,23 @@ def _rungs(quick: bool):
 
         return run
 
-    def lm_step(batch, seq, hidden, accum=1):
+    def lm_step(batch, seq, accum=1, wide=False):
         def run():
             from pytorch_distributed_rnn_tpu.models import CharRNN
+            from pytorch_distributed_rnn_tpu.models.char_rnn import (
+                char_rnn_50m,
+            )
 
-            lm = CharRNN(vocab_size=256, embed_dim=hidden,
-                         hidden_dim=hidden, layer_dim=2,
-                         precision="bf16", impl="scan")
+            if wide:
+                # the 55M MFU-ceiling shape variant (2 x 2048)
+                lm = CharRNN(vocab_size=256, embed_dim=512,
+                             hidden_dim=2048, layer_dim=2,
+                             precision="bf16", impl="scan")
+            else:
+                # the EXACT bench model that produced the HTTP 500
+                # (bench.py char50m_tokens_per_sec: 512/1280/4, auto
+                # impl -> fused Pallas kernel on TPU)
+                lm = char_rnn_50m(precision="bf16")
             params = lm.init(jax.random.PRNGKey(0))
             opt = optax.adam(1e-3)
             state = opt.init(params)
@@ -93,14 +103,15 @@ def _rungs(quick: bool):
     if quick:
         return rungs
     rungs += [
-        # the real 50M-class training step, batch laddered through 512;
-        # seq variants hold tokens-per-step constant across the 512 rung
-        ("lm50m_b256_seq128", lm_step(256, 128, 1024)),
-        ("lm50m_b512_seq64", lm_step(512, 64, 1024)),
-        ("lm50m_b512_seq128", lm_step(512, 128, 1024)),   # the failer
-        ("lm50m_b512_seq128_accum2", lm_step(512, 128, 1024, accum=2)),
-        ("lm_wide_b512_seq128_h2048_L", lm_step(512, 128, 2048)),
-        ("lm50m_b1024_seq128", lm_step(1024, 128, 1024)),
+        # the EXACT bench model (char_rnn_50m: 512/1280/4), batch
+        # laddered through 512; seq variants hold tokens-per-step
+        # constant across the 512 rung
+        ("lm50m_b256_seq128", lm_step(256, 128)),
+        ("lm50m_b512_seq64", lm_step(512, 64)),
+        ("lm50m_b512_seq128", lm_step(512, 128)),   # the failer
+        ("lm50m_b512_seq128_accum2", lm_step(512, 128, accum=2)),
+        ("lm_wide_b512_seq128_2x2048", lm_step(512, 128, wide=True)),
+        ("lm50m_b1024_seq128", lm_step(1024, 128)),
     ]
     return rungs
 
@@ -115,7 +126,6 @@ def main(argv=None):
 
     backend = jax.default_backend()
     print(f"backend: {backend} devices: {jax.devices()}")
-    rows = []
     for name, build in _rungs(args.quick):
         start = time.perf_counter()
         try:
@@ -127,11 +137,12 @@ def main(argv=None):
         dt = round(time.perf_counter() - start, 1)
         row = {"rung": name, "status": status, "seconds": dt,
                "backend": backend, "error": err}
-        rows.append(row)
-        print(f"{name}: {status} ({dt}s)" + (f" {err}" if err else ""))
-    with open(args.results, "a") as f:
-        for row in rows:
+        # append-per-rung: a wedged compile that has to be killed still
+        # leaves every completed verdict on disk (tunnel windows are
+        # scarce; re-acquiring them is expensive)
+        with open(args.results, "a") as f:
             f.write(json.dumps(row) + "\n")
+        print(f"{name}: {status} ({dt}s)" + (f" {err}" if err else ""))
     print(f"-> {args.results}")
     return 0
 

@@ -119,3 +119,107 @@ def test_cli_precision_flag():
     args = build_parser().parse_args(["--precision", "bf16", "--remat",
                                       "local"])
     assert args.precision == "bf16" and args.remat is True
+
+
+class TestAttentionPrecision:
+    """bf16 + remat for the attention family (r4): the encoder blocks
+    take the same levers as the RNN families - bf16 block params and
+    activations with f32 layernorm stats and head, per-block
+    checkpointing."""
+
+    def _model(self, **kw):
+        from pytorch_distributed_rnn_tpu.models import AttentionClassifier
+
+        return AttentionClassifier(input_dim=9, dim=32, depth=2,
+                                   num_heads=2, impl="dense", **kw)
+
+    def test_bf16_tracks_f32(self):
+        m32 = self._model()
+        m16 = self._model(precision="bf16")
+        params = m32.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 24, 9))
+        l32 = m32.apply(params, x)
+        l16 = m16.apply(params, x)
+        assert l16.dtype == jnp.float32  # head stays f32
+        np.testing.assert_allclose(np.asarray(l16), np.asarray(l32),
+                                   rtol=5e-2, atol=5e-2)
+
+    def test_remat_is_exact(self):
+        m = self._model()
+        mr = self._model(remat=True)
+        params = m.init(jax.random.PRNGKey(2))
+        x = jax.random.normal(jax.random.PRNGKey(3), (4, 24, 9))
+
+        def loss(model, p):
+            return jnp.sum(model.apply(p, x) ** 2)
+
+        l0, g0 = jax.jit(jax.value_and_grad(lambda p: loss(m, p)))(params)
+        l1, g1 = jax.jit(jax.value_and_grad(lambda p: loss(mr, p)))(params)
+        np.testing.assert_allclose(float(l1), float(l0), rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_bf16_remat_training_converges(self):
+        import optax
+
+        from pytorch_distributed_rnn_tpu.ops import cross_entropy_loss
+
+        model = self._model(precision="bf16", remat=True)
+        params = model.init(jax.random.PRNGKey(4))
+        opt = optax.adam(1e-3)
+        state = opt.init(params)
+        x = jax.random.normal(jax.random.PRNGKey(5), (16, 24, 9))
+        y = jax.random.randint(jax.random.PRNGKey(6), (16,), 0, 6)
+
+        @jax.jit
+        def step(p, s):
+            loss, g = jax.value_and_grad(
+                lambda p: cross_entropy_loss(model.apply(p, x), y)
+            )(p)
+            updates, s = opt.update(g, s, p)
+            return optax.apply_updates(p, updates), s, loss
+
+        params, state, first = step(params, state)
+        for _ in range(30):
+            params, state, last = step(params, state)
+        assert float(last) < float(first)
+        # params stay f32 (full-precision optimizer state)
+        assert all(
+            leaf.dtype == jnp.float32
+            for leaf in jax.tree.leaves(params)
+        )
+
+    def test_cli_accepts_attention_bf16_remat(self):
+        from pytorch_distributed_rnn_tpu.main import build_parser
+        from pytorch_distributed_rnn_tpu.training.families import (
+            build_model,
+        )
+
+        class FakeSet:
+            num_features = 9
+
+        args = build_parser().parse_args([
+            "--model", "attention", "--precision", "bf16", "--remat",
+            "local",
+        ])
+        model = build_model(args, FakeSet())
+        assert model.precision == "bf16" and model.remat is True
+
+    def test_attention_mesh_rejects_bf16(self):
+        import pytest
+
+        from pytorch_distributed_rnn_tpu.data.synthetic import (
+            generate_har_arrays,
+        )
+        from pytorch_distributed_rnn_tpu.data import MotionDataset
+        from pytorch_distributed_rnn_tpu.training.mesh import MeshTrainer
+
+        X, y = generate_har_arrays(48, seq_length=16, seed=0)
+        with pytest.raises(NotImplementedError, match="bf16"):
+            MeshTrainer(
+                mesh_axes={"dp": 2, "sp": 2},
+                model=self._model(precision="bf16"),
+                training_set=MotionDataset(X, y), batch_size=24,
+                learning_rate=1e-3, seed=1,
+            )
