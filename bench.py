@@ -169,10 +169,29 @@ def char50m_tokens_per_sec(precision: str, batch: int = 32,
     return tokens_per_sec, mfu
 
 
+def attention_flops_per_seq(dim: int, depth: int, seq_len: int,
+                            input_dim: int = NUM_FEATURES,
+                            mlp_ratio: int = 4) -> float:
+    """Training FLOPs per sequence for the attention classifier: per
+    block 2*MACs for QKV/output projections (4 * T * D^2), the two
+    attention matmuls (2 * T^2 * D), and the MLP (2 * T * D * 4D each
+    way); embed + head are negligible but counted.  Backward ~2x forward
+    (the standard 3x estimate; flash recompute adds ~1 more forward of
+    the attention core, not counted - MFU reads conservative)."""
+    t, d = seq_len, dim
+    per_block = (
+        2.0 * 4 * t * d * d          # QKV + output projections
+        + 2.0 * 2 * t * t * d        # QK^T and PV
+        + 2.0 * 2 * t * d * (mlp_ratio * d)  # fc1 + fc2
+    )
+    fwd = depth * per_block + 2.0 * t * input_dim * d + 2.0 * d * 6
+    return 3.0 * fwd
+
+
 def attention_throughput(batch: int = 256, steps: int = 30,
                          seq_len: int = SEQ_LEN,
                          impl: str = "auto",
-                         precision: str = "f32") -> float:
+                         precision: str = "f32"):
     """seq/s training the attention classifier on HAR-shaped windows -
     the long-context family's single-chip baseline number (its sp/tp mesh
     composition is compile-validated by dryrun_multichip; ring-attention
@@ -180,7 +199,9 @@ def attention_throughput(batch: int = 256, steps: int = 30,
     window probes the dense-attention long-context regime one chip can
     measure (quadratic attention FLOPs start to dominate ~1k).  ``impl``
     selects the attention inner: ``dense`` XLA vs the fused ``flash``
-    Pallas kernel (``auto`` = flash on TPU)."""
+    Pallas kernel (``auto`` = flash on TPU).  Returns ``(seq/s, mfu)``
+    with MFU derived from the constructed model's own fields (the
+    char50m pattern), so tuning the probe shape cannot desync them."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -215,7 +236,12 @@ def attention_throughput(batch: int = 256, steps: int = 30,
     for _ in range(steps):
         params, opt_state, loss = step(params, opt_state, x, y)
     float(loss)  # host fetch closes the timed region (see char50m note)
-    return steps * batch / (time.perf_counter() - start)
+    seq_per_sec = steps * batch / (time.perf_counter() - start)
+    mfu = (seq_per_sec
+           * attention_flops_per_seq(model.dim, model.depth, seq_len,
+                                     input_dim=model.input_dim)
+           / V5E_BF16_PEAK_FLOPS)
+    return seq_per_sec, mfu
 
 
 def main():
@@ -388,22 +414,24 @@ def main():
             # dense vs fused flash kernel at the HAR window and at 8x it:
             # the flash/dense ratio is the attention family's kernel win
             # (quadratic dense attention starts to dominate ~1k)
-            attempt("attention_seq_per_sec",
-                    lambda: round(attention_throughput(impl="dense"), 1))
-            attempt("attention_flash_seq_per_sec",
-                    lambda: round(attention_throughput(impl="flash"), 1))
-            attempt("attention_seq1024_seq_per_sec",
-                    lambda: round(attention_throughput(
-                        batch=64, steps=15, seq_len=1024,
-                        impl="dense"), 1))
-            attempt("attention_flash_seq1024_seq_per_sec",
-                    lambda: round(attention_throughput(
-                        batch=64, steps=15, seq_len=1024,
-                        impl="flash"), 1))
-            attempt("attention_flash_bf16_seq1024_seq_per_sec",
-                    lambda: round(attention_throughput(
-                        batch=64, steps=15, seq_len=1024,
-                        impl="flash", precision="bf16"), 1))
+            def _attn_row(seq_len, **kw):
+                seq_s, mfu = attention_throughput(seq_len=seq_len, **kw)
+                return {"seq_per_sec": round(seq_s, 1),
+                        "mfu_vs_v5e_bf16_peak": round(mfu, 4)}
+
+            attempt("attention_seq128_dense",
+                    lambda: _attn_row(SEQ_LEN, impl="dense"))
+            attempt("attention_seq128_flash",
+                    lambda: _attn_row(SEQ_LEN, impl="flash"))
+            attempt("attention_seq1024_dense",
+                    lambda: _attn_row(1024, batch=64, steps=15,
+                                      impl="dense"))
+            attempt("attention_seq1024_flash",
+                    lambda: _attn_row(1024, batch=64, steps=15,
+                                      impl="flash"))
+            attempt("attention_seq1024_flash_bf16",
+                    lambda: _attn_row(1024, batch=64, steps=15,
+                                      impl="flash", precision="bf16"))
         else:
             extras["char_rnn_50m"] = "skipped: no TPU"
             extras["attention"] = "skipped: no TPU"
