@@ -171,6 +171,7 @@ def char50m_tokens_per_sec(precision: str, batch: int = 32,
 
 def attention_flops_per_seq(dim: int, depth: int, seq_len: int,
                             input_dim: int = NUM_FEATURES,
+                            output_dim: int = 6,
                             mlp_ratio: int = 4) -> float:
     """Training FLOPs per sequence for the attention classifier: per
     block 2*MACs for QKV/output projections (4 * T * D^2), the two
@@ -184,7 +185,7 @@ def attention_flops_per_seq(dim: int, depth: int, seq_len: int,
         + 2.0 * 2 * t * t * d        # QK^T and PV
         + 2.0 * 2 * t * d * (mlp_ratio * d)  # fc1 + fc2
     )
-    fwd = depth * per_block + 2.0 * t * input_dim * d + 2.0 * d * 6
+    fwd = depth * per_block + 2.0 * t * input_dim * d + 2.0 * d * output_dim
     return 3.0 * fwd
 
 
@@ -237,9 +238,13 @@ def attention_throughput(batch: int = 256, steps: int = 30,
         params, opt_state, loss = step(params, opt_state, x, y)
     float(loss)  # host fetch closes the timed region (see char50m note)
     seq_per_sec = steps * batch / (time.perf_counter() - start)
+    # mlp_ratio mirrors init_block's fixed default (models/attention.py:
+    # init_block) - the one block hyperparameter the model class does not
+    # expose, so it cannot be tuned out of sync from here
     mfu = (seq_per_sec
            * attention_flops_per_seq(model.dim, model.depth, seq_len,
-                                     input_dim=model.input_dim)
+                                     input_dim=model.input_dim,
+                                     output_dim=model.output_dim)
            / V5E_BF16_PEAK_FLOPS)
     return seq_per_sec, mfu
 

@@ -160,7 +160,8 @@ def matrix_configs(extra_parameters=None, backend="cpu"):
     rows = []
     for family, fam_params, meshes in (
         ("rnn", {}, ["mesh --mesh dp=2,sp=2 --sp-schedule sequential"]),
-        ("char", {"seq-length": 15}, ["mesh --mesh dp=2,sp=2"]),
+        ("char", {"seq-length": 15}, ["mesh --mesh dp=2,sp=2",
+                                      "mesh --mesh dp=2,sp=2,tp=2"]),
         ("attention", {}, ["mesh --mesh dp=2,sp=2,tp=2",
                            "mesh --mesh dp=2,pp=2"]),
         ("moe", {}, ["mesh --mesh dp=2,ep=2"]),

@@ -370,32 +370,156 @@ def pp_schedule_stats(num_stages: int, num_microbatches: int,
     }
 
 
-def pp_rnn_1f1b_value_and_grad(layers, head, x, y, axis: str, *,
-                               num_microbatches: int, unroll: int = 1,
-                               cell: str = "lstm", compute_dtype=None,
-                               sample_weights=None):
-    """Self-differentiating 1F1B pipeline for the motion family, for use
-    inside ``shard_map`` over the ``pp`` axis.
+def _pp_1f1b_engine(axis: str, *, num_microbatches: int, diff_params,
+                    stage0_input, stage_apply, last_loss,
+                    bm: int, t_len: int, width: int, hidden: int, dtype):
+    """The generic self-differentiating 1F1B tick loop shared by the
+    motion and char families.
 
-    Runs the combined forward+backward 1F1B timetable explicitly: each
-    tick a stage performs (masked SPMD) its scheduled forward - stashing
-    the stage INPUT, the only activation kept per in-flight microbatch -
+    Runs the combined forward+backward timetable explicitly: each tick a
+    stage performs (masked SPMD) its scheduled forward - stashing the
+    stage INPUT, the only activation kept per in-flight microbatch -
     and/or its scheduled backward, which recomputes the stage via
     ``jax.vjp`` at the stashed input and chains the cotangent upstream.
     Activation memory is bounded by the 1F1B in-flight limit (<= S
     microbatch inputs per stage) instead of GPipe's all-M.
 
-    Returns ``(loss_sum, correct_sum, w_sum, grads)``: the weighted NLL
-    sum, correct-count and weight total (all banked at the last stage and
-    replicated over ``pp`` - divide loss/grads by ``w_sum`` for mean
-    semantics), and ``grads``, a params-tree cotangent for ``{"rnn":
-    layers, "fc": head}`` containing THIS STAGE's contribution only - the
+    - ``diff_params``: pytree (tuple) of everything differentiated.
+    - ``stage0_input(diff_params, m) -> (bm, t_len, width)``: microbatch
+      ``m``'s entry activation.  It re-evaluates INSIDE the vjp so params
+      feeding the entry (the char embedding) get exact gradients.
+    - ``stage_apply(diff_params, acts) -> (bm, t_len, hidden)``: this
+      stage's layers (traced stage index via closure).
+    - ``last_loss(diff_params, acts, m) -> (loss_sum, correct, w_sum)``:
+      the last stage's head + loss for microbatch ``m`` (weighted sums).
+
+    Returns ``(loss_sum, correct_sum, w_sum, grads)`` - sums banked at
+    the last stage and replicated over ``pp``; ``grads`` mirrors
+    ``diff_params`` and contains THIS STAGE's contribution only (the
     caller's ``custom_vjp`` hands it to shard_map's replicated-param
-    transpose, which sums over the mesh.  ``sample_weights`` (B,) marks
-    padded rows of a partial batch (the weighted trainer path).
+    transpose, which sums over the mesh).
     """
     n = lax.axis_size(axis)
     idx = lax.axis_index(axis)
+    M = num_microbatches
+
+    fwd_np, bwd_np = simulate_1f1b_schedule(n, M)
+    fwd_sched = jnp.asarray(fwd_np)
+    bwd_sched = jnp.asarray(bwd_np)
+    # receive flags: stage s gets an activation when s-1 ran a forward
+    # this tick, a cotangent when s+1 ran a backward
+    recv_f = jnp.asarray(
+        jnp.roll(jnp.asarray(fwd_np >= 0), 1, axis=1).at[:, 0].set(False))
+    recv_b = jnp.asarray(
+        jnp.roll(jnp.asarray(bwd_np >= 0), -1, axis=1).at[:, -1].set(False))
+    TT = fwd_np.shape[0]
+    K = min(n, M)  # 1F1B in-flight bound -> stash ring size
+    is_last = idx == n - 1
+    is_first = idx == 0
+
+    def full(dp, a, m):
+        inp = jnp.where(is_first, stage0_input(dp, m), a)
+        acts = stage_apply(dp, inp)
+        # only the last stage pays the head: for the char family the
+        # per-timestep vocab head rivals an RNN layer, so a cond (legal -
+        # last_loss has no collectives) beats compute-then-mask
+        loss_m = lax.cond(
+            is_last,
+            lambda: last_loss(dp, acts, m)[0],
+            lambda: jnp.float32(0.0),
+        )
+        return acts, loss_m
+
+    def tick(carry, tk):
+        (fwd_buf, bwd_buf, stash, grads, loss_sum, correct_sum,
+         w_sum) = carry
+        m_f = fwd_sched[tk, idx]
+        m_b = bwd_sched[tk, idx]
+        f_active = m_f >= 0
+        b_active = m_b >= 0
+        m_f_safe = jnp.clip(m_f, 0, M - 1)
+        m_b_safe = jnp.clip(m_b, 0, M - 1)
+
+        # ---- backward op: read the stash BEFORE the forward writes it
+        stash_in = lax.dynamic_index_in_dim(stash, m_b_safe % K,
+                                            keepdims=False)
+        (_, _), vjp_fn = jax.vjp(
+            lambda dp, a: full(dp, a, m_b_safe), diff_params, stash_in,
+        )
+        b_mask = b_active.astype(jnp.float32)
+        # the buffered cotangent is W-wide (it is d(next stage's padded
+        # input)); this stage's acts are H-wide - take the H slice
+        cot_acts = (jnp.where(is_last, 0.0, 1.0) * b_mask
+                    * bwd_buf[..., :hidden])
+        cot_loss = jnp.where(is_last, 1.0, 0.0) * b_mask
+        d_params, d_acts = vjp_fn((cot_acts.astype(dtype), cot_loss))
+        grads = jax.tree.map(
+            lambda g, d: g + b_mask * d.astype(jnp.float32),
+            grads, d_params,
+        )
+
+        # ---- forward op
+        inp = jnp.where(
+            is_first, stage0_input(diff_params, m_f_safe), fwd_buf
+        )
+        stash = jnp.where(
+            f_active,
+            lax.dynamic_update_index_in_dim(stash, inp, m_f_safe % K,
+                                            axis=0),
+            stash,
+        )
+        acts = stage_apply(diff_params, inp)
+        # loss/metrics bank at the last stage's forward (value only);
+        # same cond: non-last stages skip the head entirely
+        loss_m, correct_m, wsum_m = lax.cond(
+            is_last,
+            lambda: last_loss(diff_params, acts, m_f_safe),
+            lambda: (jnp.float32(0.0), jnp.float32(0.0),
+                     jnp.float32(0.0)),
+        )
+        bank = (f_active & is_last).astype(jnp.float32)
+        loss_sum = loss_sum + bank * loss_m
+        correct_sum = correct_sum + bank * correct_m
+        w_sum = w_sum + bank * wsum_m
+
+        # ---- communicate (capacity-1 buffers, schedule-gated receive)
+        perm_f = [(i, (i + 1) % n) for i in range(n)]
+        perm_b = [(i, (i - 1) % n) for i in range(n)]
+        acts_hop = lax.ppermute(_pad_last(acts, width), axis, perm_f)
+        dacts_hop = lax.ppermute(d_acts, axis, perm_b)
+        fwd_buf = jnp.where(recv_f[tk, idx], acts_hop, fwd_buf)
+        bwd_buf = jnp.where(
+            recv_b[tk, idx],
+            dacts_hop.astype(jnp.float32)[..., :width],
+            bwd_buf,
+        )
+        return (fwd_buf, bwd_buf, stash, grads, loss_sum, correct_sum,
+                w_sum), None
+
+    zeros_f32 = lambda t_: jax.tree.map(  # noqa: E731
+        lambda p: jnp.zeros(p.shape, jnp.float32), t_)
+    carry0 = (
+        jnp.zeros((bm, t_len, width), dtype),
+        jnp.zeros((bm, t_len, width), jnp.float32),
+        jnp.zeros((K, bm, t_len, width), dtype),
+        zeros_f32(diff_params),
+        jnp.float32(0.0),
+        jnp.float32(0.0),
+        jnp.float32(0.0),
+    )
+    (_, _, _, grads, loss_sum, correct_sum, w_sum), _ = lax.scan(
+        tick, carry0, jnp.arange(TT)
+    )
+
+    # loss/metrics live on the last stage; replicate over pp
+    loss_sum = broadcast_from(loss_sum, axis, n - 1)
+    correct_sum = broadcast_from(correct_sum, axis, n - 1)
+    w_sum = broadcast_from(w_sum, axis, n - 1)
+    return loss_sum, correct_sum, w_sum, grads
+
+
+def _check_1f1b_shapes(layers, axis, num_microbatches, batch, cell):
+    n = lax.axis_size(axis)
     L = len(layers)
     if L % n != 0:
         raise ValueError(f"{L} layers do not split into {n} stages")
@@ -409,11 +533,35 @@ def pp_rnn_1f1b_value_and_grad(layers, head, x, y, axis: str, *,
             f"cell={cell!r} expects {expected}H-wide gates but the params "
             f"tree carries {gates}H - wrong cell for this tree"
         )
-    per_stage = L // n
+    if batch % num_microbatches != 0:
+        raise ValueError(
+            f"batch {batch} not divisible into {num_microbatches} "
+            f"microbatches"
+        )
+    return n, L // n
+
+
+def pp_rnn_1f1b_value_and_grad(layers, head, x, y, axis: str, *,
+                               num_microbatches: int, unroll: int = 1,
+                               cell: str = "lstm", compute_dtype=None,
+                               sample_weights=None):
+    """Self-differentiating 1F1B pipeline for the motion family, for use
+    inside ``shard_map`` over the ``pp`` axis (the
+    :func:`_pp_1f1b_engine` timetable with the last-step classification
+    head).
+
+    Returns ``(loss_sum, correct_sum, w_sum, grads)``: the weighted NLL
+    sum, correct-count and weight total (all banked at the last stage and
+    replicated over ``pp`` - divide loss/grads by ``w_sum`` for mean
+    semantics), and ``grads``, a params-tree cotangent for ``{"rnn":
+    layers, "fc": head}`` containing THIS STAGE's contribution only.
+    ``sample_weights`` (B,) marks padded rows of a partial batch (the
+    weighted trainer path).
+    """
     M = num_microbatches
+    idx = lax.axis_index(axis)
     batch, t, in_dim = x.shape
-    if batch % M != 0:
-        raise ValueError(f"batch {batch} not divisible into {M} microbatches")
+    _, per_stage = _check_1f1b_shapes(layers, axis, M, batch, cell)
     bm = batch // M
     hidden = layers[0]["w_hh"].shape[1]
     width = max(in_dim, hidden)
@@ -428,133 +576,116 @@ def pp_rnn_1f1b_value_and_grad(layers, head, x, y, axis: str, *,
         x_micro = x_micro.astype(compute_dtype)
     dtype = x_micro.dtype
 
-    fwd_np, bwd_np = simulate_1f1b_schedule(n, M)
-    fwd_sched = jnp.asarray(fwd_np)
-    bwd_sched = jnp.asarray(bwd_np)
-    # receive flags: stage s gets an activation when s-1 ran a forward
-    # this tick, a cotangent when s+1 ran a backward
-    recv_f_np = jnp.asarray(
-        jnp.roll(jnp.asarray(fwd_np >= 0), 1, axis=1).at[:, 0].set(False))
-    recv_b_np = jnp.asarray(
-        jnp.roll(jnp.asarray(bwd_np >= 0), -1, axis=1).at[:, -1].set(False))
-    TT = fwd_np.shape[0]
-    K = min(n, M)  # 1F1B in-flight bound -> stash ring size
+    def stage0_input(dp, m):
+        return lax.dynamic_index_in_dim(x_micro, m, keepdims=False)
 
-    is_last = idx == n - 1
-
-    def run_stage(stk, acts):
+    def stage_apply(dp, acts):
+        stk, _ = dp
         for j in range(per_stage):
             acts = _run_layer(stk, idx * per_stage + j,
                               _pad_last(acts, width), unroll=unroll,
                               cell=cell)
         return acts
 
-    def head_loss(hd, acts, y_m, w_m):
+    def last_loss(dp, acts, m):
+        _, hd = dp
+        y_m = lax.dynamic_index_in_dim(y_micro, m, keepdims=False)
+        w_m = lax.dynamic_index_in_dim(w_micro, m, keepdims=False)
         logits = (acts[:, -1, :].astype(jnp.float32)
                   @ hd["weight"].T + hd["bias"])
         nll = -jax.nn.log_softmax(logits)[jnp.arange(bm), y_m]
+        # f32 so both lax.cond branches in the engine agree on dtypes
         correct = jnp.sum(
-            (jnp.argmax(logits, axis=1) == y_m) * (w_m > 0)
+            (jnp.argmax(logits, axis=1) == y_m).astype(jnp.float32)
+            * (w_m > 0)
         )
-        return jnp.sum(nll * w_m), correct
+        return jnp.sum(nll * w_m), correct, jnp.sum(w_m)
 
-    def full(stk, hd, a, y_m, w_m):
-        acts = run_stage(stk, a)
-        loss_m, _ = head_loss(hd, acts, y_m, w_m)
-        return acts, loss_m
-
-    def tick(carry, tk):
-        (fwd_buf, bwd_buf, stash, g_stk, g_head, loss_sum, correct_sum,
-         w_sum) = carry
-        m_f = fwd_sched[tk, idx]
-        m_b = bwd_sched[tk, idx]
-        f_active = m_f >= 0
-        b_active = m_b >= 0
-        m_f_safe = jnp.clip(m_f, 0, M - 1)
-        m_b_safe = jnp.clip(m_b, 0, M - 1)
-
-        # ---- backward op: read the stash BEFORE the forward writes it
-        stash_in = lax.dynamic_index_in_dim(stash, m_b_safe % K,
-                                            keepdims=False)
-        y_b = lax.dynamic_index_in_dim(y_micro, m_b_safe, keepdims=False)
-        w_b = lax.dynamic_index_in_dim(w_micro, m_b_safe, keepdims=False)
-        (_, _), vjp_fn = jax.vjp(
-            lambda stk, hd, a: full(stk, hd, a, y_b, w_b),
-            stacked, head, stash_in,
-        )
-        b_mask = b_active.astype(jnp.float32)
-        # the buffered cotangent is W-wide (it is d(next stage's padded
-        # input)); this stage's acts are H-wide - take the H slice
-        cot_acts = (jnp.where(is_last, 0.0, 1.0) * b_mask
-                    * bwd_buf[..., :hidden])
-        cot_loss = jnp.where(is_last, 1.0, 0.0) * b_mask
-        d_stk, d_head, d_acts = vjp_fn(
-            (cot_acts.astype(dtype), cot_loss)
-        )
-        g_stk = jax.tree.map(
-            lambda g, d: g + b_mask * d.astype(jnp.float32), g_stk, d_stk)
-        g_head = jax.tree.map(
-            lambda g, d: g + b_mask * d.astype(jnp.float32), g_head, d_head)
-
-        # ---- forward op
-        inp = jnp.where(
-            idx == 0,
-            lax.dynamic_index_in_dim(x_micro, m_f_safe, keepdims=False),
-            fwd_buf,
-        )
-        stash = jnp.where(
-            f_active,
-            lax.dynamic_update_index_in_dim(stash, inp, m_f_safe % K,
-                                            axis=0),
-            stash,
-        )
-        acts = run_stage(stacked, inp)
-        # loss/metrics bank at the last stage's forward (value only)
-        y_f = lax.dynamic_index_in_dim(y_micro, m_f_safe, keepdims=False)
-        w_f = lax.dynamic_index_in_dim(w_micro, m_f_safe, keepdims=False)
-        loss_m, correct_m = head_loss(head, acts, y_f, w_f)
-        bank = (f_active & is_last).astype(jnp.float32)
-        loss_sum = loss_sum + bank * loss_m
-        correct_sum = correct_sum + bank * correct_m
-        w_sum = w_sum + bank * jnp.sum(w_f)
-
-        # ---- communicate (capacity-1 buffers, schedule-gated receive)
-        perm_f = [(i, (i + 1) % n) for i in range(n)]
-        perm_b = [(i, (i - 1) % n) for i in range(n)]
-        acts_hop = lax.ppermute(_pad_last(acts, width), axis, perm_f)
-        dacts_hop = lax.ppermute(d_acts, axis, perm_b)
-        fwd_buf = jnp.where(recv_f_np[tk, idx], acts_hop, fwd_buf)
-        bwd_buf = jnp.where(
-            recv_b_np[tk, idx],
-            dacts_hop.astype(jnp.float32)[..., :width],
-            bwd_buf,
-        )
-        return (fwd_buf, bwd_buf, stash, g_stk, g_head, loss_sum,
-                correct_sum, w_sum), None
-
-    zeros_like_f32 = lambda t_: jax.tree.map(  # noqa: E731
-        lambda p: jnp.zeros(p.shape, jnp.float32), t_)
-    carry0 = (
-        jnp.zeros((bm, t, width), dtype),
-        jnp.zeros((bm, t, width), jnp.float32),
-        jnp.zeros((K, bm, t, width), dtype),
-        zeros_like_f32(stacked),
-        zeros_like_f32(head),
-        jnp.float32(0.0),
-        jnp.float32(0.0),
-        jnp.float32(0.0),
+    loss_sum, correct_sum, w_sum, (g_stk, g_head) = _pp_1f1b_engine(
+        axis, num_microbatches=M, diff_params=(stacked, head),
+        stage0_input=stage0_input, stage_apply=stage_apply,
+        last_loss=last_loss, bm=bm, t_len=t, width=width, hidden=hidden,
+        dtype=dtype,
     )
-    (_, _, _, g_stk, g_head, loss_sum, correct_sum, w_sum), _ = lax.scan(
-        tick, carry0, jnp.arange(TT)
-    )
-
-    # loss/metrics live on the last stage; replicate over pp
-    loss_sum = broadcast_from(loss_sum, axis, n - 1)
-    correct_sum = broadcast_from(correct_sum, axis, n - 1)
-    w_sum = broadcast_from(w_sum, axis, n - 1)
-
-    # unstack this stage's grads back into the params tree layout
     grads = {"rnn": _unstack_grads(g_stk, layers, cell), "fc": g_head}
+    return loss_sum, correct_sum, w_sum, grads
+
+
+def pp_char_1f1b_value_and_grad(layers, head, embed, tokens, axis: str, *,
+                                num_microbatches: int, unroll: int = 1,
+                                cell: str = "lstm", compute_dtype=None,
+                                sample_weights=None):
+    """Char-LM sibling of :func:`pp_rnn_1f1b_value_and_grad`: the same
+    1F1B timetable with the per-timestep vocab head and next-token
+    targets.  The embedding lookup lives INSIDE stage 0\'s vjp (the
+    ``stage0_input`` hook re-evaluates it), so ``embed`` gets exact
+    gradients without buffering d(activations) for every microbatch.
+
+    ``tokens``: (B, T) int windows (T = seq_length + 1); loss semantics
+    match ``_char_per_sequence_stats``: per-SEQUENCE mean over the T-1
+    predicted positions, weighted by ``sample_weights``; ``correct`` sums
+    per-sequence mean token accuracy.  Returns ``(loss_sum, correct_sum,
+    w_sum, grads)`` with ``grads`` shaped ``{"rnn", "head", "embed"}``.
+    """
+    M = num_microbatches
+    idx = lax.axis_index(axis)
+    batch, t = tokens.shape
+    _, per_stage = _check_1f1b_shapes(layers, axis, M, batch, cell)
+    bm = batch // M
+    hidden = layers[0]["w_hh"].shape[1]
+    embed_dim = embed.shape[1]
+    width = max(embed_dim, hidden)
+    t_len = t - 1
+
+    stacked = _stack_padded(layers, width, cell)
+    toks_micro = tokens.reshape(M, bm, t)
+    w_micro = (jnp.ones((M, bm), jnp.float32) if sample_weights is None
+               else sample_weights.reshape(M, bm).astype(jnp.float32))
+    if compute_dtype is not None:
+        stacked = jax.tree.map(lambda p: p.astype(compute_dtype), stacked)
+    dtype = jnp.dtype(compute_dtype) if compute_dtype else jnp.float32
+
+    def stage0_input(dp, m):
+        _, _, emb = dp
+        toks = lax.dynamic_index_in_dim(toks_micro, m, keepdims=False)
+        return _pad_last(emb[toks[:, :-1]], width).astype(dtype)
+
+    def stage_apply(dp, acts):
+        stk, _, _ = dp
+        for j in range(per_stage):
+            acts = _run_layer(stk, idx * per_stage + j,
+                              _pad_last(acts, width), unroll=unroll,
+                              cell=cell)
+        return acts
+
+    def last_loss(dp, acts, m):
+        _, hd, _ = dp
+        toks = lax.dynamic_index_in_dim(toks_micro, m, keepdims=False)
+        w_m = lax.dynamic_index_in_dim(w_micro, m, keepdims=False)
+        targets = toks[:, 1:]
+        logits = (acts.astype(jnp.float32)
+                  @ hd["weight"].T + hd["bias"])       # (bm, T-1, V)
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(
+            logp, targets[..., None], axis=-1
+        )[..., 0]                                       # (bm, T-1)
+        per_seq_nll = jnp.mean(nll, axis=1)
+        per_seq_acc = jnp.mean(
+            (jnp.argmax(logits, axis=-1) == targets).astype(jnp.float32),
+            axis=1,
+        )
+        loss_m = jnp.sum(per_seq_nll * w_m)
+        correct = jnp.sum(per_seq_acc * (w_m > 0))
+        return loss_m, correct, jnp.sum(w_m)
+
+    loss_sum, correct_sum, w_sum, (g_stk, g_head, g_emb) = _pp_1f1b_engine(
+        axis, num_microbatches=M, diff_params=(stacked, head, embed),
+        stage0_input=stage0_input, stage_apply=stage_apply,
+        last_loss=last_loss, bm=bm, t_len=t_len, width=width,
+        hidden=hidden, dtype=dtype,
+    )
+    grads = {"rnn": _unstack_grads(g_stk, layers, cell), "head": g_head,
+             "embed": g_emb}
     return loss_sum, correct_sum, w_sum, grads
 
 

@@ -546,30 +546,18 @@ def make_char_mesh_loss_fn(mesh, axes: dict[str, int], *,
 # Motion-model mesh factories (drive the shared Trainer loop)
 # ---------------------------------------------------------------------------
 
-def make_motion_pp_1f1b_loss_fn(mesh, axes: dict[str, int], *,
-                                num_microbatches: int = 4, unroll: int = 1,
-                                weighted: bool = False, cell: str = "lstm",
-                                precision: str = "f32"):
-    """Shard_mapped motion loss over a dp x pp mesh running the 1F1B
-    (PipeDream-flush) schedule instead of GPipe - same ``loss_fn(params,
-    x, y[, w]) -> (loss, metrics)`` contract as
-    :func:`make_motion_mesh_loss_fn`, so ``make_mesh_grad_step``'s
-    ``jax.value_and_grad`` drives it unchanged.
+def _make_pp_1f1b_loss_fn(mesh, axes, engine_of, *, weighted: bool):
+    """The shared custom-vjp scaffold for the 1F1B loss factories.
 
-    The 1F1B program computes its OWN gradients (the schedule interleaves
-    each microbatch's backward right after its forward, bounding live
-    activations to the in-flight limit instead of GPipe's all-M); a
-    ``custom_vjp`` hands those precomputed stage-local grads to
-    shard_map's replicated-param transpose, which sums them over the
-    mesh.  ``jax.checkpoint``-style remat is inherent (the backward op
-    recomputes its stage from the stashed input), so ``remat`` is not a
-    separate lever here.
+    ``engine_of(params, batch_x, w) -> (loss_sum, correct, w_sum,
+    grads)`` runs the family's self-differentiating schedule
+    (``parallel/pp.py:_pp_1f1b_engine`` wrappers); this wrapper owns the
+    mesh validation, the shard_map decoration, the custom_vjp that hands
+    the precomputed stage-local grads to shard_map's replicated-param
+    transpose, and the dp pmean/psum epilogue - ONE copy of the
+    empirically-verified 1/pp cotangent-undo correction.
     """
     from functools import partial as _partial
-
-    from pytorch_distributed_rnn_tpu.parallel.pp import (
-        pp_rnn_1f1b_value_and_grad,
-    )
 
     if (set(a for a, v in axes.items() if v != 1) - {"dp", "pp"}
             or "pp" not in axes):
@@ -577,7 +565,6 @@ def make_motion_pp_1f1b_loss_fn(mesh, axes: dict[str, int], *,
             f"1f1b runs on dp x pp meshes only (pp axis required); "
             f"got {dict(axes)}"
         )
-    compute_dtype = jnp.bfloat16 if precision == "bf16" else None
 
     batch_specs = (P("dp"), P("dp")) + ((P("dp"),) if weighted else ())
 
@@ -592,12 +579,7 @@ def make_motion_pp_1f1b_loss_fn(mesh, axes: dict[str, int], *,
         w = extra[0] if weighted else None
 
         def engine(p):
-            return pp_rnn_1f1b_value_and_grad(
-                p["rnn"], p["fc"], x, y, "pp",
-                num_microbatches=num_microbatches, unroll=unroll,
-                cell=cell, compute_dtype=compute_dtype,
-                sample_weights=w,
-            )
+            return engine_of(p, x, y, w)
 
         @jax.custom_vjp
         def f(p):
@@ -628,6 +610,66 @@ def make_motion_pp_1f1b_loss_fn(mesh, axes: dict[str, int], *,
         )
 
     return loss_fn
+
+
+def make_motion_pp_1f1b_loss_fn(mesh, axes: dict[str, int], *,
+                                num_microbatches: int = 4, unroll: int = 1,
+                                weighted: bool = False, cell: str = "lstm",
+                                precision: str = "f32"):
+    """Shard_mapped motion loss over a dp x pp mesh running the 1F1B
+    (PipeDream-flush) schedule instead of GPipe - same ``loss_fn(params,
+    x, y[, w]) -> (loss, metrics)`` contract as
+    :func:`make_motion_mesh_loss_fn`, so ``make_mesh_grad_step``'s
+    ``jax.value_and_grad`` drives it unchanged.
+
+    The 1F1B program computes its OWN gradients (the schedule interleaves
+    each microbatch's backward right after its forward, bounding live
+    activations to the in-flight limit instead of GPipe's all-M);
+    ``jax.checkpoint``-style remat is inherent (the backward op
+    recomputes its stage from the stashed input), so ``remat`` is not a
+    separate lever here.
+    """
+    from pytorch_distributed_rnn_tpu.parallel.pp import (
+        pp_rnn_1f1b_value_and_grad,
+    )
+
+    compute_dtype = jnp.bfloat16 if precision == "bf16" else None
+
+    def engine_of(p, x, y, w):
+        return pp_rnn_1f1b_value_and_grad(
+            p["rnn"], p["fc"], x, y, "pp",
+            num_microbatches=num_microbatches, unroll=unroll, cell=cell,
+            compute_dtype=compute_dtype, sample_weights=w,
+        )
+
+    return _make_pp_1f1b_loss_fn(mesh, axes, engine_of, weighted=weighted)
+
+
+def make_char_pp_1f1b_loss_fn(mesh, axes: dict[str, int], *,
+                              num_microbatches: int = 4, unroll: int = 1,
+                              weighted: bool = False, cell: str = "lstm",
+                              precision: str = "f32"):
+    """Char-LM sibling of :func:`make_motion_pp_1f1b_loss_fn`: the same
+    custom-vjp contract (``loss_fn(params, tokens, y[, w]) -> (loss,
+    metrics)``) over a dp x pp mesh running the 1F1B schedule, with the
+    per-timestep vocab head and exact embedding gradients
+    (``parallel/pp.py:pp_char_1f1b_value_and_grad``).  ``y`` is the
+    dataset's dummy label column (the LM trainer contract)."""
+    from pytorch_distributed_rnn_tpu.parallel.pp import (
+        pp_char_1f1b_value_and_grad,
+    )
+
+    compute_dtype = jnp.bfloat16 if precision == "bf16" else None
+
+    def engine_of(p, tokens, y, w):
+        del y
+        return pp_char_1f1b_value_and_grad(
+            p["rnn"], p["head"], p["embed"], tokens, "pp",
+            num_microbatches=num_microbatches, unroll=unroll, cell=cell,
+            compute_dtype=compute_dtype, sample_weights=w,
+        )
+
+    return _make_pp_1f1b_loss_fn(mesh, axes, engine_of, weighted=weighted)
 
 
 def make_motion_mesh_loss_fn(mesh, axes: dict[str, int], *,

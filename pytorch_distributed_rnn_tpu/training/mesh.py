@@ -140,13 +140,12 @@ class MeshTrainer(SpmdTrainer):
                     f"that sp divides seq_length + 1"
                 )
         if self.pp_schedule == "1f1b" and (
-            self.is_attention or self.is_char or self.is_moe
-            or self.model_axis != "pp"
+            self.is_attention or self.is_moe or self.model_axis != "pp"
         ):
             raise ValueError(
-                "--pp-schedule 1f1b drives the motion family's dp x pp "
-                "mesh only (parallel/pp.py:pp_rnn_1f1b_value_and_grad); "
-                "other families/axes run the gpipe schedule"
+                "--pp-schedule 1f1b drives the motion and char families' "
+                "dp x pp meshes (parallel/pp.py:pp_{rnn,char}_1f1b_"
+                "value_and_grad); other families/axes run gpipe"
             )
         # bf16 + remat thread through EVERY model axis since r4 (the tp
         # gate-sharded and pp GPipe stacks take the same levers as the
@@ -230,6 +229,18 @@ class MeshTrainer(SpmdTrainer):
                 self.model, self.mesh, weighted=weighted
             )
         if self.is_char:
+            if self.model_axis == "pp" and self.pp_schedule == "1f1b":
+                from pytorch_distributed_rnn_tpu.parallel.strategy import (
+                    make_char_pp_1f1b_loss_fn,
+                )
+
+                return make_char_pp_1f1b_loss_fn(
+                    self.mesh, self.mesh_axes,
+                    num_microbatches=self.num_microbatches,
+                    weighted=weighted,
+                    cell=getattr(self.model, "cell", "lstm"),
+                    precision=getattr(self.model, "precision", "f32"),
+                )
             from pytorch_distributed_rnn_tpu.parallel.strategy import (
                 make_char_mesh_loss_fn,
             )

@@ -432,7 +432,8 @@ def test_fsdp_multi_slot_is_a_real_process_world():
 
 def test_matrix_configs_cover_every_readme_cell():
     """run-matrix = one run per strategy x family matrix cell (every cell
-    trainable since r3).  4 families x 6 dp-strategies + 5 mesh rows."""
+    trainable since r3).  4 families x 6 dp-strategies + 6 mesh rows
+    (char carries both the sp and the composed sp x tp cell since r4)."""
     from pytorch_distributed_rnn_tpu.launcher import bench
     from pytorch_distributed_rnn_tpu.launcher.commands import (
         command_string,
@@ -440,7 +441,7 @@ def test_matrix_configs_cover_every_readme_cell():
     )
 
     cfgs = bench.matrix_configs()
-    assert len(cfgs) == 29
+    assert len(cfgs) == 30
     by_family = {}
     for c in cfgs:
         fam = c.parameters_dict()["model"]

@@ -225,6 +225,23 @@ class TestCharMesh:
                 "--no-validation", "mesh", "--mesh", "dp=2,sp=2",
             ])
 
+    def test_mesh_char_pp_1f1b_matches_gpipe(self, tmp_path, monkeypatch):
+        """--pp-schedule 1f1b on the char dp x pp mesh reproduces the
+        gpipe history (same grads incl. the embedding, different
+        timetable)."""
+        monkeypatch.chdir(tmp_path)
+        f_hist = self._cli(
+            tmp_path, "dp=2,pp=2",
+            mesh_extra=("--pp-schedule", "1f1b",
+                        "--num-microbatches", "2"),
+        )["train_history"]
+        (tmp_path / "history.json").unlink()
+        g_hist = self._cli(
+            tmp_path, "dp=2,pp=2",
+            mesh_extra=("--num-microbatches", "2"),
+        )["train_history"]
+        assert f_hist == pytest.approx(g_hist, rel=1e-4)
+
     def test_mesh_char_sp_tp_composes(self, tmp_path, monkeypatch):
         """The composed dp x sp x tp char mesh (gate-sharded cell inside
         the sp relay, r4) reproduces the dp-only history exactly."""
