@@ -52,6 +52,11 @@ class MoEClassifier:
     aux_weight: float = 0.01  # Switch load-balancing loss weight
     cell: str = "lstm"
     unroll: int = 1
+    precision: str = "f32"  # "bf16": backbone + expert matmuls in
+    # bfloat16 (full MXU rate); the ROUTER stays f32 - routing decisions
+    # and the aux loss are the numerics that must not quantize
+    remat: bool = False  # recompute the backbone layers and the MoE FFN
+    # during backward instead of saving their activations
 
     @property
     def _expert_hidden(self) -> int:
@@ -73,10 +78,25 @@ class MoEClassifier:
 
     def features(self, params, x: jax.Array) -> jax.Array:
         """Backbone + residual dense MoE: (B, T, in) -> ((B, T, H), aux)."""
+        compute_dtype = (jnp.bfloat16 if self.precision == "bf16"
+                         else None)
         out, _ = stacked_rnn(
-            params["rnn"], x, self.cell, unroll=self.unroll, impl="scan"
+            params["rnn"], x, self.cell, unroll=self.unroll, impl="scan",
+            compute_dtype=compute_dtype, remat=self.remat,
         )
-        moe_out, aux = moe_ffn_dense(params["moe"], out)
+        moe_params = params["moe"]
+        if compute_dtype is not None:
+            # expert weights in the compute dtype; the router stays f32
+            # (bf16 activations @ f32 router promote to f32 logits)
+            moe_params = {
+                k: (v if k == "router"
+                    else jax.tree.map(
+                        lambda p: p.astype(compute_dtype), v))
+                for k, v in moe_params.items()
+            }
+        moe_fn = (jax.checkpoint(moe_ffn_dense) if self.remat
+                  else moe_ffn_dense)
+        moe_out, aux = moe_fn(moe_params, out)
         return out + moe_out, aux
 
     def apply_with_aux(self, params, x: jax.Array, dropout_key=None):

@@ -418,7 +418,11 @@ def _pp_1f1b_engine(axis: str, *, num_microbatches: int, diff_params,
     is_first = idx == 0
 
     def full(dp, a, m):
-        inp = jnp.where(is_first, stage0_input(dp, m), a)
+        # cond, not where: non-first stages skip the entry evaluation
+        # entirely (for char that is an embedding gather forward and a
+        # vocab-sized zero scatter backward) - same rationale as the
+        # last-stage head below
+        inp = lax.cond(is_first, lambda: stage0_input(dp, m), lambda: a)
         acts = stage_apply(dp, inp)
         # only the last stage pays the head: for the char family the
         # per-timestep vocab head rivals an RNN layer, so a cond (legal -
@@ -458,9 +462,11 @@ def _pp_1f1b_engine(axis: str, *, num_microbatches: int, diff_params,
             grads, d_params,
         )
 
-        # ---- forward op
-        inp = jnp.where(
-            is_first, stage0_input(diff_params, m_f_safe), fwd_buf
+        # ---- forward op (cond: see the entry-evaluation note in full)
+        inp = lax.cond(
+            is_first,
+            lambda: stage0_input(diff_params, m_f_safe),
+            lambda: fwd_buf,
         )
         stash = jnp.where(
             f_active,
@@ -541,6 +547,16 @@ def _check_1f1b_shapes(layers, axis, num_microbatches, batch, cell):
     return n, L // n
 
 
+def _stage_layers(stk, idx, per_stage, acts, *, width, unroll, cell):
+    """This stage's slice of the layer stack - the one stage_apply body
+    shared by the motion and char 1F1B wrappers."""
+    for j in range(per_stage):
+        acts = _run_layer(stk, idx * per_stage + j,
+                          _pad_last(acts, width), unroll=unroll,
+                          cell=cell)
+    return acts
+
+
 def pp_rnn_1f1b_value_and_grad(layers, head, x, y, axis: str, *,
                                num_microbatches: int, unroll: int = 1,
                                cell: str = "lstm", compute_dtype=None,
@@ -580,12 +596,8 @@ def pp_rnn_1f1b_value_and_grad(layers, head, x, y, axis: str, *,
         return lax.dynamic_index_in_dim(x_micro, m, keepdims=False)
 
     def stage_apply(dp, acts):
-        stk, _ = dp
-        for j in range(per_stage):
-            acts = _run_layer(stk, idx * per_stage + j,
-                              _pad_last(acts, width), unroll=unroll,
-                              cell=cell)
-        return acts
+        return _stage_layers(dp[0], idx, per_stage, acts, width=width,
+                             unroll=unroll, cell=cell)
 
     def last_loss(dp, acts, m):
         _, hd = dp
@@ -651,12 +663,8 @@ def pp_char_1f1b_value_and_grad(layers, head, embed, tokens, axis: str, *,
         return _pad_last(emb[toks[:, :-1]], width).astype(dtype)
 
     def stage_apply(dp, acts):
-        stk, _, _ = dp
-        for j in range(per_stage):
-            acts = _run_layer(stk, idx * per_stage + j,
-                              _pad_last(acts, width), unroll=unroll,
-                              cell=cell)
-        return acts
+        return _stage_layers(dp[0], idx, per_stage, acts, width=width,
+                             unroll=unroll, cell=cell)
 
     def last_loss(dp, acts, m):
         _, hd, _ = dp

@@ -108,17 +108,9 @@ def build_model(args, training_set):
     if fam == "moe":
         from pytorch_distributed_rnn_tpu.models import MoEClassifier
 
-        unsupported = [
-            flag for flag, active in (
-                ("--dropout", bool(getattr(args, "dropout", 0.0))),
-                ("--precision bf16",
-                 getattr(args, "precision", "f32") != "f32"),
-                ("--remat", getattr(args, "remat", False)),
-            ) if active
-        ]
-        if unsupported:
+        if getattr(args, "dropout", 0.0):
             raise SystemExit(
-                f"--model moe does not support: {', '.join(unsupported)} "
+                "--model moe does not support: --dropout "
                 "(pass --dropout 0; the CLI default 0.1 mirrors the "
                 "reference surface)"
             )
@@ -129,6 +121,8 @@ def build_model(args, training_set):
             output_dim=len(MotionDataset.LABELS),
             num_experts=getattr(args, "num_experts", 4),
             cell=getattr(args, "cell", "lstm"),
+            precision=getattr(args, "precision", "f32"),
+            remat=getattr(args, "remat", False),
         )
     if fam != "rnn":
         raise SystemExit(

@@ -158,6 +158,19 @@ class MeshTrainer(SpmdTrainer):
                 "--dropout 0 (the CLI default 0.1 mirrors the reference "
                 "surface, main.py:26)"
             )
+        if self.is_moe and (
+            getattr(model, "precision", "f32") != "f32"
+            or getattr(model, "remat", False)
+        ):
+            # the ep-sharded dispatch (parallel/ep.py) threads neither
+            # lever yet; the dp strategies run the dense path via
+            # model.features, which does (r4)
+            raise NotImplementedError(
+                "--precision bf16/--remat are not supported on the MoE "
+                "mesh strategy (dp x ep) - use local/distributed/horovod/"
+                "fsdp/distributed-native/parameter-server, or drop the "
+                "flag"
+            )
         if self.is_attention and (
             getattr(model, "precision", "f32") != "f32"
             or getattr(model, "remat", False)

@@ -223,3 +223,77 @@ class TestAttentionPrecision:
                 training_set=MotionDataset(X, y), batch_size=24,
                 learning_rate=1e-3, seed=1,
             )
+
+
+class TestMoEPrecision:
+    """bf16 + remat for the MoE family (r4): backbone + expert matmuls
+    in bfloat16, the router and aux loss in f32, per-component remat."""
+
+    def _model(self, **kw):
+        from pytorch_distributed_rnn_tpu.models import MoEClassifier
+
+        return MoEClassifier(input_dim=9, hidden_dim=16, layer_dim=2,
+                             num_experts=4, **kw)
+
+    def test_bf16_tracks_f32_and_routes_in_f32(self):
+        m32 = self._model()
+        m16 = self._model(precision="bf16")
+        params = m32.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 12, 9))
+        l32, aux32 = m32.apply_with_aux(params, x)
+        l16, aux16 = m16.apply_with_aux(params, x)
+        assert l16.dtype == jnp.float32 and aux16.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(l16), np.asarray(l32),
+                                   rtol=5e-2, atol=5e-2)
+        np.testing.assert_allclose(float(aux16), float(aux32), rtol=5e-2)
+
+    def test_remat_is_exact(self):
+        m = self._model()
+        mr = self._model(remat=True)
+        params = m.init(jax.random.PRNGKey(2))
+        x = jax.random.normal(jax.random.PRNGKey(3), (4, 12, 9))
+
+        def loss(model, p):
+            logits, aux = model.apply_with_aux(p, x)
+            return jnp.sum(logits ** 2) + aux
+
+        l0, g0 = jax.jit(jax.value_and_grad(lambda p: loss(m, p)))(params)
+        l1, g1 = jax.jit(jax.value_and_grad(lambda p: loss(mr, p)))(params)
+        np.testing.assert_allclose(float(l1), float(l0), rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_cli_accepts_moe_bf16_remat(self):
+        from pytorch_distributed_rnn_tpu.main import build_parser
+        from pytorch_distributed_rnn_tpu.training.families import (
+            build_model,
+        )
+
+        class FakeSet:
+            num_features = 9
+
+        args = build_parser().parse_args([
+            "--model", "moe", "--precision", "bf16", "--remat",
+            "--dropout", "0", "local",
+        ])
+        model = build_model(args, FakeSet())
+        assert model.precision == "bf16" and model.remat is True
+
+    def test_moe_mesh_rejects_bf16(self):
+        import pytest
+
+        from pytorch_distributed_rnn_tpu.data.synthetic import (
+            generate_har_arrays,
+        )
+        from pytorch_distributed_rnn_tpu.data import MotionDataset
+        from pytorch_distributed_rnn_tpu.training.mesh import MeshTrainer
+
+        X, y = generate_har_arrays(48, seq_length=16, seed=0)
+        with pytest.raises(NotImplementedError, match="bf16"):
+            MeshTrainer(
+                mesh_axes={"dp": 2, "ep": 2},
+                model=self._model(precision="bf16"),
+                training_set=MotionDataset(X, y), batch_size=24,
+                learning_rate=1e-3, seed=1,
+            )
