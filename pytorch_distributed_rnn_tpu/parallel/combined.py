@@ -114,16 +114,32 @@ def tp_sp_block(blk, h, num_heads: int, *, sp_axis: str, tp_axis: str,
 
 def attention_mesh_logits(params, x_local, num_heads: int, *,
                           sp_axis: str = "sp", tp_axis: str = "tp",
-                          causal: bool = False, impl: str = "dense"):
+                          causal: bool = False, impl: str = "dense",
+                          compute_dtype=None, remat: bool = False):
     """The composed sp x tp forward for an AttentionClassifier params
     tree, for use INSIDE a shard_map where both axes are bound (size 1 is
     fine).  ``x_local``: this shard's (B_local, T_local, in) chunk;
-    logits return replicated over sp and tp."""
+    logits return replicated over sp and tp.  ``compute_dtype`` moves the
+    block params/activations (and the tp psum + sp ring wire bytes) to
+    e.g. bf16 - layernorm stats stay f32 (models/attention._layer_norm)
+    and the pooled head computes f32; ``remat`` checkpoints each block
+    (ring ppermutes replay during backward)."""
     h = sp_embed_prologue(params, x_local, sp_axis)
+    if compute_dtype is not None:
+        h = h.astype(compute_dtype)
+
+    def block_fn(blk, h):
+        return tp_sp_block(blk, h, num_heads, sp_axis=sp_axis,
+                           tp_axis=tp_axis, causal=causal, impl=impl)
+
+    if remat:
+        block_fn = jax.checkpoint(block_fn)
     for blk in params["blocks"]:
-        h = tp_sp_block(blk, h, num_heads, sp_axis=sp_axis,
-                        tp_axis=tp_axis, causal=causal, impl=impl)
-    return _linear(params["head"], sp_mean_pool(h, sp_axis))
+        if compute_dtype is not None:
+            blk = jax.tree.map(lambda p: p.astype(compute_dtype), blk)
+        h = block_fn(blk, h)
+    return _linear(params["head"],
+                   sp_mean_pool(h.astype(jnp.float32), sp_axis))
 
 
 def make_3d_loss_fn(model, mesh, *, dp_axis: str = "dp", sp_axis: str = "sp",
@@ -135,7 +151,12 @@ def make_3d_loss_fn(model, mesh, *, dp_axis: str = "dp", sp_axis: str = "sp",
         resolve_attention_impl,
     )
 
+    from pytorch_distributed_rnn_tpu.parallel.strategy import (
+        resolve_model_levers,
+    )
+
     impl = resolve_attention_impl(getattr(model, "impl", "auto"))
+    compute_dtype, remat = resolve_model_levers(model)
 
     @partial(
         shard_map,
@@ -148,6 +169,7 @@ def make_3d_loss_fn(model, mesh, *, dp_axis: str = "dp", sp_axis: str = "sp",
         logits = attention_mesh_logits(
             params, x_local, model.num_heads, sp_axis=sp_axis,
             tp_axis=tp_axis, causal=causal, impl=impl,
+            compute_dtype=compute_dtype, remat=remat,
         )
         return lax.pmean(cross_entropy_loss(logits, y_local), dp_axis)
 

@@ -206,7 +206,8 @@ pp_stacked_lstm = pp_stacked_rnn
 
 
 def pp_transformer_blocks(blocks, h, axis: str, *, num_heads: int,
-                          num_microbatches: int):
+                          num_microbatches: int, compute_dtype=None,
+                          remat: bool = False):
     """GPipe-scheduled Transformer encoder blocks, for use inside
     ``shard_map`` over the ``pp`` axis (params and ``h`` (B, T, D)
     replicated per stage) - the attention family's pipeline axis.
@@ -233,6 +234,13 @@ def pp_transformer_blocks(blocks, h, axis: str, *, num_heads: int,
 
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
     h_micro = h.reshape(M, bm, t, d)
+    dtype = h.dtype
+    if compute_dtype is not None:
+        # bf16 stage blocks + hop payloads; layernorm stats stay f32
+        # inside _layer_norm (models/attention.py)
+        stacked = jax.tree.map(lambda p: p.astype(compute_dtype), stacked)
+        h_micro = h_micro.astype(compute_dtype)
+        dtype = compute_dtype
 
     def run_stage(stage, acts):
         for j in range(per_stage):
@@ -244,10 +252,13 @@ def pp_transformer_blocks(blocks, h, axis: str, *, num_heads: int,
             acts = apply_block(p, acts, num_heads)
         return acts
 
+    if remat:
+        run_stage = jax.checkpoint(run_stage)
+
     outs = _gpipe_schedule(
         axis, h_micro, run_stage,
         hop=lambda acts: acts,  # every block is D -> D: no padding
-        out_tail=(d,), dtype=h.dtype,
+        out_tail=(d,), dtype=dtype,
     )
     return outs.reshape(batch, t, d)
 

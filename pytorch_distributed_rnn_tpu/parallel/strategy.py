@@ -51,6 +51,20 @@ from pytorch_distributed_rnn_tpu.parallel.tp import (
 MODEL_AXES = ("sp", "tp", "pp")
 
 
+def dtype_of(precision: str):
+    """The one precision-string -> compute-dtype mapping (None = f32)."""
+    return jnp.bfloat16 if precision == "bf16" else None
+
+
+def resolve_model_levers(model):
+    """``(compute_dtype, remat)`` from a model's precision/remat fields -
+    the one resolution shared by every mesh loss builder, so a new
+    precision value cannot silently train at the wrong dtype at a missed
+    call site."""
+    return (dtype_of(getattr(model, "precision", "f32")),
+            getattr(model, "remat", False))
+
+
 def parse_mesh_spec(spec: str) -> dict[str, int]:
     """``"dp=2,sp=4"`` -> ``{"dp": 2, "sp": 4}``.  Axis names are
     validated; sizes are ints (-1 = all remaining devices, as in
@@ -501,7 +515,7 @@ def make_char_mesh_loss_fn(mesh, axes: dict[str, int], *,
     _reject_unsupported_mesh_levers(model_axis, precision, remat, dropout,
                                     schedule=schedule, cell=cell,
                                     num_layers=num_layers)
-    compute_dtype = jnp.bfloat16 if precision == "bf16" else None
+    compute_dtype = dtype_of(precision)
 
     from functools import partial as _partial
 
@@ -633,7 +647,7 @@ def make_motion_pp_1f1b_loss_fn(mesh, axes: dict[str, int], *,
         pp_rnn_1f1b_value_and_grad,
     )
 
-    compute_dtype = jnp.bfloat16 if precision == "bf16" else None
+    compute_dtype = dtype_of(precision)
 
     def engine_of(p, x, y, w):
         return pp_rnn_1f1b_value_and_grad(
@@ -659,7 +673,7 @@ def make_char_pp_1f1b_loss_fn(mesh, axes: dict[str, int], *,
         pp_char_1f1b_value_and_grad,
     )
 
-    compute_dtype = jnp.bfloat16 if precision == "bf16" else None
+    compute_dtype = dtype_of(precision)
 
     def engine_of(p, tokens, y, w):
         del y
@@ -694,7 +708,7 @@ def make_motion_mesh_loss_fn(mesh, axes: dict[str, int], *,
     _reject_unsupported_mesh_levers(model_axis, precision, remat, dropout,
                                     schedule=schedule, cell=cell,
                                     num_layers=num_layers)
-    compute_dtype = jnp.bfloat16 if precision == "bf16" else None
+    compute_dtype = dtype_of(precision)
 
     from functools import partial as _partial
 
@@ -774,6 +788,7 @@ def make_attention_mesh_loss_fn(model, mesh, *, weighted: bool = False):
     )
 
     impl = resolve_attention_impl(getattr(model, "impl", "auto"))
+    compute_dtype, remat = resolve_model_levers(model)
 
     for axis in ("dp", "sp", "tp"):
         if axis not in mesh.shape:
@@ -795,7 +810,9 @@ def make_attention_mesh_loss_fn(model, mesh, *, weighted: bool = False):
     )
     def loss_fn(params, x_local, y_local, *w):
         logits = attention_mesh_logits(params, x_local, model.num_heads,
-                                       impl=impl)
+                                       impl=impl,
+                                       compute_dtype=compute_dtype,
+                                       remat=remat)
         local, correct = _classifier_loss_metrics(
             logits, y_local, w[0] if weighted else None
         )
@@ -813,9 +830,12 @@ def make_attention_pp_loss_fn(model, mesh, *, num_microbatches: int = 4,
     the attention family over a dp x pp mesh: encoder blocks split into
     GPipe stages over ``pp`` (``parallel/pp.py:pp_transformer_blocks``),
     batch rows over ``dp``.  Embed/positions and the pooled head run
-    replicated on every stage (position-wise and tiny).  pp does not
-    currently compose with sp/tp in one program - the trainer rejects
-    those specs loudly."""
+    replicated on every stage (position-wise and tiny; the head computes
+    f32).  ``model.precision``/``model.remat`` thread into the staged
+    blocks (r4).  pp does not currently compose with sp/tp in one
+    program - the trainer rejects those specs loudly."""
+    compute_dtype, remat = resolve_model_levers(model)
+
     from functools import partial as _partial
 
     from pytorch_distributed_rnn_tpu.models.attention import _linear
@@ -845,8 +865,10 @@ def make_attention_pp_loss_fn(model, mesh, *, num_microbatches: int = 4,
         h = pp_transformer_blocks(
             params["blocks"], h, "pp", num_heads=model.num_heads,
             num_microbatches=num_microbatches,
+            compute_dtype=compute_dtype, remat=remat,
         )
-        logits = _linear(params["head"], jnp.mean(h, axis=1))
+        logits = _linear(params["head"],
+                         jnp.mean(h.astype(jnp.float32), axis=1))
         local, correct = _classifier_loss_metrics(
             logits, y_local, w[0] if weighted else None
         )

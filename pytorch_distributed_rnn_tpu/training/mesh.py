@@ -171,20 +171,9 @@ class MeshTrainer(SpmdTrainer):
                 "fsdp/distributed-native/parameter-server, or drop the "
                 "flag"
             )
-        if self.is_attention and (
-            getattr(model, "precision", "f32") != "f32"
-            or getattr(model, "remat", False)
-        ):
-            # the attention family's bf16/remat levers (r4) ride
-            # model.apply, which the dp strategies call; the composed
-            # mesh programs (attention_mesh_logits / the pp stage loss)
-            # run their own block impls and thread neither - reject
-            # loudly rather than silently training f32/no-remat
-            raise NotImplementedError(
-                "--precision bf16/--remat are not supported on attention "
-                "mesh strategies - use local/distributed/horovod/fsdp/"
-                "distributed-native/parameter-server, or drop the flag"
-            )
+        # attention mesh programs thread bf16/remat since r4 (the
+        # composed sp x tp blocks and the GPipe-staged blocks take the
+        # same levers as model.apply) - no attention precision reject.
         if self._dropout > 0.0 and self.is_attention:
             # the attention family's dropout (models/attention.py) rides
             # the dp strategies' key plumbing; the composed-mesh programs
