@@ -176,8 +176,9 @@ class AttentionClassifier:
 
             if resolve_attention_impl(self.impl) == "flash":
                 attention = lambda q, k, v: flash_attention(q, k, v)  # noqa: E731
-        compute_dtype = (jnp.bfloat16 if self.precision == "bf16"
-                         else None)
+        from pytorch_distributed_rnn_tpu.ops.rnn import dtype_of
+
+        compute_dtype = dtype_of(self.precision)
         if compute_dtype is not None:
             h = h.astype(compute_dtype)
         def block_fn(blk, h, blk_key):

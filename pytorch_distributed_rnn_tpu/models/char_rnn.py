@@ -59,7 +59,9 @@ class CharRNN:
 
         ``dropout_key=None`` = eval/deterministic; pass a key for
         train-mode inter-layer dropout."""
-        compute_dtype = jnp.bfloat16 if self.precision == "bf16" else None
+        from pytorch_distributed_rnn_tpu.ops.rnn import dtype_of
+
+        compute_dtype = dtype_of(self.precision)
         x = params["embed"][tokens]
         outputs, _ = stacked_rnn(
             params["rnn"], x, self.cell, unroll=self.unroll, impl=self.impl,

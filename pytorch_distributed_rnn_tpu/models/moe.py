@@ -78,22 +78,16 @@ class MoEClassifier:
 
     def features(self, params, x: jax.Array) -> jax.Array:
         """Backbone + residual dense MoE: (B, T, in) -> ((B, T, H), aux)."""
-        compute_dtype = (jnp.bfloat16 if self.precision == "bf16"
-                         else None)
+        from pytorch_distributed_rnn_tpu.ops.rnn import dtype_of
+
+        compute_dtype = dtype_of(self.precision)
         out, _ = stacked_rnn(
             params["rnn"], x, self.cell, unroll=self.unroll, impl="scan",
             compute_dtype=compute_dtype, remat=self.remat,
         )
-        moe_params = params["moe"]
-        if compute_dtype is not None:
-            # expert weights in the compute dtype; the router stays f32
-            # (bf16 activations @ f32 router promote to f32 logits)
-            moe_params = {
-                k: (v if k == "router"
-                    else jax.tree.map(
-                        lambda p: p.astype(compute_dtype), v))
-                for k, v in moe_params.items()
-            }
+        from pytorch_distributed_rnn_tpu.ops.moe import cast_expert_params
+
+        moe_params = cast_expert_params(params["moe"], compute_dtype)
         moe_fn = (jax.checkpoint(moe_ffn_dense) if self.remat
                   else moe_ffn_dense)
         moe_out, aux = moe_fn(moe_params, out)

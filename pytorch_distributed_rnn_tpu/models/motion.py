@@ -54,7 +54,9 @@ class MotionModel:
         ``dropout_key=None`` = eval/deterministic mode; pass a PRNG key for
         train-mode inter-layer dropout (torch ``nn.LSTM(dropout=...)``
         placement)."""
-        compute_dtype = jnp.bfloat16 if self.precision == "bf16" else None
+        from pytorch_distributed_rnn_tpu.ops.rnn import dtype_of
+
+        compute_dtype = dtype_of(self.precision)
         outputs, _ = stacked_rnn(
             params["rnn"], x, self.cell, unroll=self.unroll, impl=self.impl,
             compute_dtype=compute_dtype, remat=self.remat,

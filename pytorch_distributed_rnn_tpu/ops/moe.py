@@ -42,6 +42,21 @@ def init_moe_ffn(key, dim: int, num_experts: int, hidden: int):
     }
 
 
+def cast_expert_params(params, compute_dtype):
+    """The MoE mixed-precision contract, in ONE place (shared by the
+    dense ``MoEClassifier.features`` path and the ep-mesh loss): expert
+    weights move to the compute dtype, the ROUTER stays f32 - routing
+    decisions and the aux loss are the numerics that must not quantize.
+    ``compute_dtype=None`` returns the tree unchanged."""
+    if compute_dtype is None:
+        return params
+    return {
+        k: (v if k == "router"
+            else jax.tree.map(lambda p: p.astype(compute_dtype), v))
+        for k, v in params.items()
+    }
+
+
 def _route(params, x):
     """Top-1 routing: returns (expert_idx (N,), prob (N,), gates (N, E))."""
     logits = x @ params["router"]["weight"].T + params["router"]["bias"]

@@ -201,6 +201,13 @@ def init_stacked_rnn(
     ]
 
 
+def dtype_of(precision: str):
+    """The ONE precision-string -> compute-dtype mapping (None = f32),
+    shared by every model's apply path and every mesh loss builder - a
+    new precision value added here takes effect everywhere at once."""
+    return jnp.bfloat16 if precision == "bf16" else None
+
+
 def resolve_rnn_impl(impl: str, cell: str, hidden: int | None = None) -> str:
     """Resolve the recurrent-step implementation.
 

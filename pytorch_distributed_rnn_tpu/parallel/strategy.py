@@ -35,6 +35,7 @@ from jax.sharding import PartitionSpec as P
 from jax import shard_map
 
 from pytorch_distributed_rnn_tpu.ops.losses import cross_entropy_loss
+from pytorch_distributed_rnn_tpu.ops.rnn import dtype_of
 from pytorch_distributed_rnn_tpu.parallel.collectives import broadcast_from
 from pytorch_distributed_rnn_tpu.parallel.pp import pp_stacked_rnn
 from pytorch_distributed_rnn_tpu.parallel.sp import (
@@ -49,11 +50,6 @@ from pytorch_distributed_rnn_tpu.parallel.tp import (
 )
 
 MODEL_AXES = ("sp", "tp", "pp")
-
-
-def dtype_of(precision: str):
-    """The one precision-string -> compute-dtype mapping (None = f32)."""
-    return jnp.bfloat16 if precision == "bf16" else None
 
 
 def resolve_model_levers(model):
@@ -899,8 +895,13 @@ def make_moe_mesh_loss_fn(model, mesh, *, weighted: bool = False):
     cells, and exactness here is free.  Aux statistics pmean over BOTH
     axes, so the Switch loss is the global-batch value - identical to the
     dense single-device path when capacity is ample.
+    ``model.precision``/``model.remat`` thread like the dense path (r4):
+    backbone + expert matmuls and the all_to_all wire bytes in bf16, the
+    router f32; remat checkpoints the backbone layers and the dispatch.
     """
     from functools import partial as _partial
+
+    compute_dtype, remat = resolve_model_levers(model)
 
     for axis in ("dp", "ep"):
         if axis not in mesh.shape:
@@ -925,12 +926,21 @@ def make_moe_mesh_loss_fn(model, mesh, *, weighted: bool = False):
     def loss_fn(params, x_local, y_local, *w):
         out, _ = stacked_rnn(
             params["rnn"], x_local, model.cell, unroll=model.unroll,
-            impl="scan",
+            impl="scan", compute_dtype=compute_dtype, remat=remat,
         )
-        moe_out, aux = ep_moe_ffn(
-            params["moe"], out, "ep",
-            capacity_factor=model.capacity_factor, stat_axes=data,
+        from pytorch_distributed_rnn_tpu.ops.moe import (
+            cast_expert_params,
         )
+
+        moe_params = cast_expert_params(params["moe"], compute_dtype)
+        def moe_call(mp, h_in):
+            return ep_moe_ffn(
+                mp, h_in, "ep",
+                capacity_factor=model.capacity_factor, stat_axes=data,
+            )
+
+        moe_fn = jax.checkpoint(moe_call) if remat else moe_call
+        moe_out, aux = moe_fn(moe_params, out)
         h = out + moe_out
         last = h[:, -1, :].astype(jnp.float32)
         logits = last @ params["fc"]["weight"].T + params["fc"]["bias"]

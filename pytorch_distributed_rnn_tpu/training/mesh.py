@@ -158,20 +158,7 @@ class MeshTrainer(SpmdTrainer):
                 "--dropout 0 (the CLI default 0.1 mirrors the reference "
                 "surface, main.py:26)"
             )
-        if self.is_moe and (
-            getattr(model, "precision", "f32") != "f32"
-            or getattr(model, "remat", False)
-        ):
-            # the ep-sharded dispatch (parallel/ep.py) threads neither
-            # lever yet; the dp strategies run the dense path via
-            # model.features, which does (r4)
-            raise NotImplementedError(
-                "--precision bf16/--remat are not supported on the MoE "
-                "mesh strategy (dp x ep) - use local/distributed/horovod/"
-                "fsdp/distributed-native/parameter-server, or drop the "
-                "flag"
-            )
-        # attention mesh programs thread bf16/remat since r4 (the
+        # every family's mesh programs thread bf16/remat since r4 (the
         # composed sp x tp blocks and the GPipe-staged blocks take the
         # same levers as model.apply) - no attention precision reject.
         if self._dropout > 0.0 and self.is_attention:

@@ -191,12 +191,8 @@ class TestMoECLI:
     def test_rejections(self, tmp_path, monkeypatch):
         with pytest.raises(SystemExit, match="dropout"):
             self._cli(tmp_path, monkeypatch, "--dropout", "0.1", "local")
-        # bf16/remat are SUPPORTED on the dp strategies since r4; the
-        # remaining precision reject is the ep mesh (dispatch threads
-        # neither lever)
-        with pytest.raises(NotImplementedError, match="bf16"):
-            self._cli(tmp_path, monkeypatch, "--precision", "bf16",
-                      "mesh", "--mesh", "dp=2,ep=2")
+        # bf16/remat are SUPPORTED on every MoE strategy since r4 (the
+        # ep dispatch threads both levers) - no precision rejects remain
         with pytest.raises(ValueError, match="dp x ep only"):
             self._cli(tmp_path, monkeypatch, "mesh", "--mesh", "dp=2,sp=2")
         with pytest.raises(ValueError, match="does not shard"):
