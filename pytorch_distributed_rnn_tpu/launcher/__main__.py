@@ -199,9 +199,12 @@ def main(argv=None):
     if args.task == "run-debug":
         runs = [bench.DEBUG_RUN]
     elif args.task == "run-chip":
-        # motion rows + the amortized 20-epoch row + the char-LM
+        # motion rows + the amortized 20-epoch rows (per-epoch at default
+        # dropout, per-epoch at dropout 0, fused-whole-run at dropout 0 -
+        # the last two isolate dispatch granularity) + the char-LM
         # companion row in one resumable sweep
         runs = [bench.CHIP_RUN, bench.CHIP_AMORTIZED_RUN,
+                bench.CHIP_AMORTIZED_NODROP_RUN, bench.CHIP_FUSED_RUN,
                 bench.CHIP_LM_RUN]
     elif args.task == "run-all":
         runs = [bench.BENCHMARK_RUN]

@@ -101,6 +101,15 @@ def build_parser() -> argparse.ArgumentParser:
         "saving them (trades FLOPs for HBM; for deep/long configs)",
     )
     parser.add_argument(
+        "--fuse-run", action="store_true",
+        help="compile the whole multi-epoch training run into ONE device "
+        "program (lax.scan over epochs) even with INFO logging on; "
+        "removes every per-epoch host round-trip (dominant on a "
+        "remote-attached chip) at the cost of per-epoch Start-Epoch "
+        "messages.  Needs --no-validation, no --checkpoint-every and "
+        "--grad-accum 1; rejected loudly otherwise",
+    )
+    parser.add_argument(
         "--profile", default=None, type=Path, metavar="DIR",
         help="capture a step-level device trace of the training run into "
         "DIR (viewable in TensorBoard/Perfetto); the reference had only "

@@ -121,6 +121,7 @@ def run_worker(args, rank: int):
             # forwarded so the unsupported-flag guard raises instead of
             # the flag being silently dropped
             grad_accum=getattr(args, "grad_accum", 1),
+            fuse_run=getattr(args, "fuse_run", False),
         )
         _, train_history, _ = trainer.train(epochs=args.epochs)
         trainer.finish()

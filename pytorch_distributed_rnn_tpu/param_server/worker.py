@@ -48,6 +48,7 @@ class ParameterServerWorkerTrainer(Trainer):
         num_workers: int,
         seed: int | None = None,
         grad_accum: int = 1,
+        fuse_run: bool = False,
     ):
         sampler = DistributedSampler(
             len(training_set),
@@ -67,6 +68,9 @@ class ParameterServerWorkerTrainer(Trainer):
             sampler=sampler,
             seed=seed,
             grad_accum=grad_accum,
+            # DEVICE_DATA=False: an explicit --fuse-run is rejected loudly
+            # by the base gate (every step needs the host for push/pull)
+            fuse_run=fuse_run,
         )
         self.comm = comm
         self.worker_rank = worker_rank

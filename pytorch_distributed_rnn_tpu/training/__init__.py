@@ -147,6 +147,7 @@ def _run_trainer(args, trainer_class, model, datasets):
         seed=args.seed,
         checkpoint_every=getattr(args, "checkpoint_every", 0),
         grad_accum=getattr(args, "grad_accum", 1),
+        fuse_run=getattr(args, "fuse_run", False),
     )
 
     if getattr(args, "resume", None):

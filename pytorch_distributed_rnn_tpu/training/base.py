@@ -86,8 +86,17 @@ class Trainer:
         seed: int | None = None,
         checkpoint_every: int = 0,
         grad_accum: int = 1,
+        fuse_run: bool = False,
     ):
         self.model = model
+        # --fuse-run: compile the whole multi-epoch run into ONE device
+        # program even when INFO logging is on (the perf line still
+        # prints; only the per-epoch Start-Epoch messages are traded
+        # away).  Without it the fused path is taken only when nothing
+        # observable needs the host between epochs.  On a remote-attached
+        # chip each epoch dispatch costs a full tunnel round-trip, which
+        # dominates this workload ~20x (BASELINE.md r4).
+        self._fuse_run = bool(fuse_run)
         self.checkpoint_dir = Path(checkpoint_dir) if checkpoint_dir else None
         # periodic epoch checkpoints (checkpoint-epoch-N.ckpt) in addition
         # to best-model.ckpt; 0 = best-only (reference trigger, base.py:88-91)
@@ -460,11 +469,10 @@ class Trainer:
         # the whole run fuses into one device program when nothing needs
         # the host between batches or epochs: no per-epoch validation /
         # checkpointing, no per-batch progress logging
-        fused_run = (
+        fusable = (
             self.DEVICE_DATA
             and self.validation_set is None
             and epochs > 0
-            and not logging.getLogger().isEnabledFor(logging.INFO)
             # with dropout on, a partial final batch would draw its mask
             # over the fused path's zero-padded batch shape and diverge
             # from the per-epoch path's unpadded draw; keep the two paths
@@ -475,6 +483,20 @@ class Trainer:
             # the fused run's weighted loss (per-example mask) is not
             # expressible as equal-microbatch accumulation
             and self.grad_accum == 1
+        )
+        if self._fuse_run and not fusable:
+            # the user explicitly asked for one-program training; falling
+            # back silently would reintroduce the per-epoch host syncs
+            # they are trying to eliminate
+            raise ValueError(
+                "--fuse-run needs a run with no host work between epochs: "
+                "device-resident data, --no-validation, no "
+                "--checkpoint-every, --grad-accum 1, and (with dropout) a "
+                "batch size dividing the training set"
+            )
+        fused_run = fusable and (
+            self._fuse_run
+            or not logging.getLogger().isEnabledFor(logging.INFO)
         )
 
         def train_inner():

@@ -62,6 +62,7 @@ class SpmdTrainer(Trainer):
         axis: str = "dp",
         checkpoint_every: int = 0,
         grad_accum: int = 1,
+        fuse_run: bool = False,
     ):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.axis = axis
@@ -82,6 +83,7 @@ class SpmdTrainer(Trainer):
             seed=seed,
             checkpoint_every=checkpoint_every,
             grad_accum=grad_accum,
+            fuse_run=fuse_run,
         )
         self.world_size = world_size
         # single controller: one process reports as rank 0.  In a

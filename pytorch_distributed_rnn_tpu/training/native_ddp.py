@@ -65,6 +65,7 @@ class NativeDDPTrainer(Trainer):
         checkpoint_dir=None,
         seed: int | None = None,
         grad_accum: int = 1,
+        fuse_run: bool = False,
     ):
         rank = comm.rank
         world = comm.world_size
@@ -84,6 +85,9 @@ class NativeDDPTrainer(Trainer):
             sampler=sampler,
             seed=seed,
             grad_accum=grad_accum,
+            # DEVICE_DATA=False makes the base gate reject an explicit
+            # --fuse-run loudly (the per-step host allreduce cannot fuse)
+            fuse_run=fuse_run,
         )
         self.comm = comm
         self.rank = rank
@@ -153,6 +157,7 @@ def run_rank(comm, args, model, datasets, trainer_class=None):
         # forwarded so the unsupported-flag guard raises instead of the
         # flag being silently dropped
         grad_accum=getattr(args, "grad_accum", 1),
+        fuse_run=getattr(args, "fuse_run", False),
     )
     if getattr(args, "resume", None):
         meta = trainer.resume_from(args.resume)

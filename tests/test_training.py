@@ -255,6 +255,44 @@ class TestFusedRunParity:
         ):
             np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
 
+    def test_fuse_run_flag_forces_fused_path_at_info(self):
+        """--fuse-run takes the one-program path even with INFO logging on
+        (the remote-chip lever: INFO otherwise forces one dispatch per
+        epoch) and matches the per-epoch path's numerics."""
+        X, y = generate_har_arrays(184, seq_length=24, seed=3)
+        train = MotionDataset(X, y)
+        kwargs = dict(batch_size=48, learning_rate=2.5e-3, seed=SEED)
+
+        forced = Trainer(small_model(), train, fuse_run=True, **kwargs)
+        with _force_info_logging():
+            _, forced_hist, _ = forced.train(epochs=2)
+        assert forced._run_fn is not None  # fused despite verbose logging
+
+        stepwise = Trainer(small_model(), train, **kwargs)
+        with _force_info_logging():
+            _, step_hist, _ = stepwise.train(epochs=2)
+        assert stepwise._run_fn is None
+
+        np.testing.assert_allclose(forced_hist, step_hist,
+                                   atol=1e-5, rtol=1e-5)
+        for a, b in zip(
+            jax.tree.leaves(forced.params), jax.tree.leaves(stepwise.params)
+        ):
+            np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
+    def test_fuse_run_flag_rejected_when_host_work_needed(self):
+        """An explicit --fuse-run with per-epoch host work (validation)
+        must fail loudly, not silently fall back to per-epoch dispatch."""
+        X, y = generate_har_arrays(96, seq_length=24, seed=3)
+        Xv, yv = generate_har_arrays(24, seq_length=24, seed=4)
+        trainer = Trainer(
+            small_model(), MotionDataset(X, y),
+            validation_set=MotionDataset(Xv, yv),
+            batch_size=48, learning_rate=2.5e-3, seed=SEED, fuse_run=True,
+        )
+        with pytest.raises(ValueError, match="fuse-run"):
+            trainer.train(epochs=1)
+
 
 class _force_info_logging:
     """Raise the root logger to DEBUG so trainers take the per-batch path
