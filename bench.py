@@ -192,7 +192,8 @@ def attention_flops_per_seq(dim: int, depth: int, seq_len: int,
 def attention_throughput(batch: int = 256, steps: int = 30,
                          seq_len: int = SEQ_LEN,
                          impl: str = "auto",
-                         precision: str = "f32"):
+                         precision: str = "f32",
+                         dim: int = 128, num_heads: int = 4):
     """seq/s training the attention classifier on HAR-shaped windows -
     the long-context family's single-chip baseline number (its sp/tp mesh
     composition is compile-validated by dryrun_multichip; ring-attention
@@ -210,8 +211,8 @@ def attention_throughput(batch: int = 256, steps: int = 30,
     from pytorch_distributed_rnn_tpu.models import AttentionClassifier
     from pytorch_distributed_rnn_tpu.ops import cross_entropy_loss
 
-    model = AttentionClassifier(input_dim=NUM_FEATURES, dim=128, depth=2,
-                                num_heads=4, output_dim=6,
+    model = AttentionClassifier(input_dim=NUM_FEATURES, dim=dim, depth=2,
+                                num_heads=num_heads, output_dim=6,
                                 max_len=seq_len, impl=impl,
                                 precision=precision)
     params = model.init(jax.random.PRNGKey(0))
@@ -253,8 +254,17 @@ def main():
     import argparse
 
     parser = argparse.ArgumentParser(prog="bench.py")
-    parser.add_argument("--suite", choices=["quick", "stress"],
-                        default="stress")
+    parser.add_argument("--suite", choices=["quick", "stress", "attention"],
+                        default="stress",
+                        help="quick: headline only; stress: everything; "
+                        "attention: headline + the attention rows only "
+                        "(the fast path for scarce tunnel windows)")
+    parser.add_argument("--append-rows", default=None, metavar="PATH",
+                        help="also append each extra row as one JSON line "
+                        "to PATH the moment it completes - a killed run "
+                        "(wedged tunnel, watcher timeout) keeps every "
+                        "finished measurement instead of losing the "
+                        "end-of-run JSON emit")
     args = parser.parse_args()
 
     import jax
@@ -269,12 +279,24 @@ def main():
     headline = motion_throughput("auto")
 
     extras: dict = {}
-    if args.suite == "stress":
+    rnn_rows = args.suite == "stress"
+    attention_rows = args.suite in ("stress", "attention")
+    if rnn_rows or attention_rows:
         def attempt(name, fn):
+            # suite filter lives HERE so the row lists below stay one
+            # flat sequence: attention rows are the "attention_"-prefixed
+            # ones, everything else belongs to the stress suite
+            if not (rnn_rows if not name.startswith("attention_")
+                    else attention_rows):
+                return
             try:
                 extras[name] = fn()
             except Exception as exc:  # noqa: BLE001 - headline must survive
                 extras[name] = f"error: {type(exc).__name__}: {exc}"[:200]
+            if args.append_rows:
+                with open(args.append_rows, "a") as f:
+                    f.write(json.dumps({"row": name,
+                                        "result": extras[name]}) + "\n")
 
         # fused-vs-scan A/B.  The headline "auto" run already measured one
         # impl (fused on TPU, scan elsewhere - resolve_rnn_impl): reuse
@@ -284,7 +306,8 @@ def main():
         from pytorch_distributed_rnn_tpu.ops.rnn import resolve_rnn_impl
 
         auto_impl = resolve_rnn_impl("auto", "lstm", hidden=32)
-        extras[f"motion_{auto_impl}_seq_per_sec"] = round(headline, 1)
+        if rnn_rows:
+            extras[f"motion_{auto_impl}_seq_per_sec"] = round(headline, 1)
         if auto_impl != "scan":
             attempt(
                 "motion_scan_seq_per_sec",
@@ -295,7 +318,7 @@ def main():
                 "motion_fused_seq_per_sec",
                 lambda: round(motion_throughput("fused"), 1),
             )
-        else:
+        elif rnn_rows:
             extras["motion_fused_seq_per_sec"] = (
                 "skipped: no TPU (fused kernel would run interpreted)"
             )
@@ -437,8 +460,39 @@ def main():
             attempt("attention_seq1024_flash_bf16",
                     lambda: _attn_row(1024, batch=64, steps=15,
                                       impl="flash", precision="bf16"))
-        else:
+            # the r4 window showed flash == dense == ~4.6% MFU at the
+            # probe's dim=128/heads=4: head_dim 32 fills 1/4 of the MXU's
+            # 128-wide contraction in BOTH impls, so the kernel never
+            # differentiates.  These rows probe the kernel-relevant shape
+            # (head_dim 128) where the QK^T/PV matmuls tile the MXU
+            # fully, and the T=4096 point where dense's O(T^2) score
+            # materialization stops fitting at all (its row records the
+            # OOM/compile error as evidence; flash's O(T) VMEM state is
+            # what makes the long-context point reachable on one chip).
+            attempt("attention_seq1024_dim512_dense_bf16",
+                    lambda: _attn_row(1024, batch=16, steps=10,
+                                      impl="dense", precision="bf16",
+                                      dim=512, num_heads=4))
+            attempt("attention_seq1024_dim512_flash_bf16",
+                    lambda: _attn_row(1024, batch=16, steps=10,
+                                      impl="flash", precision="bf16",
+                                      dim=512, num_heads=4))
+            attempt("attention_seq4096_dim512_flash_bf16",
+                    lambda: _attn_row(4096, batch=8, steps=5,
+                                      impl="flash", precision="bf16",
+                                      dim=512, num_heads=4))
+            # LAST on purpose: the deliberately-failure-prone row (dense
+            # O(T^2) scores at T=4096 may OOM or hang the remote compile
+            # helper); everything measured before it is already on disk
+            # via --append-rows if this one wedges the process
+            attempt("attention_seq4096_dim512_dense_bf16",
+                    lambda: _attn_row(4096, batch=8, steps=5,
+                                      impl="dense", precision="bf16",
+                                      dim=512, num_heads=4))
+        elif rnn_rows:
             extras["char_rnn_50m"] = "skipped: no TPU"
+            extras["attention"] = "skipped: no TPU"
+        else:
             extras["attention"] = "skipped: no TPU"
 
     print(

@@ -5,13 +5,18 @@
 # (r4: one ~25-min window in ~13 h) - measurements must fire the moment
 # one opens, not when a human notices.
 #
-# Flap-safe: the watcher only exits once BOTH runners succeeded; a
-# tunnel drop mid-run leaves it looping for the next window.  Before
-# each run-chip attempt, FAILED rows are pruned from the results file -
-# the sweep's resume-by-skip filters on command-string presence
-# regardless of returncode, so a row that failed in a dead window would
-# otherwise be skipped forever.
+# Flap-safe: the watcher only exits once ALL THREE queued runners have
+# succeeded (ATTN bench rows, batch-512 bisection, run-chip sweep); a
+# tunnel drop mid-run leaves it looping for the next window.  Ordered by
+# value: never-measured work first (the dim-512/seq-4096 attention rows
+# via the fast `--suite attention` path with per-row append, then the
+# batch-512 bisection with its own per-rung append), the long resumable
+# run-chip sweep last.  Before each run-chip attempt, FAILED rows are
+# pruned from the results file - the sweep's resume-by-skip filters on
+# command-string presence regardless of returncode, so a row that failed
+# in a dead window would otherwise be skipped forever.
 cd /root/repo || exit 1
+ATTN_DONE=0
 B512_DONE=0
 CHIP_DONE=0
 while true; do
@@ -20,6 +25,21 @@ import jax
 assert jax.default_backend() == 'tpu'
 " >/dev/null 2>&1; then
     echo "$(date -u +%FT%TZ) tunnel LIVE - running queued chip runners" >> /tmp/chip_watcher.log
+    if [ "$ATTN_DONE" != 1 ]; then
+      timeout 1500 python bench.py --suite attention \
+        --append-rows results_bench_attn_rows.jsonl > /tmp/bench_attn.log 2>&1
+      # same predicate for the done-gate and the extraction: the single
+      # JSON contract line, which carries the backend field (bench.py
+      # falls back to CPU when the probe dies - a CPU line must not
+      # count); per-row evidence is already on disk via --append-rows
+      # even when the final emit never happens
+      line=$(grep '"metric"' /tmp/bench_attn.log | tail -1)
+      if [ -n "$line" ] && echo "$line" | grep -q '"backend": "tpu"'; then
+        echo "$line" > results_bench_chip_r4_attn.json
+        ATTN_DONE=1
+      fi
+      echo "$(date -u +%FT%TZ) attention bench done=$ATTN_DONE" >> /tmp/chip_watcher.log
+    fi
     if [ "$B512_DONE" != 1 ]; then
       timeout 900 python repro_batch512.py >> /tmp/chip_watcher.log 2>&1 \
         && B512_DONE=1
@@ -41,7 +61,7 @@ EOF
         >> /tmp/chip_watcher.log 2>&1 && CHIP_DONE=1
       echo "$(date -u +%FT%TZ) run-chip done=$CHIP_DONE" >> /tmp/chip_watcher.log
     fi
-    if [ "$B512_DONE" = 1 ] && [ "$CHIP_DONE" = 1 ]; then
+    if [ "$ATTN_DONE" = 1 ] && [ "$B512_DONE" = 1 ] && [ "$CHIP_DONE" = 1 ]; then
       echo "$(date -u +%FT%TZ) all queued runners complete" >> /tmp/chip_watcher.log
       exit 0
     fi
