@@ -101,6 +101,22 @@ def build_parser() -> argparse.ArgumentParser:
         "saving them (trades FLOPs for HBM; for deep/long configs)",
     )
     parser.add_argument(
+        "--checkpoint-format", default="gathered",
+        choices=["gathered", "sharded"],
+        help="gathered: reference-parity single file (state gathered to "
+        "the writing host).  sharded: orbax per-shard writes - each "
+        "process/device writes only the shards it owns, restore places "
+        "them back without ever building a host-side replica (the scale "
+        "path for fsdp/mesh layouts); --resume accepts the resulting "
+        ".orbax directory",
+    )
+    parser.add_argument(
+        "--checkpoint-async", action="store_true",
+        help="hand sharded checkpoint writes to orbax's background "
+        "thread so serialization overlaps training (drained before the "
+        "next save and at train end); needs --checkpoint-format sharded",
+    )
+    parser.add_argument(
         "--fuse-run", action="store_true",
         help="compile the whole multi-epoch training run into ONE device "
         "program (lax.scan over epochs) even with INFO logging on; "

@@ -122,6 +122,9 @@ def run_worker(args, rank: int):
             # the flag being silently dropped
             grad_accum=getattr(args, "grad_accum", 1),
             fuse_run=getattr(args, "fuse_run", False),
+            checkpoint_format=getattr(args, "checkpoint_format",
+                                      "gathered"),
+            checkpoint_async=getattr(args, "checkpoint_async", False),
         )
         _, train_history, _ = trainer.train(epochs=args.epochs)
         trainer.finish()

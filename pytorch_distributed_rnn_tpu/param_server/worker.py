@@ -49,6 +49,8 @@ class ParameterServerWorkerTrainer(Trainer):
         seed: int | None = None,
         grad_accum: int = 1,
         fuse_run: bool = False,
+        checkpoint_format: str = "gathered",
+        checkpoint_async: bool = False,
     ):
         sampler = DistributedSampler(
             len(training_set),
@@ -71,6 +73,12 @@ class ParameterServerWorkerTrainer(Trainer):
             # DEVICE_DATA=False: an explicit --fuse-run is rejected loudly
             # by the base gate (every step needs the host for push/pull)
             fuse_run=fuse_run,
+            # checkpointing is disabled on PS workers (checkpoint_dir=None
+            # above - reference parity), but the flags still route through
+            # base validation so bad combinations raise instead of being
+            # silently dropped
+            checkpoint_format=checkpoint_format,
+            checkpoint_async=checkpoint_async,
         )
         self.comm = comm
         self.worker_rank = worker_rank

@@ -148,6 +148,8 @@ def _run_trainer(args, trainer_class, model, datasets):
         checkpoint_every=getattr(args, "checkpoint_every", 0),
         grad_accum=getattr(args, "grad_accum", 1),
         fuse_run=getattr(args, "fuse_run", False),
+        checkpoint_format=getattr(args, "checkpoint_format", "gathered"),
+        checkpoint_async=getattr(args, "checkpoint_async", False),
     )
 
     if getattr(args, "resume", None):

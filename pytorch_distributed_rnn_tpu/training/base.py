@@ -87,8 +87,28 @@ class Trainer:
         checkpoint_every: int = 0,
         grad_accum: int = 1,
         fuse_run: bool = False,
+        checkpoint_format: str = "gathered",
+        checkpoint_async: bool = False,
     ):
         self.model = model
+        # gathered: the reference-parity single file (training/
+        # checkpoint.py) - state is gathered to the writing host.
+        # sharded: orbax/tensorstore per-shard writes - no gather, no
+        # host-side replica; the scale path for ZeRO/mesh layouts
+        # (training/sharded_checkpoint.py).
+        if checkpoint_format not in ("gathered", "sharded"):
+            raise ValueError(
+                f"unknown checkpoint format {checkpoint_format!r} - use "
+                "gathered or sharded"
+            )
+        if checkpoint_async and checkpoint_format != "sharded":
+            raise ValueError(
+                "--checkpoint-async overlaps the orbax background write "
+                "with training and needs --checkpoint-format sharded"
+            )
+        self.checkpoint_format = checkpoint_format
+        self.checkpoint_async = bool(checkpoint_async)
+        self._pending_ckpt = None
         # --fuse-run: compile the whole multi-epoch run into ONE device
         # program even when INFO logging is on (the perf line still
         # prints; only the per-epoch Start-Epoch messages are traded
@@ -506,28 +526,40 @@ class Trainer:
             # seed the best-model threshold from a resumed checkpoint so a
             # worse post-resume epoch cannot clobber best-model.ckpt
             best_loss = self._resume_best_loss
-            for epoch in range(epochs):
-                self.sampler.set_epoch(epoch)
-                self._epoch = epoch
-                logging.info(formatter.epoch_start_message(epoch))
-                train_loss, train_acc = self._train_epoch(formatter)
-                training_history.append(train_loss)
+            try:
+                for epoch in range(epochs):
+                    self.sampler.set_epoch(epoch)
+                    self._epoch = epoch
+                    logging.info(formatter.epoch_start_message(epoch))
+                    train_loss, train_acc = self._train_epoch(formatter)
+                    training_history.append(train_loss)
 
-                if (
-                    self.checkpoint_every
-                    and (epoch + 1) % self.checkpoint_every == 0
-                ):
-                    self._save_checkpoint(epoch, train_loss, best=False)
+                    if (
+                        self.checkpoint_every
+                        and (epoch + 1) % self.checkpoint_every == 0
+                    ):
+                        self._save_checkpoint(epoch, train_loss, best=False)
 
-                if self.validation_set is not None:
-                    validation_loss, _ = self._evaluate(
-                        self.validation_set, formatter, epoch
-                    )
-                    validation_history.append(validation_loss)
-                    if best_loss is None or best_loss > validation_loss:
-                        logging.info(f"New best model in epoch {epoch + 1}")
-                        best_loss = validation_loss
-                        self._save_checkpoint(epoch, validation_loss, best=True)
+                    if self.validation_set is not None:
+                        validation_loss, _ = self._evaluate(
+                            self.validation_set, formatter, epoch
+                        )
+                        validation_history.append(validation_loss)
+                        if best_loss is None or best_loss > validation_loss:
+                            logging.info(
+                                f"New best model in epoch {epoch + 1}"
+                            )
+                            best_loss = validation_loss
+                            self._save_checkpoint(
+                                epoch, validation_loss, best=True
+                            )
+            finally:
+                # finally, and inside the timed region on purpose: an
+                # async sharded save that has not landed is training time
+                # still owed, and a later-epoch exception must not strand
+                # the in-flight write un-finalized (the crash-resume case
+                # checkpoints exist for)
+                self._drain_checkpoint()
 
         _, memory, duration = measure_memory_and_time(train_inner)
         logging.info(formatter.performance_message(memory, duration))
@@ -735,6 +767,23 @@ class Trainer:
     def _save_checkpoint(self, epoch, loss, best=False):
         if self.checkpoint_dir is None:
             return
+        if self.checkpoint_format == "sharded":
+            from pytorch_distributed_rnn_tpu.training.sharded_checkpoint import (  # noqa: E501 - lazy: orbax import is heavy
+                save_sharded,
+            )
+
+            # no _checkpoint_state() gather and no rank gate: every
+            # process hands orbax its OWN shards and rank coordination is
+            # orbax's (meta sidecar written by process 0 inside).  At most
+            # one save in flight: wait on the previous async write first
+            # (orbax serializes on device arrays; overlapping two saves
+            # of best-model would also race the directory rename).
+            self._drain_checkpoint()
+            self._pending_ckpt = save_sharded(
+                self.checkpoint_dir, epoch, self.params, self.opt_state,
+                loss, best=best, async_=self.checkpoint_async,
+            )
+            return
         params, opt_state = self._checkpoint_state()
         if not self._should_write_checkpoint():
             return
@@ -742,11 +791,40 @@ class Trainer:
             self.checkpoint_dir, epoch, params, opt_state, loss, best=best
         )
 
+    def _drain_checkpoint(self):
+        """Block until the in-flight async sharded save (if any) is
+        durable; called before the next save and at train end."""
+        if self._pending_ckpt is not None:
+            self._pending_ckpt.wait()
+            self._pending_ckpt = None
+
     def resume_from(self, checkpoint_path):
         """Restore params/optimizer state (new capability; the reference's
-        checkpoints were write-only).  Returns the checkpoint metadata."""
-        self.params, self.opt_state, meta = load_checkpoint(
-            checkpoint_path, self.params, self.opt_state
+        checkpoints were write-only).  Returns the checkpoint metadata.
+
+        Dispatches on the path's shape: a ``.orbax`` DIRECTORY restores
+        shard-by-shard onto the live state's shardings (no gather); a
+        file is the gathered single-file format."""
+        from pytorch_distributed_rnn_tpu.training.sharded_checkpoint import (
+            is_sharded_checkpoint,
+            restore_sharded,
         )
+
+        if is_sharded_checkpoint(checkpoint_path):
+            self.params, self.opt_state, meta = restore_sharded(
+                checkpoint_path, self.params, self.opt_state
+            )
+        elif Path(checkpoint_path).is_dir():
+            # e.g. --resume models/ (the parent) - neither format; say so
+            # instead of handing a directory to the single-file loader
+            raise ValueError(
+                f"{checkpoint_path} is a directory but not a sharded "
+                "checkpoint - pass the .orbax dir itself (sharded) or "
+                "the .ckpt file (gathered)"
+            )
+        else:
+            self.params, self.opt_state, meta = load_checkpoint(
+                checkpoint_path, self.params, self.opt_state
+            )
         self._resume_best_loss = meta["loss"]
         return meta

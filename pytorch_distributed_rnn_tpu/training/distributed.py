@@ -63,6 +63,8 @@ class SpmdTrainer(Trainer):
         checkpoint_every: int = 0,
         grad_accum: int = 1,
         fuse_run: bool = False,
+        checkpoint_format: str = "gathered",
+        checkpoint_async: bool = False,
     ):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.axis = axis
@@ -84,6 +86,8 @@ class SpmdTrainer(Trainer):
             checkpoint_every=checkpoint_every,
             grad_accum=grad_accum,
             fuse_run=fuse_run,
+            checkpoint_format=checkpoint_format,
+            checkpoint_async=checkpoint_async,
         )
         self.world_size = world_size
         # single controller: one process reports as rank 0.  In a

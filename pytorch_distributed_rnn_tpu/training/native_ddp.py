@@ -66,7 +66,26 @@ class NativeDDPTrainer(Trainer):
         seed: int | None = None,
         grad_accum: int = 1,
         fuse_run: bool = False,
+        checkpoint_format: str = "gathered",
+        checkpoint_async: bool = False,
     ):
+        if checkpoint_async:
+            # base validation would also reject (async needs sharded),
+            # but sharded itself is rejected here - say why directly
+            raise ValueError(
+                "--checkpoint-async needs --checkpoint-format sharded, "
+                "which distributed-native does not support (no "
+                "jax.distributed world for orbax to coordinate)"
+            )
+        if checkpoint_format == "sharded":
+            # the TCP world has no jax.distributed client, so orbax would
+            # see world_size independent "process 0"s all renaming the
+            # same directory - reject instead of corrupting
+            raise ValueError(
+                "distributed-native checkpoints are per-rank local files; "
+                "--checkpoint-format sharded needs a jax.distributed "
+                "world (local/distributed/fsdp/mesh strategies)"
+            )
         rank = comm.rank
         world = comm.world_size
         sampler = DistributedSampler(
@@ -158,6 +177,8 @@ def run_rank(comm, args, model, datasets, trainer_class=None):
         # flag being silently dropped
         grad_accum=getattr(args, "grad_accum", 1),
         fuse_run=getattr(args, "fuse_run", False),
+        checkpoint_format=getattr(args, "checkpoint_format", "gathered"),
+        checkpoint_async=getattr(args, "checkpoint_async", False),
     )
     if getattr(args, "resume", None):
         meta = trainer.resume_from(args.resume)

@@ -1,0 +1,157 @@
+"""Sharded checkpointing: each process writes only the shards it owns.
+
+The reference's checkpoints are ``torch.save`` pickles of a full replica
+(``/root/reference/src/motion/trainer/base.py:164-177``); the gathered
+format (``training/checkpoint.py``) reproduces that contract byte-for-
+byte-portably.  This module is the scale path the gathered format cannot
+take: a ZeRO/FSDP-sharded model is sharded precisely because ONE replica
+does not comfortably exist, yet ``ZeroTrainer._checkpoint_state`` must
+all-gather exactly such a replica before rank 0 can write it.  Here the
+state tree goes to orbax/tensorstore as-is: every array is written
+shard-by-shard by the devices that own it (multi-controller worlds
+coordinate through the jax.distributed client orbax picks up), and
+restore places each shard directly onto its target device from the
+template's sharding - the full model never materializes in any single
+host's memory in either direction.
+
+Async mode hands the device arrays to orbax's background thread and
+returns to the training loop immediately (the copy to host overlaps the
+next epochs' compute); the trainer waits on the previous save before
+starting the next one, and drains at train end.
+
+Layout on disk: ``<dir>/<name>.orbax/`` (an orbax StandardSave tree of
+``{"params": ..., "opt_state": ...}``) plus ``<dir>/<name>.meta.json``
+carrying ``{epoch, loss}`` - sibling file, not inside the orbax dir,
+because orbax finalizes its directory atomically.  Names mirror the
+gathered format: ``best-model`` / ``checkpoint-epoch-N``.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import jax
+
+
+def _checkpointer(async_: bool):
+    import orbax.checkpoint as ocp
+
+    handler = ocp.StandardCheckpointHandler()
+    return (ocp.AsyncCheckpointer(handler) if async_
+            else ocp.Checkpointer(handler))
+
+
+def checkpoint_name(epoch: int, best: bool) -> str:
+    return "best-model" if best else f"checkpoint-epoch-{epoch + 1}"
+
+
+class ShardedCheckpointHandle:
+    """A possibly-in-flight sharded save.  ``wait()`` blocks until the
+    write is durable; idempotent."""
+
+    def __init__(self, checkpointer, path: Path, meta: dict):
+        self._checkpointer = checkpointer
+        self.path = path
+        self._meta = meta
+
+    def wait(self):
+        if self._checkpointer is None:
+            return
+        # sync Checkpointer has no wait_until_finished (save already
+        # returned durable); AsyncCheckpointer does
+        wait = getattr(self._checkpointer, "wait_until_finished", None)
+        if wait is not None:
+            wait()
+        self._checkpointer.close()
+        self._checkpointer = None
+        # the meta sidecar is written only AFTER the orbax write is
+        # durable: writing it at submit time would let a crash mid-
+        # background-write (or an in-flight best-model overwrite) leave
+        # meta describing state the .orbax dir does not hold
+        if jax.process_index() == 0:
+            meta_path = self.path.parent / (
+                self.path.name[:-len(".orbax")] + ".meta.json")
+            with open(meta_path, "w") as f:
+                json.dump(self._meta, f)
+
+    @property
+    def in_flight(self) -> bool:
+        return self._checkpointer is not None
+
+
+def save_sharded(checkpoint_dir, epoch: int, params, opt_state,
+                 loss: float, *, best: bool = False,
+                 async_: bool = False) -> ShardedCheckpointHandle:
+    """Write ``{params, opt_state}`` sharded; returns a handle.
+
+    Synchronous unless ``async_``; an async save's handle MUST be
+    ``wait()``-ed before the process exits (the trainer drains it).
+    Every process of a multi-controller world must call this - the
+    shard writes and the final directory rename are coordinated.
+    """
+    checkpoint_dir = Path(checkpoint_dir).resolve()  # orbax wants absolute
+    checkpoint_dir.mkdir(parents=True, exist_ok=True)
+    name = checkpoint_name(epoch, best)
+    path = checkpoint_dir / f"{name}.orbax"
+    import orbax.checkpoint as ocp
+
+    checkpointer = _checkpointer(async_)
+    checkpointer.save(
+        path,
+        args=ocp.args.StandardSave({"params": params,
+                                    "opt_state": opt_state}),
+        force=True,  # overwrite: best-model is rewritten on every new best
+    )
+    handle = ShardedCheckpointHandle(
+        checkpointer, path, {"epoch": epoch + 1, "loss": float(loss)})
+    if not async_:
+        handle.wait()
+    return handle
+
+
+def is_sharded_checkpoint(path) -> bool:
+    """A sharded checkpoint is a ``.orbax``-suffixed DIRECTORY (the
+    gathered format is a single file).  The suffix requirement keeps an
+    accidental ``--resume <checkpoint parent dir>`` from dispatching
+    into orbax and dying with an opaque tensorstore error."""
+    path = Path(path)
+    return path.is_dir() and path.name.endswith(".orbax")
+
+
+def restore_sharded(path, params_template, opt_state_template):
+    """Restore ``(params, opt_state, meta)`` from a ``.orbax`` dir.
+
+    Templates are the trainer's LIVE state: their shapes/dtypes validate
+    the tree and their shardings tell orbax where each restored shard
+    belongs, so a ZeRO-laid-out trainer gets its layout back without a
+    gather or a host-side replica.
+    """
+    path = Path(path).resolve()
+    if not is_sharded_checkpoint(path):
+        raise ValueError(
+            f"{path} is not a sharded checkpoint (expected an existing "
+            ".orbax directory, e.g. models/checkpoint-epoch-3.orbax)"
+        )
+    import orbax.checkpoint as ocp
+
+    def _abstract(x):
+        if isinstance(x, jax.Array):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                        sharding=x.sharding)
+        return x  # non-array leaves (ints in optax state) restore as-is
+
+    abstract = jax.tree.map(
+        _abstract, {"params": params_template,
+                    "opt_state": opt_state_template})
+    with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as checkpointer:
+        restored = checkpointer.restore(
+            path, args=ocp.args.StandardRestore(abstract))
+
+    meta_path = path.parent / (path.name[:-len(".orbax")] + ".meta.json")
+    if meta_path.exists():
+        with open(meta_path) as f:
+            meta = json.load(f)
+    else:  # meta is auxiliary; a missing sibling must not block restore
+        meta = {"epoch": 0, "loss": float("inf")}
+    return restored["params"], restored["opt_state"], meta
