@@ -78,6 +78,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="expert count for --model moe (must shard over the ep mesh "
         "axis); expert FFN hidden dim defaults to 2 x --hidden-units",
     )
+    parser.add_argument(
+        "--moe-top-k", default=1, type=int, choices=[1, 2],
+        help="experts per token for --model moe: 1 = Switch routing "
+        "(raw max-gate combine weight), 2 = GShard (renormalized top-2 "
+        "gates; capacity slots assigned choice-major so second choices "
+        "drop first under pressure)",
+    )
     parser.add_argument("--resume", default=None, type=Path)
     parser.add_argument(
         "--checkpoint-every", default=0, type=int, metavar="N",

@@ -47,6 +47,9 @@ class MoEClassifier:
     layer_dim: int = 2
     output_dim: int = 6
     num_experts: int = 4
+    num_selected: int = 1  # experts per token: 1 = Switch (raw max-gate
+    # combine weight), 2 = GShard (renormalized top-2 gates, choice-major
+    # capacity slots - second choices drop first under pressure)
     expert_hidden: int | None = None  # default 2 * hidden_dim
     capacity_factor: float = 2.0
     aux_weight: float = 0.01  # Switch load-balancing loss weight
@@ -57,6 +60,16 @@ class MoEClassifier:
     # and the aux loss are the numerics that must not quantize
     remat: bool = False  # recompute the backbone layers and the MoE FFN
     # during backward instead of saving their activations
+
+    def __post_init__(self):
+        if not 1 <= self.num_selected <= self.num_experts:
+            # validated here (not only in the CLI) so the library surface
+            # fails with the flag names instead of a deep lax.top_k
+            # trace error ("k > last dimension of operand")
+            raise ValueError(
+                f"--moe-top-k {self.num_selected} needs at least that "
+                f"many experts (--num-experts {self.num_experts})"
+            )
 
     @property
     def _expert_hidden(self) -> int:
@@ -88,8 +101,11 @@ class MoEClassifier:
         from pytorch_distributed_rnn_tpu.ops.moe import cast_expert_params
 
         moe_params = cast_expert_params(params["moe"], compute_dtype)
-        moe_fn = (jax.checkpoint(moe_ffn_dense) if self.remat
-                  else moe_ffn_dense)
+
+        def dense(p, h):
+            return moe_ffn_dense(p, h, num_selected=self.num_selected)
+
+        moe_fn = jax.checkpoint(dense) if self.remat else dense
         moe_out, aux = moe_fn(moe_params, out)
         return out + moe_out, aux
 

@@ -1,19 +1,27 @@
-"""Mixture-of-experts FFN: token-choice (top-1, Switch-style) routing.
+"""Mixture-of-experts FFN: token-choice top-1 (Switch) and top-2 (GShard)
+routing.
 
 The reference has no MoE (SURVEY.md checklist: expert parallelism absent).
-This is the capability layer for the ``ep`` mesh axis: a router picks one
-expert per token, tokens are dispatched into per-expert capacity slots via
-one-hot matmuls (the TPU-friendly formulation - dense einsums instead of
-scatter/gather, so everything tiles onto the MXU), experts run their FFN,
-and outputs combine back weighted by the gate probability.
+This is the capability layer for the ``ep`` mesh axis: a router picks
+``num_selected`` experts per token, tokens are dispatched into per-expert
+capacity slots via one-hot matmuls (the TPU-friendly formulation - dense
+einsums instead of scatter/gather, so everything tiles onto the MXU),
+experts run their FFN, and outputs combine back weighted by the gate
+probabilities.
+
+Routing conventions follow the papers: ``num_selected=1`` is Switch - the
+combine weight is the RAW max gate probability; ``num_selected>=2`` is
+GShard - the selected gates are renormalized to sum to 1, and capacity
+slots are assigned choice-major (every token's first choice outranks any
+second choice), so under pressure second choices drop first.
 
 ``moe_ffn_dense`` computes every expert on every token (exact, O(E) flops)
 - the numerics reference.  ``moe_ffn`` dispatches through capacity slots;
 with ``capacity >= tokens routed to the busiest expert`` it matches the
-dense path exactly, otherwise overflow tokens drop (standard Switch
-behavior - the combine weight for dropped tokens is zero, so they pass
-through the residual unchanged).  ``parallel/ep.py`` shards the expert
-dimension of the same formulation over the mesh.
+dense path exactly, otherwise overflow tokens drop (the combine weight
+for dropped tokens is zero, so they pass through the residual unchanged).
+``parallel/ep.py`` shards the expert dimension of the same formulation
+over the mesh.
 """
 
 from __future__ import annotations
@@ -66,6 +74,20 @@ def _route(params, x):
     return expert, prob, gates
 
 
+def _route_topk(params, x, k: int):
+    """Top-k routing: returns (experts (N, k), probs (N, k), gates (N, E)).
+
+    ``k=1`` reproduces :func:`_route` exactly (raw max-gate combine
+    weight, Switch).  ``k>=2`` renormalizes the selected gates to sum to
+    1 per token (GShard eq. 1)."""
+    logits = x @ params["router"]["weight"].T + params["router"]["bias"]
+    gates = jax.nn.softmax(logits, axis=-1)
+    probs, experts = jax.lax.top_k(gates, k)
+    if k > 1:
+        probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    return experts, probs, gates
+
+
 def load_balancing_loss(gates, expert, num_experts: int):
     """Switch aux loss: E * sum_e (fraction of tokens to e) * (mean gate
     prob of e); minimized at uniform routing."""
@@ -109,42 +131,83 @@ def make_dispatch(expert, prob, num_experts: int, capacity: int, dtype):
     return dispatch, combine
 
 
-def moe_ffn(params, x, *, capacity_factor: float = 2.0):
-    """Top-1 MoE FFN over tokens ``x`` (..., D) via one-hot dispatch.
+def make_dispatch_topk(experts, probs, num_experts: int, capacity: int,
+                       dtype):
+    """(N, E, C) dispatch/combine tensors from top-k assignments.
 
-    Capacity per expert = ceil(tokens / E * capacity_factor).
+    Slots are assigned CHOICE-MAJOR (GShard): all tokens' choice-0
+    assignments take positions before any choice-1 assignment, so when an
+    expert overflows its capacity, second choices are dropped first.
+    ``k=1`` degenerates to :func:`make_dispatch` exactly.
     """
+    n, k = experts.shape
+    # flatten choice-major: rows [choice0 tokens..., choice1 tokens...]
+    flat_experts = experts.T.reshape(-1)  # (k*N,)
+    flat_probs = probs.T.reshape(-1)
+    dispatch_flat, combine_flat = make_dispatch(
+        flat_experts, flat_probs, num_experts, capacity, dtype
+    )
+    # fold the k choice rows of each token back together: a token's
+    # dispatch is the SUM of its per-choice one-hots (disjoint slots, so
+    # the sum stays one-hot per (expert, slot))
+    dispatch = dispatch_flat.reshape(k, n, num_experts, capacity).sum(0)
+    combine = combine_flat.reshape(k, n, num_experts, capacity).sum(0)
+    return dispatch, combine
+
+
+def moe_capacity(n_tokens: int, num_experts: int, capacity_factor: float,
+                 num_selected: int = 1) -> int:
+    """Capacity per expert = ceil(assignments / E * capacity_factor),
+    where assignments = tokens x num_selected (GShard scales capacity
+    with k; k=1 reduces to the Switch formula).  ONE definition shared by
+    the dense dispatch and the ep-sharded path, so the two can never
+    disagree on drop behavior."""
+    return int(-(-n_tokens * num_selected * capacity_factor // num_experts))
+
+
+def moe_ffn(params, x, *, capacity_factor: float = 2.0,
+            num_selected: int = 1):
+    """Top-k MoE FFN over tokens ``x`` (..., D) via one-hot dispatch."""
     shape = x.shape
     d = shape[-1]
     xt = x.reshape(-1, d)
     n = xt.shape[0]
     e = params["w1"].shape[0]
-    capacity = int(-(-n * capacity_factor // e))
+    capacity = moe_capacity(n, e, capacity_factor, num_selected)
 
-    expert, prob, gates = _route(params, xt)
-    dispatch, combine = make_dispatch(expert, prob, e, capacity, xt.dtype)
+    experts, probs, gates = _route_topk(params, xt, num_selected)
+    dispatch, combine = make_dispatch_topk(experts, probs, e, capacity,
+                                           xt.dtype)
     tokens = jnp.einsum("nec,nd->ecd", dispatch, xt)
     out = jnp.einsum("nec,ecd->nd", combine, _expert_ffn(params, tokens))
-    aux = load_balancing_loss(gates, expert, e)
+    aux = load_balancing_loss(gates, experts[:, 0], e)
     return out.reshape(shape), aux
 
 
-def moe_ffn_dense(params, x):
-    """Exact top-1 MoE: every expert computes every token, the gate picks.
-    O(E) compute - the parity reference for the dispatched paths."""
+def moe_ffn_dense(params, x, *, num_selected: int = 1):
+    """Exact top-k MoE: every expert computes every token, the gates
+    pick.  O(E) compute - the parity reference for the dispatched
+    paths."""
     shape = x.shape
     d = shape[-1]
     xt = x.reshape(-1, d)
     e = params["w1"].shape[0]
 
-    expert, prob, gates = _route(params, xt)
+    experts, probs, gates = _route_topk(params, xt, num_selected)
     h = jax.nn.gelu(
         jnp.einsum("nd,edh->neh", xt, params["w1"]) + params["b1"][None]
     )
     all_out = (
         jnp.einsum("neh,ehd->ned", h, params["w2"]) + params["b2"][None]
     )
-    sel = jax.nn.one_hot(expert, e, dtype=xt.dtype)
-    out = jnp.einsum("ne,ned->nd", sel, all_out) * prob[:, None]
-    aux = load_balancing_loss(gates, expert, e)
+    # (N, E) selection weights: sum of prob-weighted one-hots over the k
+    # choices (distinct experts, so no double counting)
+    sel = jnp.einsum(
+        "nk,nke->ne", probs,
+        jax.nn.one_hot(experts, e, dtype=xt.dtype),
+    )
+    out = jnp.einsum("ne,ned->nd", sel, all_out)
+    # aux on the FIRST choice (Switch/GShard convention: the primary
+    # assignment is what load balancing shapes)
+    aux = load_balancing_loss(gates, experts[:, 0], e)
     return out.reshape(shape), aux

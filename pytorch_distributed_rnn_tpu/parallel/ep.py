@@ -23,22 +23,25 @@ from jax import shard_map
 
 from pytorch_distributed_rnn_tpu.ops.moe import (
     _expert_ffn,
-    _route,
-    make_dispatch,
+    _route_topk,
+    make_dispatch_topk,
+    moe_capacity,
 )
 
 
 def ep_moe_ffn(params, x_local, axis: str, *, capacity_factor: float = 2.0,
-               stat_axes=None):
-    """Expert-parallel top-1 MoE FFN inside ``shard_map``.
+               num_selected: int = 1, stat_axes=None):
+    """Expert-parallel top-k MoE FFN inside ``shard_map``.
 
     ``params`` replicated, ``x_local``: this shard's (..., D) tokens
-    (batch-sharded along ``axis``).  Returns ``(out_local, aux_loss)`` with
-    ``aux_loss`` the Switch load-balancing loss averaged over
-    ``stat_axes`` (default: the expert axis only).  When tokens also
-    shard over other mesh axes (the dp x ep training layout), pass them
-    all so the aux fractions are means over the GLOBAL batch - averaging
-    per-shard aux products instead would bias the estimator.
+    (batch-sharded along ``axis``).  ``num_selected=1`` is Switch,
+    ``2`` is GShard (renormalized gates, choice-major capacity).
+    Returns ``(out_local, aux_loss)`` with ``aux_loss`` the Switch
+    load-balancing loss averaged over ``stat_axes`` (default: the expert
+    axis only).  When tokens also shard over other mesh axes (the
+    dp x ep training layout), pass them all so the aux fractions are
+    means over the GLOBAL batch - averaging per-shard aux products
+    instead would bias the estimator.
     """
     n = lax.axis_size(axis)
     k = lax.axis_index(axis)
@@ -50,10 +53,12 @@ def ep_moe_ffn(params, x_local, axis: str, *, capacity_factor: float = 2.0,
     if e % n != 0:
         raise ValueError(f"{e} experts do not shard over {n} devices")
     e_local = e // n
-    capacity = int(-(-n_tok * capacity_factor // e))
+    capacity = moe_capacity(n_tok, e, capacity_factor, num_selected)
 
-    expert, prob, gates = _route(params, xt)
-    dispatch, combine = make_dispatch(expert, prob, e, capacity, xt.dtype)
+    experts_k, probs_k, gates = _route_topk(params, xt, num_selected)
+    expert = experts_k[:, 0]  # first choice drives the aux loss below
+    dispatch, combine = make_dispatch_topk(experts_k, probs_k, e, capacity,
+                                           xt.dtype)
 
     # pack local tokens into (E, C, D) slots, send each expert block to its
     # owner: (E, C, D) -> (E/n, n*C, D) with slots ordered by source shard
@@ -85,6 +90,7 @@ def ep_moe_ffn(params, x_local, axis: str, *, capacity_factor: float = 2.0,
 
 def make_ep_train_step(optimizer, mesh, axis: str = "ep", *,
                        capacity_factor: float = 2.0,
+                       num_selected: int = 1,
                        aux_weight: float = 0.01, donate: bool = True):
     """Jitted expert-parallel MoE *training* step (regression shape):
     ``step(params, opt_state, x, y)`` with ``x``/``y`` (N, D) sharded
@@ -107,7 +113,8 @@ def make_ep_train_step(optimizer, mesh, axis: str = "ep", *,
     )
     def loss_fn(params, x_local, y_local):
         out, aux = ep_moe_ffn(params, x_local, axis,
-                              capacity_factor=capacity_factor)
+                              capacity_factor=capacity_factor,
+                              num_selected=num_selected)
         local = jnp.mean((out - y_local) ** 2)
         return lax.pmean(local, axis) + aux_weight * aux
 
@@ -121,7 +128,8 @@ def make_ep_train_step(optimizer, mesh, axis: str = "ep", *,
 
 
 def make_ep_moe_forward(mesh, axis: str = "ep", *,
-                        capacity_factor: float = 2.0):
+                        capacity_factor: float = 2.0,
+                        num_selected: int = 1):
     """Jitted expert-parallel MoE FFN: tokens (N, D) sharded along ``axis``
     on entry, outputs sharded the same way; aux loss replicated."""
 
@@ -134,6 +142,7 @@ def make_ep_moe_forward(mesh, axis: str = "ep", *,
     )
     def forward(params, x_local):
         return ep_moe_ffn(params, x_local, axis,
-                          capacity_factor=capacity_factor)
+                          capacity_factor=capacity_factor,
+                          num_selected=num_selected)
 
     return jax.jit(forward)

@@ -936,7 +936,9 @@ def make_moe_mesh_loss_fn(model, mesh, *, weighted: bool = False):
         def moe_call(mp, h_in):
             return ep_moe_ffn(
                 mp, h_in, "ep",
-                capacity_factor=model.capacity_factor, stat_axes=data,
+                capacity_factor=model.capacity_factor,
+                num_selected=model.num_selected,
+                stat_axes=data,
             )
 
         moe_fn = jax.checkpoint(moe_call) if remat else moe_call

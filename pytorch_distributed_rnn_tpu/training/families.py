@@ -120,6 +120,7 @@ def build_model(args, training_set):
             layer_dim=args.stacked_layer,
             output_dim=len(MotionDataset.LABELS),
             num_experts=getattr(args, "num_experts", 4),
+            num_selected=getattr(args, "moe_top_k", 1),
             cell=getattr(args, "cell", "lstm"),
             precision=getattr(args, "precision", "f32"),
             remat=getattr(args, "remat", False),

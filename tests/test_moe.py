@@ -56,6 +56,96 @@ def test_ep_matches_dense(setup, ep):
     np.testing.assert_allclose(aux_ep, aux_d, rtol=1e-6, atol=1e-7)
 
 
+class TestTop2Routing:
+    """GShard-style top-2: k=1 degenerates to Switch exactly, top-2
+    matches its dense reference, second choices drop first under
+    capacity pressure, and the ep path agrees."""
+
+    def test_k1_matches_switch_exactly(self, setup):
+        from pytorch_distributed_rnn_tpu.ops.moe import (
+            _route,
+            _route_topk,
+            make_dispatch,
+            make_dispatch_topk,
+        )
+
+        params, x = setup
+        expert, prob, gates = _route(params, x)
+        experts_k, probs_k, gates_k = _route_topk(params, x, 1)
+        np.testing.assert_array_equal(experts_k[:, 0], expert)
+        np.testing.assert_allclose(probs_k[:, 0], prob, rtol=1e-6)
+        np.testing.assert_allclose(gates_k, gates, rtol=1e-6)
+
+        d1, c1 = make_dispatch(expert, prob, E, 8, x.dtype)
+        dk, ck = make_dispatch_topk(experts_k, probs_k, E, 8, x.dtype)
+        np.testing.assert_allclose(dk, d1, atol=0)
+        np.testing.assert_allclose(ck, c1, atol=0)
+
+    def test_dense_top2_matches_manual(self, setup):
+        params, x = setup
+        out, _ = moe_ffn_dense(params, x, num_selected=2)
+
+        from pytorch_distributed_rnn_tpu.ops.moe import (
+            _expert_ffn,
+            _route_topk,
+        )
+
+        experts, probs, _ = _route_topk(params, x, 2)
+        # manual: run each token through its two experts, mix by the
+        # renormalized gates
+        want = np.zeros_like(np.asarray(x))
+        for j in range(2):
+            per_tok = _expert_ffn(
+                params, x[None, :, :].repeat(E, axis=0)
+            )  # (E, N, D): every expert on every token
+            sel = np.asarray(per_tok)[
+                np.asarray(experts)[:, j], np.arange(N)
+            ]
+            want += np.asarray(probs)[:, j:j + 1] * sel
+        np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+    def test_dispatch_top2_matches_dense_with_ample_capacity(self, setup):
+        params, x = setup
+        out_d, aux_d = moe_ffn_dense(params, x, num_selected=2)
+        out, aux = moe_ffn(params, x, capacity_factor=float(E),
+                           num_selected=2)
+        np.testing.assert_allclose(out, out_d, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(aux, aux_d, rtol=1e-6, atol=1e-7)
+
+    def test_top2_probs_renormalize(self, setup):
+        from pytorch_distributed_rnn_tpu.ops.moe import _route_topk
+
+        params, x = setup
+        _, probs, _ = _route_topk(params, x, 2)
+        np.testing.assert_allclose(np.asarray(probs).sum(-1), 1.0,
+                                   rtol=1e-6)
+
+    def test_second_choices_drop_first(self):
+        """Choice-major capacity: when an expert overflows, the surviving
+        assignments are first choices."""
+        from pytorch_distributed_rnn_tpu.ops.moe import make_dispatch_topk
+
+        # 3 tokens; expert 0 is token 0's FIRST choice and tokens 1-2's
+        # SECOND choice; capacity 2 on expert 0 -> token 0's assignment
+        # plus ONE second choice survive (choice-major: t0 outranks both)
+        experts = jnp.asarray([[0, 1], [2, 0], [2, 0]])
+        probs = jnp.full((3, 2), 0.5)
+        dispatch, _ = make_dispatch_topk(experts, probs, 3, 2, jnp.float32)
+        to_e0 = np.asarray(dispatch)[:, 0, :].sum(axis=-1)  # per token
+        assert to_e0[0] == 1.0  # the first choice survived
+        assert to_e0[1] + to_e0[2] == 1.0  # only one second choice fit
+
+    @pytest.mark.parametrize("ep", [2, 4])
+    def test_ep_top2_matches_dense(self, setup, ep):
+        params, x = setup
+        mesh = make_mesh({"ep": ep})
+        out_ep, aux_ep = make_ep_moe_forward(
+            mesh, capacity_factor=float(E), num_selected=2)(params, x)
+        out_d, aux_d = moe_ffn_dense(params, x, num_selected=2)
+        np.testing.assert_allclose(out_ep, out_d, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(aux_ep, aux_d, rtol=1e-6, atol=1e-7)
+
+
 def test_moe_training_balances_and_learns(setup):
     """Aux-weighted training: loss decreases and routing spreads."""
     import optax

@@ -86,6 +86,37 @@ class TestMoEMeshParity:
         for a, b in zip(jax.tree.leaves(gm), jax.tree.leaves(gd)):
             np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-6)
 
+    @pytest.mark.parametrize("axes", [
+        {"dp": 1, "ep": 4}, {"dp": 2, "ep": 2},
+    ])
+    def test_ep_top2_loss_and_grads_match_dense(self, axes):
+        """The GShard top-2 routing composes with the dp x ep mesh: with
+        ample capacity the expert-parallel program equals the dense-exact
+        top-2 path - loss AND gradients."""
+        model = _model(num_experts=4, capacity_factor=4.0, num_selected=2)
+        params = model.init(jax.random.PRNGKey(0))
+        mesh = make_mesh(axes)
+        x = jax.random.normal(jax.random.PRNGKey(1), (16, 12, 5))
+        y = jax.random.randint(jax.random.PRNGKey(2), (16,), 0, 6)
+
+        mesh_loss = make_moe_mesh_loss_fn(model, mesh)
+
+        def dense_loss(p):
+            logits, aux = model.apply_with_aux(p, x)
+            return (
+                cross_entropy_loss(logits, y) + model.aux_weight * aux,
+                jnp.sum(jnp.argmax(logits, axis=1) == y),
+            )
+
+        (lm, mm), gm = jax.value_and_grad(mesh_loss, has_aux=True)(
+            params, x, y
+        )
+        (ld, cd), gd = jax.value_and_grad(dense_loss, has_aux=True)(params)
+        np.testing.assert_allclose(float(lm), float(ld), rtol=1e-5)
+        assert int(mm["correct"]) == int(cd)
+        for a, b in zip(jax.tree.leaves(gm), jax.tree.leaves(gd)):
+            np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-6)
+
     def test_weighted_mask_matches_smaller_batch(self):
         """Zero-weighted padding rows reproduce the unpadded batch's CE
         term exactly (the fused-run contract), with the exact
