@@ -271,7 +271,7 @@ def _char_sp_program(dp: int, sp: int):
 
 
 def _motion_pp_program(dp: int, pp: int, schedule: str = "gpipe",
-                       num_microbatches: int = 2):
+                       num_microbatches: int = 2, num_chunks: int = 1):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -287,14 +287,15 @@ def _motion_pp_program(dp: int, pp: int, schedule: str = "gpipe",
 
     axes = {"dp": dp, "pp": pp}
     mesh = make_mesh(axes)
-    model = MotionModel(input_dim=9, hidden_dim=8, layer_dim=pp,
-                        output_dim=6)
+    model = MotionModel(input_dim=9, hidden_dim=8,
+                        layer_dim=pp * num_chunks, output_dim=6)
     params = model.init(jax.random.PRNGKey(6))
     opt = optax.adam(1e-3)
     state = opt.init(params)
-    if schedule == "1f1b":
+    if schedule in ("1f1b", "interleaved"):
         loss_fn = make_motion_pp_1f1b_loss_fn(
-            mesh, axes, num_microbatches=num_microbatches)
+            mesh, axes, num_microbatches=num_microbatches,
+            num_chunks=num_chunks)
     else:
         loss_fn = make_motion_mesh_loss_fn(
             mesh, axes, num_microbatches=num_microbatches)
@@ -374,6 +375,12 @@ def report_programs(n_devices: int = 8) -> list[dict]:
         (f"motion mesh dp={n_devices // 2},pp=2 (1F1B self-scheduled)",
          lambda: _motion_pp_program(n_devices // 2, 2, schedule="1f1b"),
          {"schedule": [pp_schedule_stats(2, m, "1f1b")
+                       for m in (2, 4, 8)]}),
+        (f"motion mesh dp={n_devices // 2},pp=2 (interleaved, 2 chunks)",
+         lambda: _motion_pp_program(n_devices // 2, 2,
+                                    schedule="interleaved", num_chunks=2),
+         {"schedule": [pp_schedule_stats(2, m, "interleaved",
+                                         num_chunks=2)
                        for m in (2, 4, 8)]}),
     ):
         fn, call_args, params = build()

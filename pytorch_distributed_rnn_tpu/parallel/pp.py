@@ -349,17 +349,138 @@ def simulate_1f1b_schedule(num_stages: int, num_microbatches: int):
     return np.asarray(fwd_sched), np.asarray(bwd_sched)
 
 
+def simulate_interleaved_1f1b_schedule(num_devices: int, num_chunks: int,
+                                       num_microbatches: int):
+    """Greedy event simulation of the INTERLEAVED (virtual-stage) 1F1B
+    timetable (Megatron-LM's interleaved schedule, arXiv:2104.04473).
+
+    Each of the ``S`` devices owns ``V`` model chunks placed round-robin:
+    global stage ``g`` (of ``G = S*V``) lives on device ``g % S`` as its
+    chunk ``g // S``.  Round-robin placement makes EVERY stage-to-stage
+    hop a uniform +1 ring permute (chunk boundaries wrap device S-1 ->
+    device 0), so the executing engine keeps the plain ``ppermute`` wire
+    of the non-interleaved schedule.  Constraints per tick: one op
+    (forward or backward of one (stage, microbatch)) per DEVICE, under
+    the same dataflow rules as :func:`simulate_1f1b_schedule` -
+    capacity-1 per-stage receive buffers, backward preferred (deepest
+    ready chunk first, which drains the pipe), forwards ALSO deepest
+    ready chunk first (pushing each microbatch toward the loss as fast
+    as possible unblocks backwards sooner - measured: S=4 M=8 slot
+    bubble 0.27 (V=1) -> 0.24 (V=2) -> 0.18 (V=4); shallow-first
+    inverts the trend), and stage ``g`` may run at most ``G - g``
+    forwards ahead of its backwards (the V=1 bound ``S - s``,
+    generalized; the schedule's measured max in-flight sizes the
+    engine's stash).
+
+    Returns ``(fwd_mb, fwd_chunk, bwd_mb, bwd_chunk, max_inflight)``:
+    (ticks, devices) arrays of microbatch ids / chunk ids (-1 = idle)
+    plus the max forward-ahead count of any stage (stash bound).
+    ``V=1`` reproduces :func:`simulate_1f1b_schedule`'s timetable.
+    """
+    import numpy as np
+
+    S, V, M = num_devices, num_chunks, num_microbatches
+    G = S * V
+    next_f = [0] * G
+    next_b = [0] * G
+    f_done = [[-1] * M for _ in range(G)]
+    b_done = [[-1] * M for _ in range(G)]
+    fwd_buf = [-1] * G  # mb whose activation waits unconsumed at stage g
+    bwd_buf = [-1] * G  # mb whose cotangent waits unconsumed at stage g
+    fwd_mb, fwd_ck, bwd_mb, bwd_ck = [], [], [], []
+    max_inflight = 1
+    t = 0
+    while any(nb < M for nb in next_b):
+        if t > 8 * (V * M + G):  # safety: greedy must terminate
+            raise RuntimeError(
+                "interleaved 1f1b schedule simulation did not converge"
+            )
+        f_mb_row, f_ck_row = [-1] * S, [-1] * S
+        b_mb_row, b_ck_row = [-1] * S, [-1] * S
+        consumed_f, consumed_b, sent_f, sent_b = [], [], [], []
+        for d in range(S):
+            bwd_g = -1
+            for c in reversed(range(V)):  # deepest chunk drains first
+                g = c * S + d
+                mb = next_b[g]
+                if (
+                    mb < M
+                    and 0 <= f_done[g][mb] < t
+                    and (g == G - 1 or (0 <= b_done[g + 1][mb] < t
+                                        and bwd_buf[g] == mb))
+                    and (g == 0 or bwd_buf[g - 1] == -1)  # room to send
+                ):
+                    bwd_g = g
+                    break
+            fwd_g = -1
+            for c in reversed(range(V)):  # deepest ready chunk first
+                g = c * S + d
+                mf = next_f[g]
+                if (
+                    mf < M
+                    and (g == 0 or (0 <= f_done[g - 1][mf] < t
+                                    and fwd_buf[g] == mf))
+                    and (g == G - 1 or fwd_buf[g + 1] == -1)  # room
+                    and next_f[g] - next_b[g] < G - g  # in-flight bound
+                ):
+                    fwd_g = g
+                    break
+            if bwd_g >= 0:
+                g, mb = bwd_g, next_b[bwd_g]
+                b_mb_row[d], b_ck_row[d] = mb, g // S
+                b_done[g][mb] = t
+                next_b[g] += 1
+                if g > 0:
+                    sent_b.append((g - 1, mb))
+                if g < G - 1:
+                    consumed_b.append(g)
+            elif fwd_g >= 0:
+                g, mf = fwd_g, next_f[fwd_g]
+                f_mb_row[d], f_ck_row[d] = mf, g // S
+                f_done[g][mf] = t
+                next_f[g] += 1
+                max_inflight = max(max_inflight, next_f[g] - next_b[g])
+                if g < G - 1:
+                    sent_f.append((g + 1, mf))
+                if g > 0:
+                    consumed_f.append(g)
+        for g in consumed_f:
+            fwd_buf[g] = -1
+        for g in consumed_b:
+            bwd_buf[g] = -1
+        for g, m in sent_f:
+            assert fwd_buf[g] == -1, "activation buffer overwrite"
+            fwd_buf[g] = m
+        for g, m in sent_b:
+            assert bwd_buf[g] == -1, "cotangent buffer overwrite"
+            bwd_buf[g] = m
+        fwd_mb.append(f_mb_row)
+        fwd_ck.append(f_ck_row)
+        bwd_mb.append(b_mb_row)
+        bwd_ck.append(b_ck_row)
+        t += 1
+    return (np.asarray(fwd_mb), np.asarray(fwd_ck),
+            np.asarray(bwd_mb), np.asarray(bwd_ck), max_inflight)
+
+
 def pp_schedule_stats(num_stages: int, num_microbatches: int,
-                      schedule: str = "gpipe") -> dict:
+                      schedule: str = "gpipe", num_chunks: int = 1) -> dict:
     """Tick/bubble accounting for a pipeline schedule.
 
     ``gpipe``: the forward fill-drain loop (M + S - 1 ticks; its backward
     is XLA's transpose with the mirrored bubble).  ``1f1b``: ticks and
     idle slots measured from the simulated timetable (one F or B op per
-    stage per tick).  ``bubble_fraction`` = idle stage-ticks / total
-    stage-ticks.
+    stage per tick).  ``interleaved`` (``num_chunks`` V > 1): the
+    virtual-stage timetable; note a tick's op covers 1/V of a device's
+    layers, so busy slots scale with V while warmup idle does not - the
+    bubble FRACTION is what shrinks.  ``bubble_fraction`` = idle
+    device-ticks / total device-ticks.
     """
-    S, M = num_stages, num_microbatches
+    S, M, V = num_stages, num_microbatches, num_chunks
+    if schedule != "interleaved" and V != 1:
+        raise ValueError(
+            f"num_chunks {V} only applies to schedule='interleaved'"
+        )
     if schedule == "gpipe":
         ticks = M + S - 1
         busy = S * M
@@ -367,12 +488,18 @@ def pp_schedule_stats(num_stages: int, num_microbatches: int,
         fwd, bwd = simulate_1f1b_schedule(S, M)
         ticks = fwd.shape[0]
         busy = int((fwd >= 0).sum() + (bwd >= 0).sum())
+    elif schedule == "interleaved":
+        fwd_mb, _, bwd_mb, _, _ = simulate_interleaved_1f1b_schedule(
+            S, V, M)
+        ticks = fwd_mb.shape[0]
+        busy = int((fwd_mb >= 0).sum() + (bwd_mb >= 0).sum())
     else:
         raise ValueError(f"unknown pp schedule {schedule!r}")
     total = S * ticks
     return {
         "schedule": schedule,
         "stages": S,
+        "chunks": V,
         "microbatches": M,
         "ticks": ticks,
         "busy_slots": busy,
@@ -381,65 +508,93 @@ def pp_schedule_stats(num_stages: int, num_microbatches: int,
     }
 
 
-def _pp_1f1b_engine(axis: str, *, num_microbatches: int, diff_params,
-                    stage0_input, stage_apply, last_loss,
-                    bm: int, t_len: int, width: int, hidden: int, dtype):
+def _pp_interleaved_engine(axis: str, *, num_microbatches: int,
+                           num_chunks: int, diff_params, stage0_input,
+                           stage_apply, last_loss, bm: int, t_len: int,
+                           width: int, hidden: int, dtype):
     """The generic self-differentiating 1F1B tick loop shared by the
-    motion and char families.
+    motion and char families - flat (``num_chunks=1``, the PipeDream-
+    flush timetable) and INTERLEAVED (virtual stages) in one engine.
 
     Runs the combined forward+backward timetable explicitly: each tick a
-    stage performs (masked SPMD) its scheduled forward - stashing the
+    device performs (masked SPMD) its scheduled forward - stashing the
     stage INPUT, the only activation kept per in-flight microbatch -
     and/or its scheduled backward, which recomputes the stage via
     ``jax.vjp`` at the stashed input and chains the cotangent upstream.
-    Activation memory is bounded by the 1F1B in-flight limit (<= S
-    microbatch inputs per stage) instead of GPipe's all-M.
+    Activation memory is bounded by the schedule's measured in-flight
+    limit instead of GPipe's all-M.
+
+    Each device owns ``num_chunks`` model chunks placed round-robin
+    (global stage ``g = chunk * S + device``), so every forward hop is
+    the same +1 ring ``ppermute`` and every backward hop -1 - chunk
+    boundaries wrap device S-1 -> 0 on the same wire.  Per-chunk state:
+    capacity-1 receive buffers and a stash ring of in-flight microbatch
+    INPUTS per chunk; the chunk id of each tick's op rides in from the
+    precomputed timetable (``num_chunks=1`` reproduces the flat
+    timetable exactly - pinned by ``test_v1_reproduces_flat_timetable``).
 
     - ``diff_params``: pytree (tuple) of everything differentiated.
     - ``stage0_input(diff_params, m) -> (bm, t_len, width)``: microbatch
       ``m``'s entry activation.  It re-evaluates INSIDE the vjp so params
       feeding the entry (the char embedding) get exact gradients.
-    - ``stage_apply(diff_params, acts) -> (bm, t_len, hidden)``: this
-      stage's layers (traced stage index via closure).
+    - ``stage_apply(diff_params, acts, chunk) -> (bm, t_len, hidden)``:
+      the device's ``chunk``-th layer block (traced chunk index).
     - ``last_loss(diff_params, acts, m) -> (loss_sum, correct, w_sum)``:
-      the last stage's head + loss for microbatch ``m`` (weighted sums).
+      the last stage's head + loss for microbatch ``m`` (weighted sums);
+      fires on the global last stage (device S-1, chunk V-1) only, as
+      ``stage0_input`` fires on (device 0, chunk 0) only.
 
     Returns ``(loss_sum, correct_sum, w_sum, grads)`` - sums banked at
     the last stage and replicated over ``pp``; ``grads`` mirrors
-    ``diff_params`` and contains THIS STAGE's contribution only (the
+    ``diff_params`` and contains THIS DEVICE's contribution only (the
     caller's ``custom_vjp`` hands it to shard_map's replicated-param
     transpose, which sums over the mesh).
     """
+    import numpy as np
+
     n = lax.axis_size(axis)
     idx = lax.axis_index(axis)
-    M = num_microbatches
+    M, V = num_microbatches, num_chunks
+    G = n * V
 
-    fwd_np, bwd_np = simulate_1f1b_schedule(n, M)
-    fwd_sched = jnp.asarray(fwd_np)
-    bwd_sched = jnp.asarray(bwd_np)
-    # receive flags: stage s gets an activation when s-1 ran a forward
-    # this tick, a cotangent when s+1 ran a backward
-    recv_f = jnp.asarray(
-        jnp.roll(jnp.asarray(fwd_np >= 0), 1, axis=1).at[:, 0].set(False))
-    recv_b = jnp.asarray(
-        jnp.roll(jnp.asarray(bwd_np >= 0), -1, axis=1).at[:, -1].set(False))
-    TT = fwd_np.shape[0]
-    K = min(n, M)  # 1F1B in-flight bound -> stash ring size
-    is_last = idx == n - 1
-    is_first = idx == 0
+    fwd_mb_np, fwd_ck_np, bwd_mb_np, bwd_ck_np, max_if = (
+        simulate_interleaved_1f1b_schedule(n, V, M))
+    TT = fwd_mb_np.shape[0]
+    K = min(max_if, M)  # per-chunk stash ring size
 
-    def full(dp, a, m):
-        # cond, not where: non-first stages skip the entry evaluation
-        # entirely (for char that is an embedding gather forward and a
-        # vocab-sized zero scatter backward) - same rationale as the
-        # last-stage head below
-        inp = lax.cond(is_first, lambda: stage0_input(dp, m), lambda: a)
-        acts = stage_apply(dp, inp)
-        # only the last stage pays the head: for the char family the
-        # per-timestep vocab head rivals an RNN layer, so a cond (legal -
-        # last_loss has no collectives) beats compute-then-mask
+    # receive tags: device d's +1-wire carries an activation when device
+    # d-1 (ring) ran a forward whose stage has a successor; the receiving
+    # chunk is (sender_g + 1) // S.  Chunk-boundary sends wrap the ring
+    # (device S-1's chunk-c output lands on device 0 as chunk c+1), so
+    # np.roll keeps its wrap - the global-last-stage mask already
+    # excludes the one send that must not happen.
+    devs = np.arange(n)[None, :]
+    g_send_f = fwd_ck_np * n + devs
+    f_sends = (fwd_mb_np >= 0) & (g_send_f < G - 1)
+    recv_f_np = np.roll(f_sends, 1, axis=1)
+    recv_f_ck_np = np.roll((g_send_f + 1) // n, 1, axis=1)
+    g_send_b = bwd_ck_np * n + devs
+    b_sends = (bwd_mb_np >= 0) & (g_send_b > 0)
+    recv_b_np = np.roll(b_sends, -1, axis=1)
+    recv_b_ck_np = np.roll(
+        np.maximum(g_send_b - 1, 0) // n, -1, axis=1)
+
+    fwd_mb = jnp.asarray(fwd_mb_np)
+    fwd_ck = jnp.asarray(fwd_ck_np)
+    bwd_mb = jnp.asarray(bwd_mb_np)
+    bwd_ck = jnp.asarray(bwd_ck_np)
+    recv_f = jnp.asarray(recv_f_np)
+    recv_f_ck = jnp.asarray(recv_f_ck_np)
+    recv_b = jnp.asarray(recv_b_np)
+    recv_b_ck = jnp.asarray(recv_b_ck_np)
+
+    def full(dp, a, m, c):
+        is_first_g = (idx == 0) & (c == 0)
+        is_last_g = (idx == n - 1) & (c == V - 1)
+        inp = lax.cond(is_first_g, lambda: stage0_input(dp, m), lambda: a)
+        acts = stage_apply(dp, inp, c)
         loss_m = lax.cond(
-            is_last,
+            is_last_g,
             lambda: last_loss(dp, acts, m)[0],
             lambda: jnp.float32(0.0),
         )
@@ -448,66 +603,81 @@ def _pp_1f1b_engine(axis: str, *, num_microbatches: int, diff_params,
     def tick(carry, tk):
         (fwd_buf, bwd_buf, stash, grads, loss_sum, correct_sum,
          w_sum) = carry
-        m_f = fwd_sched[tk, idx]
-        m_b = bwd_sched[tk, idx]
+        m_f = fwd_mb[tk, idx]
+        c_f = jnp.clip(fwd_ck[tk, idx], 0, V - 1)
+        m_b = bwd_mb[tk, idx]
+        c_b = jnp.clip(bwd_ck[tk, idx], 0, V - 1)
         f_active = m_f >= 0
         b_active = m_b >= 0
         m_f_safe = jnp.clip(m_f, 0, M - 1)
         m_b_safe = jnp.clip(m_b, 0, M - 1)
 
         # ---- backward op: read the stash BEFORE the forward writes it
-        stash_in = lax.dynamic_index_in_dim(stash, m_b_safe % K,
-                                            keepdims=False)
+        stash_in = lax.dynamic_index_in_dim(
+            lax.dynamic_index_in_dim(stash, c_b, keepdims=False),
+            m_b_safe % K, keepdims=False)
         (_, _), vjp_fn = jax.vjp(
-            lambda dp, a: full(dp, a, m_b_safe), diff_params, stash_in,
+            lambda dp, a: full(dp, a, m_b_safe, c_b), diff_params,
+            stash_in,
         )
         b_mask = b_active.astype(jnp.float32)
-        # the buffered cotangent is W-wide (it is d(next stage's padded
-        # input)); this stage's acts are H-wide - take the H slice
-        cot_acts = (jnp.where(is_last, 0.0, 1.0) * b_mask
-                    * bwd_buf[..., :hidden])
-        cot_loss = jnp.where(is_last, 1.0, 0.0) * b_mask
+        is_last_b = (idx == n - 1) & (c_b == V - 1)
+        buf_b = lax.dynamic_index_in_dim(bwd_buf, c_b, keepdims=False)
+        cot_acts = (jnp.where(is_last_b, 0.0, 1.0) * b_mask
+                    * buf_b[..., :hidden])
+        cot_loss = jnp.where(is_last_b, 1.0, 0.0) * b_mask
         d_params, d_acts = vjp_fn((cot_acts.astype(dtype), cot_loss))
         grads = jax.tree.map(
             lambda g, d: g + b_mask * d.astype(jnp.float32),
             grads, d_params,
         )
 
-        # ---- forward op (cond: see the entry-evaluation note in full)
+        # ---- forward op
+        is_first_f = (idx == 0) & (c_f == 0)
+        is_last_f = (idx == n - 1) & (c_f == V - 1)
         inp = lax.cond(
-            is_first,
+            is_first_f,
             lambda: stage0_input(diff_params, m_f_safe),
-            lambda: fwd_buf,
+            lambda: lax.dynamic_index_in_dim(fwd_buf, c_f,
+                                             keepdims=False),
         )
         stash = jnp.where(
             f_active,
-            lax.dynamic_update_index_in_dim(stash, inp, m_f_safe % K,
-                                            axis=0),
+            lax.dynamic_update_slice(
+                stash, inp[None, None].astype(stash.dtype),
+                (c_f, m_f_safe % K, 0, 0, 0)),
             stash,
         )
-        acts = stage_apply(diff_params, inp)
-        # loss/metrics bank at the last stage's forward (value only);
-        # same cond: non-last stages skip the head entirely
+        acts = stage_apply(diff_params, inp, c_f)
         loss_m, correct_m, wsum_m = lax.cond(
-            is_last,
+            is_last_f,
             lambda: last_loss(diff_params, acts, m_f_safe),
             lambda: (jnp.float32(0.0), jnp.float32(0.0),
                      jnp.float32(0.0)),
         )
-        bank = (f_active & is_last).astype(jnp.float32)
+        bank = (f_active & is_last_f).astype(jnp.float32)
         loss_sum = loss_sum + bank * loss_m
         correct_sum = correct_sum + bank * correct_m
         w_sum = w_sum + bank * wsum_m
 
-        # ---- communicate (capacity-1 buffers, schedule-gated receive)
+        # ---- communicate (one +1 act hop, one -1 cotangent hop)
         perm_f = [(i, (i + 1) % n) for i in range(n)]
         perm_b = [(i, (i - 1) % n) for i in range(n)]
         acts_hop = lax.ppermute(_pad_last(acts, width), axis, perm_f)
         dacts_hop = lax.ppermute(d_acts, axis, perm_b)
-        fwd_buf = jnp.where(recv_f[tk, idx], acts_hop, fwd_buf)
+        fwd_buf = jnp.where(
+            recv_f[tk, idx],
+            lax.dynamic_update_slice(
+                fwd_buf, acts_hop[None].astype(fwd_buf.dtype),
+                (recv_f_ck[tk, idx], 0, 0, 0)),
+            fwd_buf,
+        )
         bwd_buf = jnp.where(
             recv_b[tk, idx],
-            dacts_hop.astype(jnp.float32)[..., :width],
+            lax.dynamic_update_slice(
+                bwd_buf,
+                dacts_hop.astype(jnp.float32)[None, ..., :width],
+                (recv_b_ck[tk, idx], 0, 0, 0)),
             bwd_buf,
         )
         return (fwd_buf, bwd_buf, stash, grads, loss_sum, correct_sum,
@@ -516,9 +686,9 @@ def _pp_1f1b_engine(axis: str, *, num_microbatches: int, diff_params,
     zeros_f32 = lambda t_: jax.tree.map(  # noqa: E731
         lambda p: jnp.zeros(p.shape, jnp.float32), t_)
     carry0 = (
-        jnp.zeros((bm, t_len, width), dtype),
-        jnp.zeros((bm, t_len, width), jnp.float32),
-        jnp.zeros((K, bm, t_len, width), dtype),
+        jnp.zeros((V, bm, t_len, width), dtype),
+        jnp.zeros((V, bm, t_len, width), jnp.float32),
+        jnp.zeros((V, K, bm, t_len, width), dtype),
         zeros_f32(diff_params),
         jnp.float32(0.0),
         jnp.float32(0.0),
@@ -528,18 +698,21 @@ def _pp_1f1b_engine(axis: str, *, num_microbatches: int, diff_params,
         tick, carry0, jnp.arange(TT)
     )
 
-    # loss/metrics live on the last stage; replicate over pp
     loss_sum = broadcast_from(loss_sum, axis, n - 1)
     correct_sum = broadcast_from(correct_sum, axis, n - 1)
     w_sum = broadcast_from(w_sum, axis, n - 1)
     return loss_sum, correct_sum, w_sum, grads
 
 
-def _check_1f1b_shapes(layers, axis, num_microbatches, batch, cell):
+def _check_1f1b_shapes(layers, axis, num_microbatches, batch, cell,
+                       num_chunks: int = 1):
     n = lax.axis_size(axis)
     L = len(layers)
-    if L % n != 0:
-        raise ValueError(f"{L} layers do not split into {n} stages")
+    if L % (n * num_chunks) != 0:
+        raise ValueError(
+            f"{L} layers do not split into {n} devices x {num_chunks} "
+            "chunks"
+        )
     # same guard as pp_stacked_rnn: a mismatched ``cell`` would split the
     # pre-activations into bogus gates with NO shape error whenever the
     # gate widths divide evenly
@@ -555,7 +728,7 @@ def _check_1f1b_shapes(layers, axis, num_microbatches, batch, cell):
             f"batch {batch} not divisible into {num_microbatches} "
             f"microbatches"
         )
-    return n, L // n
+    return n, L // (n * num_chunks)
 
 
 def _stage_layers(stk, idx, per_stage, acts, *, width, unroll, cell):
@@ -569,12 +742,13 @@ def _stage_layers(stk, idx, per_stage, acts, *, width, unroll, cell):
 
 
 def pp_rnn_1f1b_value_and_grad(layers, head, x, y, axis: str, *,
-                               num_microbatches: int, unroll: int = 1,
+                               num_microbatches: int, num_chunks: int = 1,
+                               unroll: int = 1,
                                cell: str = "lstm", compute_dtype=None,
                                sample_weights=None):
     """Self-differentiating 1F1B pipeline for the motion family, for use
     inside ``shard_map`` over the ``pp`` axis (the
-    :func:`_pp_1f1b_engine` timetable with the last-step classification
+    :func:`_pp_interleaved_engine` timetable with the last-step classification
     head).
 
     Returns ``(loss_sum, correct_sum, w_sum, grads)``: the weighted NLL
@@ -587,8 +761,10 @@ def pp_rnn_1f1b_value_and_grad(layers, head, x, y, axis: str, *,
     """
     M = num_microbatches
     idx = lax.axis_index(axis)
+    n_dev = lax.axis_size(axis)
     batch, t, in_dim = x.shape
-    _, per_stage = _check_1f1b_shapes(layers, axis, M, batch, cell)
+    _, per_stage = _check_1f1b_shapes(layers, axis, M, batch, cell,
+                                      num_chunks)
     bm = batch // M
     hidden = layers[0]["w_hh"].shape[1]
     width = max(in_dim, hidden)
@@ -606,9 +782,10 @@ def pp_rnn_1f1b_value_and_grad(layers, head, x, y, axis: str, *,
     def stage0_input(dp, m):
         return lax.dynamic_index_in_dim(x_micro, m, keepdims=False)
 
-    def stage_apply(dp, acts):
-        return _stage_layers(dp[0], idx, per_stage, acts, width=width,
-                             unroll=unroll, cell=cell)
+    def stage_apply_chunk(dp, acts, c):
+        # global stage c*S + idx owns layers [g*per_stage, (g+1)*per_stage)
+        return _stage_layers(dp[0], c * n_dev + idx, per_stage, acts,
+                             width=width, unroll=unroll, cell=cell)
 
     def last_loss(dp, acts, m):
         _, hd = dp
@@ -624,18 +801,20 @@ def pp_rnn_1f1b_value_and_grad(layers, head, x, y, axis: str, *,
         )
         return jnp.sum(nll * w_m), correct, jnp.sum(w_m)
 
-    loss_sum, correct_sum, w_sum, (g_stk, g_head) = _pp_1f1b_engine(
-        axis, num_microbatches=M, diff_params=(stacked, head),
-        stage0_input=stage0_input, stage_apply=stage_apply,
-        last_loss=last_loss, bm=bm, t_len=t, width=width, hidden=hidden,
-        dtype=dtype,
-    )
+    loss_sum, correct_sum, w_sum, (g_stk, g_head) = (
+        _pp_interleaved_engine(
+            axis, num_microbatches=M, num_chunks=num_chunks,
+            diff_params=(stacked, head), stage0_input=stage0_input,
+            stage_apply=stage_apply_chunk, last_loss=last_loss,
+            bm=bm, t_len=t, width=width, hidden=hidden, dtype=dtype,
+        ))
     grads = {"rnn": _unstack_grads(g_stk, layers, cell), "fc": g_head}
     return loss_sum, correct_sum, w_sum, grads
 
 
 def pp_char_1f1b_value_and_grad(layers, head, embed, tokens, axis: str, *,
-                                num_microbatches: int, unroll: int = 1,
+                                num_microbatches: int, num_chunks: int = 1,
+                                unroll: int = 1,
                                 cell: str = "lstm", compute_dtype=None,
                                 sample_weights=None):
     """Char-LM sibling of :func:`pp_rnn_1f1b_value_and_grad`: the same
@@ -652,8 +831,10 @@ def pp_char_1f1b_value_and_grad(layers, head, embed, tokens, axis: str, *,
     """
     M = num_microbatches
     idx = lax.axis_index(axis)
+    n_dev = lax.axis_size(axis)
     batch, t = tokens.shape
-    _, per_stage = _check_1f1b_shapes(layers, axis, M, batch, cell)
+    _, per_stage = _check_1f1b_shapes(layers, axis, M, batch, cell,
+                                      num_chunks)
     bm = batch // M
     hidden = layers[0]["w_hh"].shape[1]
     embed_dim = embed.shape[1]
@@ -673,9 +854,9 @@ def pp_char_1f1b_value_and_grad(layers, head, embed, tokens, axis: str, *,
         toks = lax.dynamic_index_in_dim(toks_micro, m, keepdims=False)
         return _pad_last(emb[toks[:, :-1]], width).astype(dtype)
 
-    def stage_apply(dp, acts):
-        return _stage_layers(dp[0], idx, per_stage, acts, width=width,
-                             unroll=unroll, cell=cell)
+    def stage_apply_chunk(dp, acts, c):
+        return _stage_layers(dp[0], c * n_dev + idx, per_stage, acts,
+                             width=width, unroll=unroll, cell=cell)
 
     def last_loss(dp, acts, m):
         _, hd, _ = dp
@@ -697,12 +878,14 @@ def pp_char_1f1b_value_and_grad(layers, head, embed, tokens, axis: str, *,
         correct = jnp.sum(per_seq_acc * (w_m > 0))
         return loss_m, correct, jnp.sum(w_m)
 
-    loss_sum, correct_sum, w_sum, (g_stk, g_head, g_emb) = _pp_1f1b_engine(
-        axis, num_microbatches=M, diff_params=(stacked, head, embed),
-        stage0_input=stage0_input, stage_apply=stage_apply,
-        last_loss=last_loss, bm=bm, t_len=t_len, width=width,
-        hidden=hidden, dtype=dtype,
-    )
+    loss_sum, correct_sum, w_sum, (g_stk, g_head, g_emb) = (
+        _pp_interleaved_engine(
+            axis, num_microbatches=M, num_chunks=num_chunks,
+            diff_params=(stacked, head, embed),
+            stage0_input=stage0_input, stage_apply=stage_apply_chunk,
+            last_loss=last_loss, bm=bm, t_len=t_len, width=width,
+            hidden=hidden, dtype=dtype,
+        ))
     grads = {"rnn": _unstack_grads(g_stk, layers, cell), "head": g_head,
              "embed": g_emb}
     return loss_sum, correct_sum, w_sum, grads

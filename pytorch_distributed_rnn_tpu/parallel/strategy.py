@@ -561,7 +561,7 @@ def _make_pp_1f1b_loss_fn(mesh, axes, engine_of, *, weighted: bool):
 
     ``engine_of(params, batch_x, w) -> (loss_sum, correct, w_sum,
     grads)`` runs the family's self-differentiating schedule
-    (``parallel/pp.py:_pp_1f1b_engine`` wrappers); this wrapper owns the
+    (``parallel/pp.py:_pp_interleaved_engine`` wrappers); this wrapper owns the
     mesh validation, the shard_map decoration, the custom_vjp that hands
     the precomputed stage-local grads to shard_map's replicated-param
     transpose, and the dp pmean/psum epilogue - ONE copy of the
@@ -623,7 +623,8 @@ def _make_pp_1f1b_loss_fn(mesh, axes, engine_of, *, weighted: bool):
 
 
 def make_motion_pp_1f1b_loss_fn(mesh, axes: dict[str, int], *,
-                                num_microbatches: int = 4, unroll: int = 1,
+                                num_microbatches: int = 4,
+                                num_chunks: int = 1, unroll: int = 1,
                                 weighted: bool = False, cell: str = "lstm",
                                 precision: str = "f32"):
     """Shard_mapped motion loss over a dp x pp mesh running the 1F1B
@@ -648,7 +649,8 @@ def make_motion_pp_1f1b_loss_fn(mesh, axes: dict[str, int], *,
     def engine_of(p, x, y, w):
         return pp_rnn_1f1b_value_and_grad(
             p["rnn"], p["fc"], x, y, "pp",
-            num_microbatches=num_microbatches, unroll=unroll, cell=cell,
+            num_microbatches=num_microbatches, num_chunks=num_chunks,
+            unroll=unroll, cell=cell,
             compute_dtype=compute_dtype, sample_weights=w,
         )
 
@@ -656,7 +658,8 @@ def make_motion_pp_1f1b_loss_fn(mesh, axes: dict[str, int], *,
 
 
 def make_char_pp_1f1b_loss_fn(mesh, axes: dict[str, int], *,
-                              num_microbatches: int = 4, unroll: int = 1,
+                              num_microbatches: int = 4,
+                              num_chunks: int = 1, unroll: int = 1,
                               weighted: bool = False, cell: str = "lstm",
                               precision: str = "f32"):
     """Char-LM sibling of :func:`make_motion_pp_1f1b_loss_fn`: the same
@@ -675,7 +678,8 @@ def make_char_pp_1f1b_loss_fn(mesh, axes: dict[str, int], *,
         del y
         return pp_char_1f1b_value_and_grad(
             p["rnn"], p["head"], p["embed"], tokens, "pp",
-            num_microbatches=num_microbatches, unroll=unroll, cell=cell,
+            num_microbatches=num_microbatches, num_chunks=num_chunks,
+            unroll=unroll, cell=cell,
             compute_dtype=compute_dtype, sample_weights=w,
         )
 

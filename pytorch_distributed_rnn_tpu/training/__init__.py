@@ -77,12 +77,20 @@ def add_sub_commands(sub_parser):
     )
     mesh_p.add_argument("--num-microbatches", type=int, default=4)
     mesh_p.add_argument(
-        "--pp-schedule", choices=["gpipe", "1f1b"], default="gpipe",
+        "--pp-schedule", choices=["gpipe", "1f1b", "interleaved"],
+        default="gpipe",
         help="pipeline schedule for pp meshes: gpipe (fill-drain forward, "
-        "XLA-transposed backward) or 1f1b (PipeDream-flush: each "
+        "XLA-transposed backward), 1f1b (PipeDream-flush: each "
         "microbatch's backward interleaves right after its forward, "
-        "bounding live activations to the in-flight limit; motion "
-        "family)",
+        "bounding live activations to the in-flight limit), or "
+        "interleaved (Megatron virtual stages: each device owns "
+        "--pp-chunks model chunks placed round-robin, shrinking the "
+        "pipeline bubble; motion + char families)",
+    )
+    mesh_p.add_argument(
+        "--pp-chunks", type=int, default=2, metavar="V",
+        help="virtual model chunks per device for --pp-schedule "
+        "interleaved (pp x V must divide --stacked-layer)",
     )
 
     def _mesh(args):

@@ -37,12 +37,21 @@ class MeshTrainer(SpmdTrainer):
 
     def __init__(self, *, mesh_axes, schedule: str = "wavefront",
                  num_microbatches: int = 4, pp_schedule: str = "gpipe",
-                 **kwargs):
-        if pp_schedule not in ("gpipe", "1f1b"):
+                 pp_chunks: int = 2, **kwargs):
+        if pp_schedule not in ("gpipe", "1f1b", "interleaved"):
             raise ValueError(
-                f"unknown pp schedule {pp_schedule!r} - use gpipe or 1f1b"
+                f"unknown pp schedule {pp_schedule!r} - use gpipe, 1f1b "
+                "or interleaved"
+            )
+        if pp_schedule == "interleaved" and pp_chunks < 2:
+            raise ValueError(
+                f"--pp-schedule interleaved needs --pp-chunks >= 2 "
+                f"(got {pp_chunks}); V=1 IS the 1f1b schedule"
             )
         self.pp_schedule = pp_schedule
+        # V virtual chunks per device only under the interleaved
+        # schedule; the flat engines take num_chunks=1
+        self.pp_chunks = pp_chunks if pp_schedule == "interleaved" else 1
         axes = dict(mesh_axes)
         if "dp" not in axes:
             axes = {"dp": 1, **axes}
@@ -139,14 +148,24 @@ class MeshTrainer(SpmdTrainer):
                     f"divisible by sp={sp_size} - pick --seq-length so "
                     f"that sp divides seq_length + 1"
                 )
-        if self.pp_schedule == "1f1b" and (
+        if self.pp_schedule in ("1f1b", "interleaved") and (
             self.is_attention or self.is_moe or self.model_axis != "pp"
         ):
             raise ValueError(
-                "--pp-schedule 1f1b drives the motion and char families' "
-                "dp x pp meshes (parallel/pp.py:pp_{rnn,char}_1f1b_"
-                "value_and_grad); other families/axes run gpipe"
+                f"--pp-schedule {self.pp_schedule} drives the motion and "
+                "char families' dp x pp meshes (parallel/pp.py:"
+                "pp_{rnn,char}_1f1b_value_and_grad); other families/axes "
+                "run gpipe"
             )
+        if self.pp_schedule == "interleaved" and self.model_axis == "pp":
+            layers = self.model.layer_dim
+            total = self.mesh_axes["pp"] * self.pp_chunks
+            if layers % total:
+                raise ValueError(
+                    f"--stacked-layer {layers} does not split into "
+                    f"pp={self.mesh_axes['pp']} x --pp-chunks "
+                    f"{self.pp_chunks} = {total} virtual stages"
+                )
         # bf16 + remat thread through EVERY model axis since r4 (the tp
         # gate-sharded and pp GPipe stacks take the same levers as the
         # sp relay: compute-dtype matmuls/collective bytes, f32 carries,
@@ -218,7 +237,8 @@ class MeshTrainer(SpmdTrainer):
                 self.model, self.mesh, weighted=weighted
             )
         if self.is_char:
-            if self.model_axis == "pp" and self.pp_schedule == "1f1b":
+            if (self.model_axis == "pp"
+                    and self.pp_schedule in ("1f1b", "interleaved")):
                 from pytorch_distributed_rnn_tpu.parallel.strategy import (
                     make_char_pp_1f1b_loss_fn,
                 )
@@ -226,6 +246,7 @@ class MeshTrainer(SpmdTrainer):
                 return make_char_pp_1f1b_loss_fn(
                     self.mesh, self.mesh_axes,
                     num_microbatches=self.num_microbatches,
+                    num_chunks=self.pp_chunks,
                     weighted=weighted,
                     cell=getattr(self.model, "cell", "lstm"),
                     precision=getattr(self.model, "precision", "f32"),
@@ -243,7 +264,8 @@ class MeshTrainer(SpmdTrainer):
                 remat=getattr(self.model, "remat", False),
                 num_layers=getattr(self.model, "layer_dim", None),
             )
-        if self.model_axis == "pp" and self.pp_schedule == "1f1b":
+        if (self.model_axis == "pp"
+                and self.pp_schedule in ("1f1b", "interleaved")):
             from pytorch_distributed_rnn_tpu.parallel.strategy import (
                 make_motion_pp_1f1b_loss_fn,
             )
@@ -252,7 +274,8 @@ class MeshTrainer(SpmdTrainer):
             # stage from the stashed input), so the flag needs no seam
             return make_motion_pp_1f1b_loss_fn(
                 self.mesh, self.mesh_axes,
-                num_microbatches=self.num_microbatches, weighted=weighted,
+                num_microbatches=self.num_microbatches,
+                num_chunks=self.pp_chunks, weighted=weighted,
                 cell=getattr(self.model, "cell", "lstm"),
                 precision=getattr(self.model, "precision", "f32"),
             )
@@ -377,6 +400,7 @@ def mesh_trainer_factory(args):
             schedule=args.sp_schedule,
             num_microbatches=args.num_microbatches,
             pp_schedule=getattr(args, "pp_schedule", "gpipe"),
+            pp_chunks=getattr(args, "pp_chunks", 2),
             **kwargs,
         )
 

@@ -452,3 +452,212 @@ class Test1F1B:
                 np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5,
                 err_msg=f"{cell} {jax.tree_util.keystr(pa)}",
             )
+
+
+class TestInterleaved1F1B:
+    """Interleaved (virtual-stage) 1F1B: the simulated timetable's
+    invariants, the bubble shrinking with chunk count, and the executing
+    engine's exact numerics against the single-device reference."""
+
+    def test_v1_reproduces_flat_timetable(self):
+        from pytorch_distributed_rnn_tpu.parallel.pp import (
+            simulate_1f1b_schedule,
+            simulate_interleaved_1f1b_schedule,
+        )
+
+        f1, b1 = simulate_1f1b_schedule(4, 8)
+        fm, fc, bm, bc, _ = simulate_interleaved_1f1b_schedule(4, 1, 8)
+        np.testing.assert_array_equal(fm, f1)
+        np.testing.assert_array_equal(bm, b1)
+        # V=1 ops are all chunk 0
+        assert set(np.asarray(fc)[np.asarray(fm) >= 0]) == {0}
+
+    @pytest.mark.parametrize("S,V,M", [(2, 2, 4), (4, 2, 8), (4, 4, 8)])
+    def test_schedule_invariants(self, S, V, M):
+        """Every (stage, direction) processes microbatches 0..M-1 exactly
+        once, in order; backward of (g, m) never precedes forward."""
+        from pytorch_distributed_rnn_tpu.parallel.pp import (
+            simulate_interleaved_1f1b_schedule,
+        )
+
+        fm, fc, bm, bc, _ = simulate_interleaved_1f1b_schedule(S, V, M)
+        TT = fm.shape[0]
+        for d in range(S):
+            for c in range(V):
+                fs = [(t, fm[t, d]) for t in range(TT)
+                      if fm[t, d] >= 0 and fc[t, d] == c]
+                bs = [(t, bm[t, d]) for t in range(TT)
+                      if bm[t, d] >= 0 and bc[t, d] == c]
+                assert [m for _, m in fs] == list(range(M))
+                assert [m for _, m in bs] == list(range(M))
+                f_at = {m: t for t, m in fs}
+                for t, m in bs:
+                    assert f_at[m] < t  # backward strictly after forward
+
+    def test_bubble_shrinks_with_chunks(self):
+        from pytorch_distributed_rnn_tpu.parallel.pp import (
+            pp_schedule_stats,
+        )
+
+        flat = pp_schedule_stats(4, 8, "1f1b")
+        v2 = pp_schedule_stats(4, 8, "interleaved", num_chunks=2)
+        v4 = pp_schedule_stats(4, 8, "interleaved", num_chunks=4)
+        assert v2["bubble_fraction"] < flat["bubble_fraction"]
+        assert v4["bubble_fraction"] < v2["bubble_fraction"]
+
+    @pytest.mark.parametrize("stages,chunks,cell", [
+        (2, 2, "lstm"), (2, 2, "gru"), (4, 2, "lstm"),
+    ])
+    def test_motion_value_and_grad_matches_reference(self, stages, chunks,
+                                                     cell):
+        from jax import lax
+
+        from pytorch_distributed_rnn_tpu.parallel.pp import (
+            pp_rnn_1f1b_value_and_grad,
+        )
+
+        layers = stages * chunks * 2  # 2 layers per virtual stage
+        mesh = make_mesh({"pp": stages})
+        model = MotionModel(input_dim=IN, hidden_dim=H, layer_dim=layers,
+                            output_dim=6, cell=cell, impl="scan")
+        params = model.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (B, T, IN))
+        y = jax.random.randint(jax.random.PRNGKey(2), (B,), 0, 6)
+
+        @partial(shard_map, mesh=mesh, in_specs=(P(), P(), P()),
+                 out_specs=(P(), P()), check_vma=False)
+        def run(p, x, y):
+            from jax import lax as _lax
+
+            ls, _, ws, g = pp_rnn_1f1b_value_and_grad(
+                p["rnn"], p["fc"], x, y, "pp", num_microbatches=4,
+                num_chunks=chunks, cell=cell,
+            )
+            g = jax.tree.map(lambda gg: _lax.psum(gg, "pp") / ws, g)
+            return ls / ws, g
+
+        loss, grads = jax.jit(run)(params, x, y)
+
+        def ref(p):
+            logits = model.apply(p, x)
+            nll = -jax.nn.log_softmax(logits)[jnp.arange(B), y]
+            return jnp.mean(nll)
+
+        rl, rg = jax.value_and_grad(ref)(params)
+        assert float(loss) == pytest.approx(float(rl), abs=1e-5)
+        for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(grads),
+            jax.tree_util.tree_leaves_with_path(rg),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5,
+                err_msg=jax.tree_util.keystr(pa),
+            )
+
+    def test_char_value_and_grad_matches_reference(self):
+        """The char family's interleaved engine: per-timestep vocab head
+        + exact embedding grads through the chunked stage-0 hook."""
+        from jax import lax
+
+        from pytorch_distributed_rnn_tpu.models import CharRNN
+        from pytorch_distributed_rnn_tpu.parallel.pp import (
+            pp_char_1f1b_value_and_grad,
+        )
+
+        mesh = make_mesh({"pp": 2})
+        lm = CharRNN(vocab_size=32, embed_dim=8, hidden_dim=8,
+                     layer_dim=4, impl="scan")
+        params = lm.init(jax.random.PRNGKey(0))
+        toks = jax.random.randint(jax.random.PRNGKey(1), (8, 17), 0, 32)
+
+        @partial(shard_map, mesh=mesh, in_specs=(P(), P()),
+                 out_specs=(P(), P()), check_vma=False)
+        def run(p, t):
+            ls, _, ws, g = pp_char_1f1b_value_and_grad(
+                p["rnn"], p["head"], p["embed"], t, "pp",
+                num_microbatches=4, num_chunks=2,
+            )
+            g = jax.tree.map(lambda x: lax.psum(x, "pp") / ws, g)
+            return ls / ws, g
+
+        loss, grads = jax.jit(run)(params, toks)
+
+        def ref(p):
+            x = p["embed"][toks[:, :-1]]
+            out, _ = stacked_rnn(p["rnn"], x, "lstm", impl="scan")
+            logits = out @ p["head"]["weight"].T + p["head"]["bias"]
+            tg = toks[:, 1:]
+            nll = -jnp.take_along_axis(
+                jax.nn.log_softmax(logits), tg[..., None], -1
+            )[..., 0]
+            return jnp.mean(jnp.mean(nll, axis=1))
+
+        rl, rg = jax.value_and_grad(ref)(params)
+        assert float(loss) == pytest.approx(float(rl), abs=1e-5)
+        gmap = {"rnn": rg["rnn"], "head": rg["head"],
+                "embed": rg["embed"]}
+        for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(grads),
+            jax.tree_util.tree_leaves_with_path(gmap),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5,
+                err_msg=jax.tree_util.keystr(pa),
+            )
+
+    def test_loss_fn_under_value_and_grad_on_dp_pp(self):
+        """The interleaved loss fn drives jax.value_and_grad on a
+        dp x pp mesh (the make_mesh_grad_step contract)."""
+        from pytorch_distributed_rnn_tpu.parallel.strategy import (
+            make_motion_pp_1f1b_loss_fn,
+        )
+
+        axes = {"dp": 2, "pp": 2}
+        mesh = make_mesh(axes)
+        model = MotionModel(input_dim=IN, hidden_dim=H, layer_dim=4,
+                            output_dim=6, impl="scan")
+        params = model.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2 * B, T, IN))
+        y = jax.random.randint(jax.random.PRNGKey(2), (2 * B,), 0, 6)
+        loss_fn = make_motion_pp_1f1b_loss_fn(
+            mesh, axes, num_microbatches=4, num_chunks=2)
+        (loss, metrics), grads = jax.jit(
+            jax.value_and_grad(loss_fn, has_aux=True)
+        )(params, x, y)
+
+        def ref(p):
+            logits = model.apply(p, x)
+            nll = -jax.nn.log_softmax(logits)[jnp.arange(2 * B), y]
+            return jnp.mean(nll)
+
+        rl, rg = jax.value_and_grad(ref)(params)
+        assert float(loss) == pytest.approx(float(rl), abs=1e-5)
+        for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(grads),
+            jax.tree_util.tree_leaves_with_path(rg),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5,
+                err_msg=jax.tree_util.keystr(pa),
+            )
+
+    def test_trainer_rejects_bad_chunking(self):
+        from pytorch_distributed_rnn_tpu.data import MotionDataset
+        from pytorch_distributed_rnn_tpu.data.synthetic import (
+            generate_har_arrays,
+        )
+        from pytorch_distributed_rnn_tpu.training.mesh import MeshTrainer
+
+        X, y = generate_har_arrays(64, seq_length=12, seed=0)
+        train = MotionDataset(X, y)
+        model = MotionModel(input_dim=9, hidden_dim=8, layer_dim=3,
+                            output_dim=6, impl="scan")
+        common = dict(model=model, training_set=train, batch_size=32,
+                      learning_rate=1e-3, seed=0)
+        with pytest.raises(ValueError, match="pp-chunks >= 2"):
+            MeshTrainer(mesh_axes={"dp": 1, "pp": 2},
+                        pp_schedule="interleaved", pp_chunks=1, **common)
+        with pytest.raises(ValueError, match="virtual stages"):
+            # 3 layers cannot split into 2 devices x 2 chunks
+            MeshTrainer(mesh_axes={"dp": 1, "pp": 2},
+                        pp_schedule="interleaved", pp_chunks=2, **common)
