@@ -31,6 +31,7 @@ import numpy as np
 import optax
 
 from pytorch_distributed_rnn_tpu.data.loader import DataLoader
+from pytorch_distributed_rnn_tpu.data.prefetch import prefetch
 from pytorch_distributed_rnn_tpu.data.sampler import DistributedSampler
 from pytorch_distributed_rnn_tpu.ops.losses import cross_entropy_loss
 from pytorch_distributed_rnn_tpu.training.checkpoint import (
@@ -682,17 +683,24 @@ class Trainer:
         train_acc = total_correct / len(self.training_set)
         return train_loss, train_acc
 
+    # host-path input pipeline: how many prepared batches ride ahead of
+    # the consuming step (data/prefetch.py - the torch-DataLoader-worker
+    # analogue: the next batch's async H2D upload overlaps this step)
+    PREFETCH_DEPTH = 2
+
     def _train_epoch_host(self, formatter):
-        """Legacy materialized-batch loop (used when the strategy must act
-        on host every step, e.g. the parameter-server worker)."""
+        """Materialized-batch loop (used when the strategy must act on
+        host every step - parameter-server push/pull, native-DDP TCP
+        allreduce - or the dataset exceeds device residence).
+
+        Pipelined: batch prep/upload is prefetched ``PREFETCH_DEPTH``
+        ahead (H2D overlaps compute), and the per-batch scalar fetches
+        are deferred to epoch end so steps dispatch back-to-back - each
+        ``float()`` would otherwise block the host on that step.  At
+        DEBUG, per-batch progress needs the values NOW; that path keeps
+        the fetch-per-batch loop (the documented cost of -v progress).
+        """
         log_progress = logging.getLogger().isEnabledFor(logging.DEBUG)
-        # host-side accumulators: each program's loss/metrics outputs are
-        # replicated over the (possibly multi-process) mesh, so fetching
-        # them immediately is legal on every rank - while accumulating
-        # into a process-LOCAL device zero can land the sum on a device
-        # other controllers cannot address
-        total_loss = 0.0
-        total_correct = 0.0
         loader = self._train_loader()
         num_batches = len(loader)
         keys = (
@@ -700,25 +708,43 @@ class Trainer:
             if self._dropout > 0.0
             else None
         )
-        for batch_idx, (features, labels) in enumerate(loader):
-            batch = self._prepare_batch(features, labels)
+        stream = prefetch(
+            (self._prepare_batch(f, l) for f, l in loader),
+            depth=self.PREFETCH_DEPTH,
+        )
+        # device-scalar accumulators, fetched after the loop: the
+        # programs' loss/metrics outputs are replicated over the
+        # (possibly multi-process) mesh, so a post-loop fetch is legal on
+        # every rank - while accumulating into a process-LOCAL device
+        # zero could land the sum on a device other controllers cannot
+        # address
+        losses, corrects = [], []
+        for batch_idx, batch in enumerate(stream):
             extra = (keys[batch_idx],) if keys is not None else ()
             self.params, self.opt_state, loss, metrics = self._train_step_fn(
                 self.params, self.opt_state, batch, *extra
             )
-            total_loss += float(loss)
-            total_correct += float(metrics["correct"])
             if log_progress:
+                # the progress message needs the values NOW - accumulate
+                # the already-fetched floats instead of re-fetching at
+                # epoch end
+                losses.append(float(loss))
+                corrects.append(float(metrics["correct"]))
                 logging.debug(
                     formatter.train_progress_message(
                         batch_idx=batch_idx,
                         batches=num_batches,
-                        training_examples=len(features),
-                        correct=_correct_count(metrics["correct"]),
-                        loss=float(loss),
+                        training_examples=len(batch[0]),
+                        correct=_correct_count(corrects[-1]),
+                        loss=losses[-1],
                     )
                 )
+            else:
+                losses.append(loss)
+                corrects.append(metrics["correct"])
 
+        total_loss = sum(float(l) for l in losses)
+        total_correct = sum(float(c) for c in corrects)
         # parity quirk kept: sum of batch-mean losses / dataset size
         train_loss = total_loss / len(self.training_set)
         train_acc = total_correct / len(self.training_set)
