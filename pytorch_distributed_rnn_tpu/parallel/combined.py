@@ -38,7 +38,10 @@ from pytorch_distributed_rnn_tpu.models.attention import (
     _layer_norm,
     _linear,
 )
-from pytorch_distributed_rnn_tpu.ops.attention import ring_attention
+from pytorch_distributed_rnn_tpu.ops.attention import (
+    mha_attention,
+    ring_attention,
+)
 from pytorch_distributed_rnn_tpu.ops.losses import cross_entropy_loss
 from pytorch_distributed_rnn_tpu.parallel.sp import (
     sp_embed_prologue,
@@ -60,8 +63,8 @@ def _row_slice(p, k, per):
     return lax.dynamic_slice_in_dim(p["weight"], k * per, per, axis=1)
 
 
-def tp_sp_block(blk, h, num_heads: int, *, sp_axis: str, tp_axis: str,
-                causal: bool = False, impl: str = "dense"):
+def tp_sp_block(blk, h, num_heads: int, *, sp_axis: str | None,
+                tp_axis: str, causal: bool = False, impl: str = "dense"):
     """One encoder block with heads tp-sharded and time sp-sharded.
 
     ``h``: (B_local, T_local, dim).  QKV column-parallel -> ring attention
@@ -69,6 +72,10 @@ def tp_sp_block(blk, h, num_heads: int, *, sp_axis: str, tp_axis: str,
     over ``tp``) -> MLP column+row parallel (one more psum).  ``impl``
     picks the ring's inner step: ``dense`` XLA online-softmax or the
     fused ``flash`` Pallas kernel.
+
+    ``sp_axis=None`` runs LOCAL attention over the full (unsharded)
+    sequence on this shard's head group - the pure-tp form the pp x tp
+    composition uses, where no sequence axis exists in the mesh.
     """
     ntp = lax.axis_size(tp_axis)
     ktp = lax.axis_index(tp_axis)
@@ -88,7 +95,16 @@ def tp_sp_block(blk, h, num_heads: int, *, sp_axis: str, tp_axis: str,
     k = split_heads(_linear(_col_slice(blk["wk"], ktp, per), y))
     v = split_heads(_linear(_col_slice(blk["wv"], ktp, per), y))
 
-    if impl == "flash":
+    if sp_axis is None:
+        if impl == "flash":
+            from pytorch_distributed_rnn_tpu.ops.pallas_attention import (
+                flash_attention,
+            )
+
+            attn = flash_attention(q, k, v, causal=causal)
+        else:
+            attn = mha_attention(q, k, v, causal=causal)
+    elif impl == "flash":
         from pytorch_distributed_rnn_tpu.ops.pallas_attention import (
             ring_flash_attention,
         )

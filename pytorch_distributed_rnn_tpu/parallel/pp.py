@@ -207,7 +207,8 @@ pp_stacked_lstm = pp_stacked_rnn
 
 def pp_transformer_blocks(blocks, h, axis: str, *, num_heads: int,
                           num_microbatches: int, compute_dtype=None,
-                          remat: bool = False):
+                          remat: bool = False, tp_axis: str | None = None,
+                          impl: str = "dense"):
     """GPipe-scheduled Transformer encoder blocks, for use inside
     ``shard_map`` over the ``pp`` axis (params and ``h`` (B, T, D)
     replicated per stage) - the attention family's pipeline axis.
@@ -218,8 +219,24 @@ def pp_transformer_blocks(blocks, h, axis: str, *, num_heads: int,
     blocks split into ``axis_size`` contiguous stages; embed/positions
     and the pooled head stay with the caller (position-wise and tiny -
     they run replicated).
+
+    ``tp_axis`` composes Megatron head/MLP sharding INSIDE each stage
+    (``parallel/combined.py:tp_sp_block`` with no sequence axis): each
+    (pp stage, tp shard) cell computes its head group + MLP slice, the
+    two per-block psums ride the tp axis, and the stage hop payload
+    stays the full (B_m, T, D) activation.  ``impl`` picks each block's
+    attention inner (``dense`` XLA or the fused ``flash`` Pallas kernel)
+    - the caller resolves the model's ``auto``.
     """
     from pytorch_distributed_rnn_tpu.models.attention import apply_block
+
+    attention_inner = None
+    if impl == "flash":
+        from pytorch_distributed_rnn_tpu.ops.pallas_attention import (
+            flash_attention,
+        )
+
+        attention_inner = lambda q, k, v: flash_attention(q, k, v)  # noqa: E731
 
     n = lax.axis_size(axis)
     L = len(blocks)
@@ -242,6 +259,11 @@ def pp_transformer_blocks(blocks, h, axis: str, *, num_heads: int,
         h_micro = h_micro.astype(compute_dtype)
         dtype = compute_dtype
 
+    if tp_axis is not None:
+        from pytorch_distributed_rnn_tpu.parallel.combined import (
+            tp_sp_block,
+        )
+
     def run_stage(stage, acts):
         for j in range(per_stage):
             p = jax.tree.map(
@@ -249,7 +271,12 @@ def pp_transformer_blocks(blocks, h, axis: str, *, num_heads: int,
                     a, stage * per_stage + j, keepdims=False),
                 stacked,
             )
-            acts = apply_block(p, acts, num_heads)
+            if tp_axis is not None:
+                acts = tp_sp_block(p, acts, num_heads, sp_axis=None,
+                                   tp_axis=tp_axis, impl=impl)
+            else:
+                acts = apply_block(p, acts, num_heads,
+                                   attention=attention_inner)
         return acts
 
     if remat:

@@ -827,21 +827,31 @@ def make_attention_mesh_loss_fn(model, mesh, *, weighted: bool = False):
 def make_attention_pp_loss_fn(model, mesh, *, num_microbatches: int = 4,
                               weighted: bool = False):
     """Shard_mapped ``loss_fn(params, x, y[, w]) -> (loss, metrics)`` for
-    the attention family over a dp x pp mesh: encoder blocks split into
-    GPipe stages over ``pp`` (``parallel/pp.py:pp_transformer_blocks``),
-    batch rows over ``dp``.  Embed/positions and the pooled head run
-    replicated on every stage (position-wise and tiny; the head computes
-    f32).  ``model.precision``/``model.remat`` thread into the staged
-    blocks (r4).  pp does not currently compose with sp/tp in one
-    program - the trainer rejects those specs loudly."""
+    the attention family over a dp x pp (x tp) mesh: encoder blocks split
+    into GPipe stages over ``pp`` (``parallel/pp.py:
+    pp_transformer_blocks``), batch rows over ``dp``, and - when the mesh
+    carries a tp axis of size > 1 - Megatron head/MLP sharding INSIDE
+    each stage (each (pp, tp) cell computes its head group + MLP slice;
+    the per-block psums ride tp).  Embed/positions and the pooled head
+    run replicated on every stage (position-wise and tiny; the head
+    computes f32).  ``model.precision``/``model.remat`` thread into the
+    staged blocks (r4).  pp does not compose with sp in one program -
+    the trainer rejects those specs loudly."""
     compute_dtype, remat = resolve_model_levers(model)
 
     from functools import partial as _partial
 
     from pytorch_distributed_rnn_tpu.models.attention import _linear
+    from pytorch_distributed_rnn_tpu.ops.pallas_attention import (
+        resolve_attention_impl,
+    )
     from pytorch_distributed_rnn_tpu.parallel.pp import (
         pp_transformer_blocks,
     )
+
+    # resolve the model's "auto" like the dp x sp x tp path: a flash
+    # request must reach the staged blocks, not silently drop to dense
+    impl = resolve_attention_impl(getattr(model, "impl", "auto"))
 
     for axis in ("dp", "pp"):
         if axis not in mesh.shape:
@@ -849,6 +859,7 @@ def make_attention_pp_loss_fn(model, mesh, *, num_microbatches: int = 4,
                 f"attention pp mesh needs axis {axis!r} (size 1 is "
                 f"fine); got {dict(mesh.shape)}"
             )
+    tp_axis = "tp" if mesh.shape.get("tp", 1) > 1 else None
 
     batch_specs = (P("dp"), P("dp")) + ((P("dp"),) if weighted else ())
 
@@ -865,7 +876,8 @@ def make_attention_pp_loss_fn(model, mesh, *, num_microbatches: int = 4,
         h = pp_transformer_blocks(
             params["blocks"], h, "pp", num_heads=model.num_heads,
             num_microbatches=num_microbatches,
-            compute_dtype=compute_dtype, remat=remat,
+            compute_dtype=compute_dtype, remat=remat, tp_axis=tp_axis,
+            impl=impl,
         )
         logits = _linear(params["head"],
                          jnp.mean(h.astype(jnp.float32), axis=1))

@@ -83,19 +83,20 @@ class MeshTrainer(SpmdTrainer):
             # `!= 1`, not `> 1`: pp=-1 ("all remaining devices") must
             # enter this branch too, not silently drop to plain DDP
             if axes.get("pp", 1) != 1:
-                # GPipe over encoder blocks (parallel/pp.py); pp does not
-                # compose with sp/tp in one program yet - reject loudly
-                # rather than silently dropping an axis
-                bad = [a for a in ("sp", "tp") if axes.get(a, 1) != 1]
-                if bad:
+                # GPipe over encoder blocks (parallel/pp.py), optionally
+                # with Megatron tp INSIDE each stage (r4); pp does not
+                # compose with sp in one program - reject loudly rather
+                # than silently dropping an axis
+                if axes.get("sp", 1) != 1:
                     raise ValueError(
-                        f"attention pp does not compose with {bad} - use "
-                        "dp x pp (e.g. --mesh dp=2,pp=2) or the dp x sp "
-                        "x tp composition"
+                        "attention pp does not compose with sp - use "
+                        "dp x pp (x tp) (e.g. --mesh dp=2,pp=2,tp=2) or "
+                        "the dp x sp x tp composition"
                     )
                 # depth % pp is checked AFTER make_mesh resolves pp=-1
                 # (below) - depth % -1 would vacuously pass here
-                axes = {"dp": axes.get("dp", 1), "pp": axes["pp"]}
+                axes = {"dp": axes.get("dp", 1), "pp": axes["pp"],
+                        "tp": axes.get("tp", 1)}
             else:
                 axes.pop("pp", None)
                 # every axis name must exist in the mesh for the composed
@@ -137,6 +138,12 @@ class MeshTrainer(SpmdTrainer):
                 raise ValueError(
                     f"--stacked-layer {model.depth} blocks do not split "
                     f"into pp={self.mesh_axes['pp']} stages"
+                )
+            tp_size = self.mesh_axes.get("tp", 1)
+            if tp_size > 1 and model.num_heads % tp_size:
+                raise ValueError(
+                    f"--num-heads {model.num_heads} does not shard over "
+                    f"tp={tp_size} (pp x tp composition)"
                 )
         super().__init__(mesh=mesh, axis="dp", **kwargs)
         if self.is_char and self.model_axis in ("sp", "sp+tp"):

@@ -661,3 +661,76 @@ class TestInterleaved1F1B:
             # 3 layers cannot split into 2 devices x 2 chunks
             MeshTrainer(mesh_axes={"dp": 1, "pp": 2},
                         pp_schedule="interleaved", pp_chunks=2, **common)
+
+
+class TestPpTpComposition:
+    """Attention dp x pp x tp: Megatron head/MLP sharding INSIDE each
+    GPipe stage - the composition the trainer rejected before r4."""
+
+    @pytest.mark.parametrize("axes", [
+        {"dp": 1, "pp": 2, "tp": 2}, {"dp": 2, "pp": 2, "tp": 2},
+    ])
+    def test_pp_tp_matches_model_apply(self, axes):
+        from pytorch_distributed_rnn_tpu.models import AttentionClassifier
+        from pytorch_distributed_rnn_tpu.parallel.strategy import (
+            make_attention_pp_loss_fn,
+        )
+        from pytorch_distributed_rnn_tpu.ops.losses import (
+            cross_entropy_loss,
+        )
+
+        model = AttentionClassifier(input_dim=IN, dim=16, depth=2,
+                                    num_heads=4, output_dim=6, max_len=T)
+        params = model.init(jax.random.PRNGKey(50))
+        mesh = make_mesh(axes)
+        bsz = 8 * axes["dp"]
+        x = jax.random.normal(jax.random.PRNGKey(51), (bsz, T, IN))
+        y = jax.random.randint(jax.random.PRNGKey(52), (bsz,), 0, 6)
+
+        loss_fn = make_attention_pp_loss_fn(model, mesh,
+                                            num_microbatches=4)
+        (loss, metrics), grads = jax.jit(
+            jax.value_and_grad(loss_fn, has_aux=True)
+        )(params, x, y)
+
+        def ref(p):
+            logits = model.apply(p, x)
+            return cross_entropy_loss(logits, y)
+
+        rl, rg = jax.value_and_grad(ref)(params)
+        assert float(loss) == pytest.approx(float(rl), abs=2e-5)
+        for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(grads),
+            jax.tree_util.tree_leaves_with_path(rg),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
+                err_msg=jax.tree_util.keystr(pa),
+            )
+
+    def test_trainer_accepts_pp_tp_and_rejects_pp_sp(self):
+        from pytorch_distributed_rnn_tpu.data import MotionDataset
+        from pytorch_distributed_rnn_tpu.data.synthetic import (
+            generate_har_arrays,
+        )
+        from pytorch_distributed_rnn_tpu.models import AttentionClassifier
+        from pytorch_distributed_rnn_tpu.training.mesh import MeshTrainer
+
+        X, y = generate_har_arrays(64, seq_length=12, seed=0)
+        train = MotionDataset(X, y)
+        model = AttentionClassifier(input_dim=9, dim=16, depth=2,
+                                    num_heads=4, output_dim=6, max_len=12)
+        common = dict(model=model, training_set=train, batch_size=32,
+                      learning_rate=1e-3, seed=0)
+        trainer = MeshTrainer(mesh_axes={"dp": 2, "pp": 2, "tp": 2},
+                              **common)
+        assert trainer.mesh_axes == {"dp": 2, "pp": 2, "tp": 2}
+        with pytest.raises(ValueError, match="does not compose with sp"):
+            MeshTrainer(mesh_axes={"dp": 1, "pp": 2, "sp": 2}, **common)
+        with pytest.raises(ValueError, match="num-heads"):
+            MeshTrainer(mesh_axes={"dp": 1, "pp": 2, "tp": 3},
+                        model=AttentionClassifier(
+                            input_dim=9, dim=16, depth=2, num_heads=4,
+                            output_dim=6, max_len=12),
+                        training_set=train, batch_size=32,
+                        learning_rate=1e-3, seed=0)
