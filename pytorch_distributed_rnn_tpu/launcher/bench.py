@@ -194,13 +194,33 @@ def matrix_configs(extra_parameters=None, backend="cpu"):
     from pytorch_distributed_rnn_tpu.parallel.strategy import parse_mesh_spec
 
     rows = []
+    # mesh rows are (trainer_string, extra main-parser params): subcommand
+    # flags (--mesh/--pp-schedule/--pp-chunks) ride in the trainer string,
+    # main-parser flags (--stacked-layer/--moe-top-k) must precede the
+    # subcommand and therefore go through params
     for family, fam_params, meshes in (
-        ("rnn", {}, ["mesh --mesh dp=2,sp=2 --sp-schedule sequential"]),
-        ("char", {"seq-length": 15}, ["mesh --mesh dp=2,sp=2",
-                                      "mesh --mesh dp=2,sp=2,tp=2"]),
-        ("attention", {}, ["mesh --mesh dp=2,sp=2,tp=2",
-                           "mesh --mesh dp=2,pp=2"]),
-        ("moe", {}, ["mesh --mesh dp=2,ep=2"]),
+        ("rnn", {}, [
+            ("mesh --mesh dp=2,sp=2 --sp-schedule sequential", {}),
+            # interleaved 1F1B: 2 virtual chunks per pp device
+            # (4 layers = 2 stages x 2 chunks x 1 layer)
+            ("mesh --mesh dp=1,pp=2 --pp-schedule interleaved "
+             "--pp-chunks 2", {"stacked-layer": 4}),
+        ]),
+        ("char", {"seq-length": 15}, [
+            ("mesh --mesh dp=2,sp=2", {}),
+            ("mesh --mesh dp=2,sp=2,tp=2", {}),
+        ]),
+        ("attention", {}, [
+            ("mesh --mesh dp=2,sp=2,tp=2", {}),
+            ("mesh --mesh dp=2,pp=2", {}),
+            # Megatron tp inside each GPipe stage (r4)
+            ("mesh --mesh dp=1,pp=2,tp=2", {}),
+        ]),
+        ("moe", {}, [
+            ("mesh --mesh dp=2,ep=2", {}),
+            # GShard top-2 routing over the ep mesh (r4)
+            ("mesh --mesh dp=2,ep=2", {"moe-top-k": 2}),
+        ]),
     ):
         params = {**_MATRIX_BASE, "model": family, **fam_params,
                   **(extra_parameters or {})}
@@ -210,9 +230,10 @@ def matrix_configs(extra_parameters=None, backend="cpu"):
             ("parameter-server", 2),
         ):
             rows.append(make_config(trainer, devices, 1, params, backend))
-        for mesh_trainer in meshes:
+        for mesh_trainer, mesh_params in meshes:
             size = prod(parse_mesh_spec(_mesh_spec_of(mesh_trainer)).values())
-            rows.append(make_config(mesh_trainer, size, 1, params, backend))
+            rows.append(make_config(mesh_trainer, size, 1,
+                                    {**params, **mesh_params}, backend))
     return rows
 
 

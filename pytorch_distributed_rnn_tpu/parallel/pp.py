@@ -231,12 +231,13 @@ def pp_transformer_blocks(blocks, h, axis: str, *, num_heads: int,
     from pytorch_distributed_rnn_tpu.models.attention import apply_block
 
     attention_inner = None
-    if impl == "flash":
+    if impl == "flash" and tp_axis is None:
+        # the tp path dispatches flash inside tp_sp_block itself
         from pytorch_distributed_rnn_tpu.ops.pallas_attention import (
             flash_attention,
         )
 
-        attention_inner = lambda q, k, v: flash_attention(q, k, v)  # noqa: E731
+        attention_inner = flash_attention
 
     n = lax.axis_size(axis)
     L = len(blocks)

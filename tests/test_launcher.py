@@ -432,8 +432,10 @@ def test_fsdp_multi_slot_is_a_real_process_world():
 
 def test_matrix_configs_cover_every_readme_cell():
     """run-matrix = one run per strategy x family matrix cell (every cell
-    trainable since r3).  4 families x 6 dp-strategies + 6 mesh rows
-    (char carries both the sp and the composed sp x tp cell since r4)."""
+    trainable since r3).  4 families x 6 dp-strategies + 9 mesh rows
+    (char carries sp and composed sp x tp; rnn adds the interleaved pp
+    cell, attention the composed pp x tp cell, and moe the GShard top-2
+    cell since r4)."""
     from pytorch_distributed_rnn_tpu.launcher import bench
     from pytorch_distributed_rnn_tpu.launcher.commands import (
         command_string,
@@ -441,7 +443,7 @@ def test_matrix_configs_cover_every_readme_cell():
     )
 
     cfgs = bench.matrix_configs()
-    assert len(cfgs) == 30
+    assert len(cfgs) == 33
     by_family = {}
     for c in cfgs:
         fam = c.parameters_dict()["model"]
@@ -452,9 +454,18 @@ def test_matrix_configs_cover_every_readme_cell():
                   "distributed-native", "parameter-server"):
             assert t in trainers, (fam, t)
         assert any(t.startswith("mesh") for t in trainers), fam
-    # attention covers BOTH mesh compositions (3d and GPipe pp)
+    # attention covers all THREE mesh compositions (3d, GPipe pp, pp x tp)
     att = [t for t in by_family["attention"] if t.startswith("mesh")]
     assert any("tp=2" in t for t in att) and any("pp=2" in t for t in att)
+    assert any("pp=2,tp=2" in t for t in att)
+    # rnn carries the interleaved virtual-stage cell, moe the top-2 cell
+    assert any("interleaved" in t for t in by_family["rnn"])
+    moe_topk = [
+        c for c in cfgs
+        if c.parameters_dict()["model"] == "moe"
+        and c.parameters_dict().get("moe-top-k") == 2
+    ]
+    assert len(moe_topk) == 1
     # every config synthesizes a unique, runnable command
     seen = set()
     for c in cfgs:
