@@ -481,6 +481,51 @@ def main():
                     lambda: _attn_row(4096, batch=8, steps=5,
                                       impl="flash", precision="bf16",
                                       dim=512, num_heads=4))
+            # pure-kernel block-size ladder: flash fwd+bwd at the
+            # MXU-relevant shape (head_dim 128, T=1024) across block_q/
+            # block_k tilings - the Pallas tuning lever the model-level
+            # rows cannot separate from everything around the kernel
+            def _flash_block_ladder():
+                import jax
+                import jax.numpy as jnp
+
+                from pytorch_distributed_rnn_tpu.ops.pallas_attention import (  # noqa: E501
+                    flash_attention,
+                )
+
+                rng = np.random.RandomState(0)
+                q, k, v = (
+                    jnp.asarray(
+                        rng.randn(8, 8, 1024, 128).astype(np.float32)
+                    ).astype(jnp.bfloat16)
+                    for _ in range(3)
+                )
+                ladder = {}
+                for bq, bk in ((256, 256), (256, 512), (512, 256),
+                               (512, 512), (128, 1024)):
+                    try:
+                        def f(q, k, v, _bq=bq, _bk=bk):
+                            return jnp.sum(
+                                flash_attention(
+                                    q, k, v, block_q=_bq, block_k=_bk
+                                ).astype(jnp.float32))
+
+                        step = jax.jit(jax.grad(f, argnums=(0, 1, 2)))
+                        jax.block_until_ready(step(q, k, v))  # compile
+                        iters = 10
+                        start = time.perf_counter()
+                        for _ in range(iters):
+                            out = step(q, k, v)
+                        jax.block_until_ready(out)
+                        ladder[f"bq{bq}_bk{bk}_ms"] = round(
+                            (time.perf_counter() - start) * 1000 / iters,
+                            3)
+                    except Exception as exc:  # noqa: BLE001 - keep rungs
+                        ladder[f"bq{bq}_bk{bk}_ms"] = (
+                            f"error: {type(exc).__name__}: {exc}"[:120])
+                return ladder
+
+            attempt("attention_flash_block_ladder", _flash_block_ladder)
             # LAST on purpose: the deliberately-failure-prone row (dense
             # O(T^2) scores at T=4096 may OOM or hang the remote compile
             # helper); everything measured before it is already on disk

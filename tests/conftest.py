@@ -59,6 +59,13 @@ QUICK_NODEIDS = (
     "test_ops_parity.py",
     "test_pallas_rnn.py::test_fused_forward_matches_scan",
     "test_pallas_attention.py::TestForwardParity::test_matches_dense",
+    # r4 capability anchors: one representative each for the interleaved
+    # pp schedule, the GShard top-2 router, and the sharded checkpoint
+    # round-trip (the pipelined host loop is covered transitively by the
+    # PS/native-ddp strategy rows above, which run it)
+    "test_pp.py::TestInterleaved1F1B::test_bubble_shrinks_with_chunks",
+    "test_moe.py::TestTop2Routing::test_dispatch_top2_matches_dense_with_ample_capacity",
+    "test_sharded_checkpoint.py::TestShardedSingleDevice::test_local_trainer_round_trips",
 )
 
 
