@@ -20,3 +20,9 @@ def test_example_4d_runs():
     from examples.example_4d import main
 
     main()
+
+
+def test_example_longcontext_runs():
+    from examples.example_longcontext import main
+
+    main()
