@@ -113,7 +113,10 @@ def main():
     print(f"causal ring == causal full over sp={SP}: "
           f"max|diff| = {float(jnp.abs(out_rc - out_fc).max()):.2e}")
 
-    # 4. the ring inside a real training step: dp x sp x tp
+    # 4. the CAUSAL ring inside a real training step: dp x sp x tp with
+    # causal=True - the LM framing of demo 3 threaded through the whole
+    # composed program (the plain non-causal composition is demo 1 of
+    # examples/example_4d.py; this one is the long-context variant)
     axes = {"dp": 2, "sp": 2, "tp": 2}
     mesh3d = make_mesh(axes)
     model = AttentionClassifier(input_dim=9, dim=32, depth=2, num_heads=4,
@@ -121,7 +124,8 @@ def main():
     params = model.init(jax.random.PRNGKey(0))
     opt = optax.adam(1e-3)
     state = opt.init(params)
-    step = make_3d_train_step(model, opt, mesh3d, donate=False)
+    step = make_3d_train_step(model, opt, mesh3d, causal=True,
+                              donate=False)
     x = jnp.asarray(rng.randn(4, 64, 9).astype(np.float32))
     y = jnp.asarray(rng.randint(0, 6, size=4))
     losses = []
@@ -129,7 +133,7 @@ def main():
         params, state, loss = step(params, state, (x, y))
         losses.append(float(loss))
     assert losses[-1] < losses[0], "training did not reduce loss"
-    print(f"dp x sp x tp training {axes}: "
+    print(f"causal dp x sp x tp training {axes}: "
           f"loss {losses[0]:.4f} -> {losses[-1]:.4f}")
     print("long-context example OK")
 

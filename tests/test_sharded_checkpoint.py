@@ -216,6 +216,55 @@ class TestCliSurface:
         main(common + ["--resume", str(ckpt), "fsdp"])
 
 
+@pytest.mark.slow
+def test_multi_controller_world_saves_sharded_and_resumes(tmp_path):
+    """The no-gather claim's real payoff: a 2-process jax.distributed
+    fsdp world saves sharded (each controller writes only the shards it
+    owns; orbax coordinates the finalize over the jax.distributed KV
+    store) and a later single-process run restores from the .orbax dir -
+    the full state never gathered into any one host's memory on the way
+    out."""
+    import subprocess
+    import sys
+
+    from pytorch_distributed_rnn_tpu.launcher import launch_jax_world
+
+    data_dir = tmp_path / "data"
+    subprocess.run(
+        [sys.executable, "-m", "pytorch_distributed_rnn_tpu.launcher",
+         "prepare-data", "--dataset-path", str(data_dir),
+         "--num-train", "192", "--num-test", "32"],
+        check=True, capture_output=True, text=True,
+    )
+    common = [
+        "--dataset-path", str(data_dir),
+        "--checkpoint-directory", str(tmp_path / "models"),
+        "--checkpoint-format", "sharded",
+        "--checkpoint-every", "1",
+        "--epochs", "1", "--batch-size", "48", "--seed", "123456789",
+        "--no-validation", "--log", "INFO",
+    ]
+    results = launch_jax_world(
+        2, common, devices_per_process=2, trainer="fsdp",
+        coordinator_port=29881, timeout=300, cwd=tmp_path,
+    )
+    # spawn_world raises on any nonzero-rc rank - reaching here means
+    # both controllers trained and exited clean
+    assert len(results) == 2
+    ckpt = tmp_path / "models" / "checkpoint-epoch-1.orbax"
+    assert is_sharded_checkpoint(ckpt)
+    assert (tmp_path / "models" / "checkpoint-epoch-1.meta.json").exists()
+
+    # a DIFFERENT topology (one process, 4 devices) restores the
+    # 2-process-written checkpoint; launch_jax_world builds the child
+    # env correctly (PYTHONPATH prepend, inherited device-count strip)
+    (rc, out, err), = launch_jax_world(
+        1, common + ["--resume", str(ckpt)], devices_per_process=4,
+        trainer="fsdp", coordinator_port=29882, timeout=300, cwd=tmp_path,
+    )
+    assert "Resumed from" in err
+
+
 class TestMetaSidecar:
     def test_best_model_meta_and_overwrite(self, tmp_path):
         import jax.numpy as jnp
