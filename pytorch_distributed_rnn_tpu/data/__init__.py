@@ -1,5 +1,6 @@
 from pytorch_distributed_rnn_tpu.data.dataset import MotionDataset
 from pytorch_distributed_rnn_tpu.data.loader import DataLoader
+from pytorch_distributed_rnn_tpu.data.prefetch import prefetch
 from pytorch_distributed_rnn_tpu.data.processor import MotionDataProcessor
 from pytorch_distributed_rnn_tpu.data.sampler import DistributedSampler
 from pytorch_distributed_rnn_tpu.data.synthetic import (
@@ -13,5 +14,6 @@ __all__ = [
     "MotionDataProcessor",
     "DistributedSampler",
     "generate_har_arrays",
+    "prefetch",
     "write_synthetic_har_dataset",
 ]

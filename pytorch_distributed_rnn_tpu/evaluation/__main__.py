@@ -20,15 +20,31 @@ def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="pytorch_distributed_rnn_tpu.evaluation"
     )
-    parser.add_argument("results", nargs="+", help="results_*.json files")
+    parser.add_argument("results", nargs="*", help="results_*.json files")
     parser.add_argument("--csv", default=None, help="write scaling table CSV")
     parser.add_argument("--plot", default=None, help="write scaling figure")
     parser.add_argument("--network-plot", default=None,
                         help="write the delay/loss perturbation figure "
                         "(needs results with fault rules)")
+    parser.add_argument("--bubble-plot", default=None,
+                        help="write the pipeline-schedule bubble-fraction "
+                        "figure (pure timetable accounting - needs no "
+                        "results files)")
     parser.add_argument("--batch-size", type=int, default=None,
                         help="restrict the plot to one batch size")
     args = parser.parse_args(argv)
+
+    if args.bubble_plot:
+        from pytorch_distributed_rnn_tpu.evaluation.plots import (
+            plot_bubble_fractions,
+        )
+
+        plot_bubble_fractions(args.bubble_plot)
+        print(f"wrote {args.bubble_plot}")
+        if not args.results:
+            return 0
+    if not args.results:
+        parser.error("results files required (or pass --bubble-plot)")
 
     import pandas as pd
 

@@ -185,3 +185,17 @@ def test_network_plot_requires_fault_rules(tmp_path):
             create_measurement_df([_run("local", 1, 10.0, 100.0)]),
             tmp_path / "x.png",
         )
+
+
+def test_bubble_plot_needs_no_results(tmp_path):
+    """--bubble-plot is pure timetable accounting: runs with no results
+    files; bare invocation without either still errors."""
+    from pytorch_distributed_rnn_tpu.evaluation.__main__ import main
+
+    png_path = tmp_path / "bubble.png"
+    rc = main(["--bubble-plot", str(png_path)])
+    assert rc == 0
+    assert png_path.exists() and png_path.stat().st_size > 0
+
+    with pytest.raises(SystemExit):
+        main([])
