@@ -85,6 +85,13 @@ def build_parser() -> argparse.ArgumentParser:
         "gates; capacity slots assigned choice-major so second choices "
         "drop first under pressure)",
     )
+    parser.add_argument(
+        "--moe-router", default="token", choices=["token", "expert"],
+        help="--model moe routing direction: token (tokens pick experts "
+        "- Switch/GShard, see --moe-top-k) or expert (expert-choice: "
+        "each expert picks its top-C tokens - perfectly balanced by "
+        "construction, no aux loss)",
+    )
     parser.add_argument("--resume", default=None, type=Path)
     parser.add_argument(
         "--checkpoint-every", default=0, type=int, metavar="N",

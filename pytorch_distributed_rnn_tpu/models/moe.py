@@ -21,8 +21,19 @@ Two forward paths share one parameter tree:
   training and by evaluation under every strategy (the numerics reference).
 - the expert-parallel path - ``parallel/strategy.py::make_moe_mesh_loss_fn``
   shards experts over ``ep`` and batch over dp x ep via
-  ``parallel/ep.py::ep_moe_ffn``; with ample capacity it equals the dense
-  path exactly (Switch drop semantics otherwise).
+  ``parallel/ep.py::ep_moe_ffn``; for TOKEN-choice routing, ample
+  capacity makes it equal the dense path exactly (Switch drop semantics
+  otherwise).
+
+Expert-choice caveat (``router_type="expert"``): selection is inherently
+GLOBAL over whatever token set the router sees.  The dense path selects
+over the full batch; the ep-sharded path selects over each shard's local
+tokens (the standard sharded-EC practice - keeps selection
+communication-free and every expert exactly balanced per shard).  The
+two agree only at one shard; at ep > 1, training (shard-local EC) and
+dense-path evaluation (global EC) use slightly different routing
+functions - an inherent property of expert-choice under data sharding,
+not a bug in either path.
 """
 
 from __future__ import annotations
@@ -50,6 +61,9 @@ class MoEClassifier:
     num_selected: int = 1  # experts per token: 1 = Switch (raw max-gate
     # combine weight), 2 = GShard (renormalized top-2 gates, choice-major
     # capacity slots - second choices drop first under pressure)
+    router_type: str = "token"  # "token": tokens pick experts (Switch/
+    # GShard above); "expert": expert-choice - each expert picks its
+    # top-C tokens, perfectly balanced by construction, aux loss 0
     expert_hidden: int | None = None  # default 2 * hidden_dim
     capacity_factor: float = 2.0
     aux_weight: float = 0.01  # Switch load-balancing loss weight
@@ -69,6 +83,17 @@ class MoEClassifier:
             raise ValueError(
                 f"--moe-top-k {self.num_selected} needs at least that "
                 f"many experts (--num-experts {self.num_experts})"
+            )
+        if self.router_type not in ("token", "expert"):
+            raise ValueError(
+                f"unknown --moe-router {self.router_type!r} - use token "
+                "or expert"
+            )
+        if self.router_type == "expert" and self.num_selected != 1:
+            raise ValueError(
+                "--moe-top-k is a token-choice knob; expert-choice "
+                "routing picks per-expert capacities instead - drop "
+                "--moe-top-k or use --moe-router token"
             )
 
     @property
@@ -102,8 +127,18 @@ class MoEClassifier:
 
         moe_params = cast_expert_params(params["moe"], compute_dtype)
 
-        def dense(p, h):
-            return moe_ffn_dense(p, h, num_selected=self.num_selected)
+        if self.router_type == "expert":
+            from pytorch_distributed_rnn_tpu.ops.moe import (
+                moe_ffn_expert_choice,
+            )
+
+            def dense(p, h):
+                return moe_ffn_expert_choice(
+                    p, h, capacity_factor=self.capacity_factor)
+        else:
+            def dense(p, h):
+                return moe_ffn_dense(p, h,
+                                     num_selected=self.num_selected)
 
         moe_fn = jax.checkpoint(dense) if self.remat else dense
         moe_out, aux = moe_fn(moe_params, out)

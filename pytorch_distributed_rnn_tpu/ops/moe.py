@@ -184,6 +184,50 @@ def moe_ffn(params, x, *, capacity_factor: float = 2.0,
     return out.reshape(shape), aux
 
 
+def _route_expert_choice(params, xt, capacity: int):
+    """Expert-choice selection: returns ``(sel, vals)`` - each expert's
+    top-``capacity`` tokens as an (E, C, N) one-hot and their (E, C)
+    gate affinities.  ONE definition shared by the dense path and the
+    ep-sharded path (the :func:`moe_capacity` convention), so the two
+    can never disagree on selection semantics."""
+    n = xt.shape[0]
+    logits = xt @ params["router"]["weight"].T + params["router"]["bias"]
+    gates = jax.nn.softmax(logits, axis=-1)  # (N, E)
+    vals, idx = jax.lax.top_k(gates.T, min(capacity, n))  # (E, C)
+    sel = jax.nn.one_hot(idx, n, dtype=xt.dtype)  # (E, C, N)
+    return sel, vals
+
+
+def moe_ffn_expert_choice(params, x, *, capacity_factor: float = 1.0):
+    """Expert-choice MoE FFN (Zhou et al. 2022): EXPERTS pick tokens.
+
+    Token-choice (Switch/GShard above) lets each token pick its experts
+    and drops overflow; expert-choice inverts it - each expert selects
+    its top-C tokens by gate affinity, so every expert processes EXACTLY
+    C tokens: perfect load balance by construction, no auxiliary loss
+    (returned aux is 0.0 to keep the family's loss surface uniform).
+    A token may be chosen by several experts (outputs sum, gate-weighted)
+    or by none (passes through the caller's residual unchanged).
+
+    C = ceil(tokens * capacity_factor / E).  All-dense formulation: the
+    per-expert top-C becomes a (E, C, N) one-hot gather einsum, so
+    dispatch/combine tile onto the MXU like the token-choice paths.
+    """
+    shape = x.shape
+    d = shape[-1]
+    xt = x.reshape(-1, d)
+    n = xt.shape[0]
+    e = params["w1"].shape[0]
+    sel, vals = _route_expert_choice(
+        params, xt, moe_capacity(n, e, capacity_factor))
+
+    tokens = jnp.einsum("ecn,nd->ecd", sel, xt)
+    out_tokens = _expert_ffn(params, tokens)
+    combine = sel * vals[..., None].astype(xt.dtype)  # gate-weighted
+    out = jnp.einsum("ecn,ecd->nd", combine, out_tokens)
+    return out.reshape(shape), jnp.float32(0.0)
+
+
 def moe_ffn_dense(params, x, *, num_selected: int = 1):
     """Exact top-k MoE: every expert computes every token, the gates
     pick.  O(E) compute - the parity reference for the dispatched

@@ -23,6 +23,7 @@ from jax import shard_map
 
 from pytorch_distributed_rnn_tpu.ops.moe import (
     _expert_ffn,
+    _route_expert_choice,
     _route_topk,
     make_dispatch_topk,
     moe_capacity,
@@ -30,12 +31,18 @@ from pytorch_distributed_rnn_tpu.ops.moe import (
 
 
 def ep_moe_ffn(params, x_local, axis: str, *, capacity_factor: float = 2.0,
-               num_selected: int = 1, stat_axes=None):
-    """Expert-parallel top-k MoE FFN inside ``shard_map``.
+               num_selected: int = 1, router: str = "token",
+               stat_axes=None):
+    """Expert-parallel MoE FFN inside ``shard_map``.
 
     ``params`` replicated, ``x_local``: this shard's (..., D) tokens
-    (batch-sharded along ``axis``).  ``num_selected=1`` is Switch,
-    ``2`` is GShard (renormalized gates, choice-major capacity).
+    (batch-sharded along ``axis``).  ``router="token"``:
+    ``num_selected=1`` is Switch, ``2`` is GShard (renormalized gates,
+    choice-major capacity).  ``router="expert"``: expert-choice - each
+    expert picks its top-C tokens among this SHARD's tokens (the
+    standard sharded EC practice: selection is shard-local, so each
+    expert owner processes exactly n_shards x C slots - perfectly
+    balanced by construction), aux is 0.
     Returns ``(out_local, aux_loss)`` with ``aux_loss`` the Switch
     load-balancing loss averaged over ``stat_axes`` (default: the expert
     axis only).  When tokens also shard over other mesh axes (the
@@ -53,12 +60,27 @@ def ep_moe_ffn(params, x_local, axis: str, *, capacity_factor: float = 2.0,
     if e % n != 0:
         raise ValueError(f"{e} experts do not shard over {n} devices")
     e_local = e // n
-    capacity = moe_capacity(n_tok, e, capacity_factor, num_selected)
 
-    experts_k, probs_k, gates = _route_topk(params, xt, num_selected)
-    expert = experts_k[:, 0]  # first choice drives the aux loss below
-    dispatch, combine = make_dispatch_topk(experts_k, probs_k, e, capacity,
-                                           xt.dtype)
+    if router == "expert":
+        if num_selected != 1:
+            # same loud reject as the model surface: --moe-top-k is a
+            # token-choice knob; silently ignoring it here would let a
+            # caller believe they got top-2 semantics
+            raise ValueError(
+                "num_selected is a token-choice knob; expert-choice "
+                "routing picks per-expert capacities instead"
+            )
+        sel, vals = _route_expert_choice(
+            params, xt, moe_capacity(n_tok, e, capacity_factor))
+        dispatch = sel.transpose(2, 0, 1)  # (N, E, C)
+        combine = (sel * vals[..., None].astype(xt.dtype)).transpose(
+            2, 0, 1)
+    else:
+        capacity = moe_capacity(n_tok, e, capacity_factor, num_selected)
+        experts_k, probs_k, gates = _route_topk(params, xt, num_selected)
+        expert = experts_k[:, 0]  # first choice drives the aux loss
+        dispatch, combine = make_dispatch_topk(experts_k, probs_k, e,
+                                               capacity, xt.dtype)
 
     # pack local tokens into (E, C, D) slots, send each expert block to its
     # owner: (E, C, D) -> (E/n, n*C, D) with slots ordered by source shard
@@ -77,6 +99,9 @@ def ep_moe_ffn(params, x_local, axis: str, *, capacity_factor: float = 2.0,
                                 concat_axis=0, tiled=True)
     out = jnp.einsum("nec,ecd->nd", combine, out_tokens)
 
+    if router == "expert":
+        # perfectly balanced by construction - no load-balancing loss
+        return out.reshape(shape), jnp.float32(0.0)
     # the Switch aux loss is a product of two *global* means - average the
     # per-shard means first (pmean of each factor), then combine; averaging
     # per-shard losses would bias the product
@@ -90,7 +115,7 @@ def ep_moe_ffn(params, x_local, axis: str, *, capacity_factor: float = 2.0,
 
 def make_ep_train_step(optimizer, mesh, axis: str = "ep", *,
                        capacity_factor: float = 2.0,
-                       num_selected: int = 1,
+                       num_selected: int = 1, router: str = "token",
                        aux_weight: float = 0.01, donate: bool = True):
     """Jitted expert-parallel MoE *training* step (regression shape):
     ``step(params, opt_state, x, y)`` with ``x``/``y`` (N, D) sharded
@@ -114,7 +139,7 @@ def make_ep_train_step(optimizer, mesh, axis: str = "ep", *,
     def loss_fn(params, x_local, y_local):
         out, aux = ep_moe_ffn(params, x_local, axis,
                               capacity_factor=capacity_factor,
-                              num_selected=num_selected)
+                              num_selected=num_selected, router=router)
         local = jnp.mean((out - y_local) ** 2)
         return lax.pmean(local, axis) + aux_weight * aux
 
@@ -129,7 +154,7 @@ def make_ep_train_step(optimizer, mesh, axis: str = "ep", *,
 
 def make_ep_moe_forward(mesh, axis: str = "ep", *,
                         capacity_factor: float = 2.0,
-                        num_selected: int = 1):
+                        num_selected: int = 1, router: str = "token"):
     """Jitted expert-parallel MoE FFN: tokens (N, D) sharded along ``axis``
     on entry, outputs sharded the same way; aux loss replicated."""
 
@@ -143,6 +168,6 @@ def make_ep_moe_forward(mesh, axis: str = "ep", *,
     def forward(params, x_local):
         return ep_moe_ffn(params, x_local, axis,
                           capacity_factor=capacity_factor,
-                          num_selected=num_selected)
+                          num_selected=num_selected, router=router)
 
     return jax.jit(forward)

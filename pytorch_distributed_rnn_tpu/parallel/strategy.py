@@ -954,6 +954,7 @@ def make_moe_mesh_loss_fn(model, mesh, *, weighted: bool = False):
                 mp, h_in, "ep",
                 capacity_factor=model.capacity_factor,
                 num_selected=model.num_selected,
+                router=model.router_type,
                 stat_axes=data,
             )
 

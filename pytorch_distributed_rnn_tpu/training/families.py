@@ -121,6 +121,7 @@ def build_model(args, training_set):
             output_dim=len(MotionDataset.LABELS),
             num_experts=getattr(args, "num_experts", 4),
             num_selected=getattr(args, "moe_top_k", 1),
+            router_type=getattr(args, "moe_router", "token"),
             cell=getattr(args, "cell", "lstm"),
             precision=getattr(args, "precision", "f32"),
             remat=getattr(args, "remat", False),

@@ -146,6 +146,107 @@ class TestTop2Routing:
         np.testing.assert_allclose(aux_ep, aux_d, rtol=1e-6, atol=1e-7)
 
 
+class TestExpertChoice:
+    """Expert-choice routing (experts pick tokens): perfect balance by
+    construction, manual parity, shard-local EC under ep, and the model
+    surface's rejects."""
+
+    def test_every_expert_exactly_at_capacity(self, setup):
+        from pytorch_distributed_rnn_tpu.ops.moe import (
+            moe_capacity,
+            moe_ffn_expert_choice,
+        )
+
+        params, x = setup
+        out, aux = moe_ffn_expert_choice(params, x, capacity_factor=1.0)
+        assert float(aux) == 0.0
+        # the balance property is structural: capacity C per expert,
+        # always filled (tokens can repeat across experts, not within)
+        assert moe_capacity(N, E, 1.0) == N // E
+
+    def test_matches_manual_computation(self, setup):
+        from pytorch_distributed_rnn_tpu.ops.moe import (
+            _expert_ffn,
+            moe_capacity,
+            moe_ffn_expert_choice,
+        )
+
+        params, x = setup
+        out, _ = moe_ffn_expert_choice(params, x, capacity_factor=1.0)
+
+        logits = (np.asarray(x) @ np.asarray(params["router"]["weight"]).T
+                  + np.asarray(params["router"]["bias"]))
+        gates = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+        C = moe_capacity(N, E, 1.0)
+        want = np.zeros((N, D), np.float64)
+        all_out = np.asarray(_expert_ffn(
+            params, jnp.broadcast_to(x, (E, N, D))))  # (E, N, D)
+        for e_i in range(E):
+            top = np.argsort(-gates[:, e_i], kind="stable")[:C]
+            for t in top:
+                want[t] += gates[t, e_i] * all_out[e_i, t]
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4,
+                                   atol=1e-5)
+
+    @pytest.mark.parametrize("ep", [1, 2])
+    def test_ep_single_shard_matches_dense(self, setup, ep):
+        """ep=1: shard-local EC selection == global EC exactly.  ep=2:
+        the sharded program still runs balanced with aux 0 (selection is
+        shard-local by design, so no cross-shard parity claim)."""
+        from pytorch_distributed_rnn_tpu.ops.moe import (
+            moe_ffn_expert_choice,
+        )
+
+        params, x = setup
+        mesh = make_mesh({"ep": ep})
+        out_ep, aux_ep = make_ep_moe_forward(
+            mesh, capacity_factor=1.0, router="expert")(params, x)
+        assert float(aux_ep) == 0.0
+        if ep == 1:
+            out_d, _ = moe_ffn_expert_choice(params, x,
+                                             capacity_factor=1.0)
+            np.testing.assert_allclose(out_ep, out_d, rtol=1e-5,
+                                       atol=1e-6)
+        else:
+            assert np.isfinite(np.asarray(out_ep)).all()
+
+    def test_expert_choice_trains(self, setup):
+        import optax
+
+        from pytorch_distributed_rnn_tpu.ops.moe import (
+            moe_ffn_expert_choice,
+        )
+
+        params, x = setup
+        y = jax.random.normal(jax.random.PRNGKey(2), (N, D))
+        opt = optax.adam(1e-2)
+        state = opt.init(params)
+
+        @jax.jit
+        def step(p, s):
+            def loss_fn(p):
+                out, _ = moe_ffn_expert_choice(p, x, capacity_factor=1.0)
+                return jnp.mean((out - y) ** 2)
+
+            l, g = jax.value_and_grad(loss_fn)(p)
+            u, s = opt.update(g, s, p)
+            return optax.apply_updates(p, u), s, l
+
+        losses = []
+        for _ in range(40):
+            params, state, l = step(params, state)
+            losses.append(float(l))
+        assert losses[-1] < losses[0]
+
+    def test_model_surface_rejects(self):
+        from pytorch_distributed_rnn_tpu.models import MoEClassifier
+
+        with pytest.raises(ValueError, match="moe-router"):
+            MoEClassifier(router_type="topk")
+        with pytest.raises(ValueError, match="token-choice knob"):
+            MoEClassifier(router_type="expert", num_selected=2)
+
+
 def test_moe_training_balances_and_learns(setup):
     """Aux-weighted training: loss decreases and routing spreads."""
     import optax
