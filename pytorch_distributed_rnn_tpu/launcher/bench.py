@@ -220,6 +220,8 @@ def matrix_configs(extra_parameters=None, backend="cpu"):
             ("mesh --mesh dp=2,ep=2", {}),
             # GShard top-2 routing over the ep mesh (r4)
             ("mesh --mesh dp=2,ep=2", {"moe-top-k": 2}),
+            # expert-choice routing over the ep mesh (r4)
+            ("mesh --mesh dp=2,ep=2", {"moe-router": "expert"}),
         ]),
     ):
         params = {**_MATRIX_BASE, "model": family, **fam_params,

@@ -185,17 +185,18 @@ def moe_ffn(params, x, *, capacity_factor: float = 2.0,
 
 
 def _route_expert_choice(params, xt, capacity: int):
-    """Expert-choice selection: returns ``(sel, vals)`` - each expert's
-    top-``capacity`` tokens as an (E, C, N) one-hot and their (E, C)
-    gate affinities.  ONE definition shared by the dense path and the
+    """Expert-choice selection AND combine weighting: returns
+    ``(sel, combine)``, both (E, C, N) - each expert's top-``capacity``
+    tokens as a one-hot and the same one-hot scaled by the gate
+    affinity.  ONE definition shared by the dense path and the
     ep-sharded path (the :func:`moe_capacity` convention), so the two
-    can never disagree on selection semantics."""
+    can never disagree on selection or weighting semantics."""
     n = xt.shape[0]
     logits = xt @ params["router"]["weight"].T + params["router"]["bias"]
     gates = jax.nn.softmax(logits, axis=-1)  # (N, E)
     vals, idx = jax.lax.top_k(gates.T, min(capacity, n))  # (E, C)
     sel = jax.nn.one_hot(idx, n, dtype=xt.dtype)  # (E, C, N)
-    return sel, vals
+    return sel, sel * vals[..., None].astype(xt.dtype)
 
 
 def moe_ffn_expert_choice(params, x, *, capacity_factor: float = 1.0):
@@ -218,12 +219,11 @@ def moe_ffn_expert_choice(params, x, *, capacity_factor: float = 1.0):
     xt = x.reshape(-1, d)
     n = xt.shape[0]
     e = params["w1"].shape[0]
-    sel, vals = _route_expert_choice(
+    sel, combine = _route_expert_choice(
         params, xt, moe_capacity(n, e, capacity_factor))
 
     tokens = jnp.einsum("ecn,nd->ecd", sel, xt)
     out_tokens = _expert_ffn(params, tokens)
-    combine = sel * vals[..., None].astype(xt.dtype)  # gate-weighted
     out = jnp.einsum("ecn,ecd->nd", combine, out_tokens)
     return out.reshape(shape), jnp.float32(0.0)
 

@@ -70,11 +70,10 @@ def ep_moe_ffn(params, x_local, axis: str, *, capacity_factor: float = 2.0,
                 "num_selected is a token-choice knob; expert-choice "
                 "routing picks per-expert capacities instead"
             )
-        sel, vals = _route_expert_choice(
+        sel, combine_ecn = _route_expert_choice(
             params, xt, moe_capacity(n_tok, e, capacity_factor))
         dispatch = sel.transpose(2, 0, 1)  # (N, E, C)
-        combine = (sel * vals[..., None].astype(xt.dtype)).transpose(
-            2, 0, 1)
+        combine = combine_ecn.transpose(2, 0, 1)
     else:
         capacity = moe_capacity(n_tok, e, capacity_factor, num_selected)
         experts_k, probs_k, gates = _route_topk(params, xt, num_selected)

@@ -432,10 +432,10 @@ def test_fsdp_multi_slot_is_a_real_process_world():
 
 def test_matrix_configs_cover_every_readme_cell():
     """run-matrix = one run per strategy x family matrix cell (every cell
-    trainable since r3).  4 families x 6 dp-strategies + 9 mesh rows
+    trainable since r3).  4 families x 6 dp-strategies + 10 mesh rows
     (char carries sp and composed sp x tp; rnn adds the interleaved pp
     cell, attention the composed pp x tp cell, and moe the GShard top-2
-    cell since r4)."""
+    and expert-choice cells since r4)."""
     from pytorch_distributed_rnn_tpu.launcher import bench
     from pytorch_distributed_rnn_tpu.launcher.commands import (
         command_string,
@@ -443,7 +443,7 @@ def test_matrix_configs_cover_every_readme_cell():
     )
 
     cfgs = bench.matrix_configs()
-    assert len(cfgs) == 33
+    assert len(cfgs) == 34
     by_family = {}
     for c in cfgs:
         fam = c.parameters_dict()["model"]

@@ -153,6 +153,7 @@ class TestExpertChoice:
 
     def test_every_expert_exactly_at_capacity(self, setup):
         from pytorch_distributed_rnn_tpu.ops.moe import (
+            _route_expert_choice,
             moe_capacity,
             moe_ffn_expert_choice,
         )
@@ -160,9 +161,19 @@ class TestExpertChoice:
         params, x = setup
         out, aux = moe_ffn_expert_choice(params, x, capacity_factor=1.0)
         assert float(aux) == 0.0
-        # the balance property is structural: capacity C per expert,
-        # always filled (tokens can repeat across experts, not within)
-        assert moe_capacity(N, E, 1.0) == N // E
+        # the balance property, verified on the actual selection tensor:
+        # every expert fills exactly C slots, each a valid one-hot over
+        # DISTINCT tokens (no duplicate within an expert)
+        C = moe_capacity(N, E, 1.0)
+        sel, _ = _route_expert_choice(params, x, C)
+        sel = np.asarray(sel)
+        assert sel.shape == (E, C, N)
+        np.testing.assert_array_equal(sel.sum(axis=2),
+                                      np.ones((E, C)))  # one token/slot
+        per_expert_tokens = sel.sum(axis=(1, 2))
+        np.testing.assert_array_equal(per_expert_tokens, np.full(E, C))
+        for e_i in range(E):
+            assert sel[e_i].sum(axis=0).max() == 1.0  # distinct tokens
 
     def test_matches_manual_computation(self, setup):
         from pytorch_distributed_rnn_tpu.ops.moe import (
