@@ -92,6 +92,17 @@ def build_parser() -> argparse.ArgumentParser:
         "each expert picks its top-C tokens - perfectly balanced by "
         "construction, no aux loss)",
     )
+    parser.add_argument(
+        "--moe-capacity-factor", default=2.0, type=float, metavar="F",
+        help="per-expert slot budget for --model moe: capacity = "
+        "ceil(tokens x selections x F / experts).  Applies to the "
+        "dispatched paths: the ep mesh strategy (token-choice drops "
+        "overflow past it, residual passes through) and expert-choice "
+        "routing on every strategy (each expert fills exactly this many "
+        "slots).  Token-choice on the non-mesh strategies runs the "
+        "dense-exact path, which computes every expert and drops "
+        "nothing - the flag has no effect there",
+    )
     parser.add_argument("--resume", default=None, type=Path)
     parser.add_argument(
         "--checkpoint-every", default=0, type=int, metavar="N",

@@ -95,6 +95,20 @@ class MoEClassifier:
                 "routing picks per-expert capacities instead - drop "
                 "--moe-top-k or use --moe-router token"
             )
+        import math
+
+        # `not (x > 0)` also catches NaN (every comparison is False);
+        # isfinite rejects inf - both would otherwise crash deep in
+        # moe_capacity's int() without the flag name
+        if not (self.capacity_factor > 0
+                and math.isfinite(self.capacity_factor)):
+            # capacity 0 would silently drop EVERY token (the residual
+            # passes all inputs through unchanged - no error, no learning
+            # signal from the experts)
+            raise ValueError(
+                f"--moe-capacity-factor must be a positive finite "
+                f"number, got {self.capacity_factor}"
+            )
 
     @property
     def _expert_hidden(self) -> int:

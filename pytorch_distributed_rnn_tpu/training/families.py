@@ -122,6 +122,7 @@ def build_model(args, training_set):
             num_experts=getattr(args, "num_experts", 4),
             num_selected=getattr(args, "moe_top_k", 1),
             router_type=getattr(args, "moe_router", "token"),
+            capacity_factor=getattr(args, "moe_capacity_factor", 2.0),
             cell=getattr(args, "cell", "lstm"),
             precision=getattr(args, "precision", "f32"),
             remat=getattr(args, "remat", False),

@@ -256,6 +256,27 @@ class TestExpertChoice:
             MoEClassifier(router_type="topk")
         with pytest.raises(ValueError, match="token-choice knob"):
             MoEClassifier(router_type="expert", num_selected=2)
+        with pytest.raises(ValueError, match="capacity-factor"):
+            MoEClassifier(capacity_factor=0.0)
+
+    def test_cli_flags_reach_the_model(self):
+        import argparse
+
+        from pytorch_distributed_rnn_tpu.training import families
+
+        args = argparse.Namespace(
+            model="moe", hidden_units=8, stacked_layer=1, dropout=0,
+            num_experts=2, moe_top_k=1, moe_router="expert",
+            moe_capacity_factor=1.5, cell="lstm", precision="f32",
+            remat=False,
+        )
+
+        class _DS:
+            num_features = 5
+
+        model = families.build_model(args, _DS())
+        assert model.router_type == "expert"
+        assert model.capacity_factor == 1.5
 
 
 def test_moe_training_balances_and_learns(setup):
