@@ -640,25 +640,41 @@ def _pp_interleaved_engine(axis: str, *, num_microbatches: int,
         m_f_safe = jnp.clip(m_f, 0, M - 1)
         m_b_safe = jnp.clip(m_b, 0, M - 1)
 
-        # ---- backward op: read the stash BEFORE the forward writes it
-        stash_in = lax.dynamic_index_in_dim(
-            lax.dynamic_index_in_dim(stash, c_b, keepdims=False),
-            m_b_safe % K, keepdims=False)
-        (_, _), vjp_fn = jax.vjp(
-            lambda dp, a: full(dp, a, m_b_safe, c_b), diff_params,
-            stash_in,
-        )
-        b_mask = b_active.astype(jnp.float32)
+        # ---- backward op: read the stash BEFORE the forward writes it.
+        # The whole op sits under lax.cond so a tick with no scheduled
+        # backward skips the vjp's recompute-forward + backward entirely
+        # (~2/3 of a busy tick's compute; warmup/drain ticks are the
+        # bubble).  Per-device divergent conds are legal here because
+        # the branches hold NO collectives - stage_apply / stage0_input /
+        # last_loss are device-local, and the ppermute hops stay outside.
         is_last_b = (idx == n - 1) & (c_b == V - 1)
-        buf_b = lax.dynamic_index_in_dim(bwd_buf, c_b, keepdims=False)
-        cot_acts = (jnp.where(is_last_b, 0.0, 1.0) * b_mask
-                    * buf_b[..., :hidden])
-        cot_loss = jnp.where(is_last_b, 1.0, 0.0) * b_mask
-        d_params, d_acts = vjp_fn((cot_acts.astype(dtype), cot_loss))
-        grads = jax.tree.map(
-            lambda g, d: g + b_mask * d.astype(jnp.float32),
-            grads, d_params,
-        )
+
+        def do_bwd():
+            stash_in = lax.dynamic_index_in_dim(
+                lax.dynamic_index_in_dim(stash, c_b, keepdims=False),
+                m_b_safe % K, keepdims=False)
+            buf_b = lax.dynamic_index_in_dim(bwd_buf, c_b,
+                                             keepdims=False)
+            (_, _), vjp_fn = jax.vjp(
+                lambda dp, a: full(dp, a, m_b_safe, c_b), diff_params,
+                stash_in,
+            )
+            cot_acts = (jnp.where(is_last_b, 0.0, 1.0)
+                        * buf_b[..., :hidden])
+            cot_loss = jnp.where(is_last_b, 1.0, 0.0)
+            d_params, d_acts = vjp_fn((cot_acts.astype(dtype), cot_loss))
+            return (
+                jax.tree.map(lambda d: d.astype(jnp.float32), d_params),
+                d_acts,
+            )
+
+        def skip_bwd():
+            # statically-known shape: no stash/buffer gather on idle ticks
+            return (zeros_f32(diff_params),
+                    jnp.zeros((bm, t_len, width), dtype))
+
+        d_params, d_acts = lax.cond(b_active, do_bwd, skip_bwd)
+        grads = jax.tree.map(jnp.add, grads, d_params)
 
         # ---- forward op
         is_first_f = (idx == 0) & (c_f == 0)
