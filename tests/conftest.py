@@ -5,6 +5,8 @@ docker-compose master/slave pair (``/root/reference/docker-compose.yaml:3-27``)
 - multi-device on one machine stands in for multi-chip/multi-host.
 """
 
+import contextlib
+import logging
 import os
 
 # Must be set before jax initializes its backends.  Force CPU even when the
@@ -97,3 +99,19 @@ def pytest_collection_modifyitems(config, items):
             f"QUICK_NODEIDS entries match no collected test (renamed?): "
             f"{missing}"
         )
+
+
+@contextlib.contextmanager
+def force_log_level(level):
+    """Temporarily pin the root logger level - the trainer's fused/
+    per-epoch path selection is gated on logger verbosity (INFO keeps
+    the per-epoch path, DEBUG forces per-batch progress), so tests
+    choreograph levels explicitly instead of inheriting whatever an
+    earlier test left behind."""
+    root = logging.getLogger()
+    saved = root.level
+    root.setLevel(level)
+    try:
+        yield
+    finally:
+        root.setLevel(saved)

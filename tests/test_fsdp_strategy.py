@@ -142,3 +142,36 @@ class TestFsdpCLI:
         # the restored state is back in the ZeRO layout, not replicated
         assert fresh.per_device_state_bytes() == per_dev
         fresh.train(epochs=1)  # and trains
+
+
+def test_fuse_run_composes_with_zero_sharded_state(datasets):
+    """--fuse-run on the fsdp strategy: the whole multi-epoch run
+    compiles into one program over the ZeRO layout and matches the
+    per-epoch fsdp path exactly."""
+    import logging
+
+    from conftest import force_log_level
+
+    mesh = make_mesh({"dp": 4})
+    kwargs = dict(batch_size=48, learning_rate=2.5e-3, seed=SEED,
+                  mesh=mesh)
+
+    forced = ZeroTrainer(model=big_model(), training_set=datasets,
+                         fuse_run=True, **kwargs)
+    with force_log_level(logging.INFO):  # fuse_run overrides INFO gate
+        _, forced_hist, _ = forced.train(epochs=2)
+    assert forced._run_fn is not None  # one-program path actually taken
+
+    stepwise = ZeroTrainer(model=big_model(), training_set=datasets,
+                           **kwargs)
+    with force_log_level(logging.INFO):
+        _, step_hist, _ = stepwise.train(epochs=2)
+    assert stepwise._run_fn is None
+
+    np.testing.assert_allclose(forced_hist, step_hist, atol=1e-5,
+                               rtol=1e-5)
+    for a, b in zip(
+        jax.tree.leaves(forced.params), jax.tree.leaves(stepwise.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
