@@ -199,7 +199,7 @@ def _route_expert_choice(params, xt, capacity: int):
     return sel, sel * vals[..., None].astype(xt.dtype)
 
 
-def moe_ffn_expert_choice(params, x, *, capacity_factor: float = 1.0):
+def moe_ffn_expert_choice(params, x, *, capacity_factor: float = 2.0):
     """Expert-choice MoE FFN (Zhou et al. 2022): EXPERTS pick tokens.
 
     Token-choice (Switch/GShard above) lets each token pick its experts

@@ -752,6 +752,11 @@ def _check_1f1b_shapes(layers, axis, num_microbatches, batch, cell,
                        num_chunks: int = 1):
     n = lax.axis_size(axis)
     L = len(layers)
+    if num_chunks < 1:
+        raise ValueError(
+            f"num_chunks must be >= 1, got {num_chunks} (1 = plain 1F1B, "
+            ">1 = interleaved virtual stages)"
+        )
     if L % (n * num_chunks) != 0:
         raise ValueError(
             f"{L} layers do not split into {n} devices x {num_chunks} "

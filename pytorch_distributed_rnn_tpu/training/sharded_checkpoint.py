@@ -29,6 +29,7 @@ gathered format: ``best-model`` / ``checkpoint-epoch-N``.
 from __future__ import annotations
 
 import json
+import os
 from pathlib import Path
 
 import jax
@@ -44,6 +45,14 @@ def _checkpointer(async_: bool):
 
 def checkpoint_name(epoch: int, best: bool) -> str:
     return "best-model" if best else f"checkpoint-epoch-{epoch + 1}"
+
+
+def _meta_path(orbax_path: Path) -> Path:
+    """The meta sidecar for a ``<name>.orbax`` dir - one formula shared
+    by save, wait and restore so the three can never target different
+    files."""
+    return orbax_path.parent / (
+        orbax_path.name[:-len(".orbax")] + ".meta.json")
 
 
 class ShardedCheckpointHandle:
@@ -70,10 +79,14 @@ class ShardedCheckpointHandle:
         # background-write (or an in-flight best-model overwrite) leave
         # meta describing state the .orbax dir does not hold
         if jax.process_index() == 0:
-            meta_path = self.path.parent / (
-                self.path.name[:-len(".orbax")] + ".meta.json")
-            with open(meta_path, "w") as f:
+            # temp-file + rename: a crash mid-write must leave either no
+            # sidecar or a complete one, never a truncated JSON that
+            # blocks restore of the (durable) .orbax next to it
+            meta_path = _meta_path(self.path)
+            tmp = meta_path.with_suffix(".json.tmp")
+            with open(tmp, "w") as f:
                 json.dump(self._meta, f)
+            os.replace(tmp, meta_path)
 
     @property
     def in_flight(self) -> bool:
@@ -103,6 +116,15 @@ def save_sharded(checkpoint_dir, epoch: int, params, opt_state,
                                     "opt_state": opt_state}),
         force=True,  # overwrite: best-model is rewritten on every new best
     )
+    # an overwriting save removes the previous .orbax dir at submit time
+    # (synchronously, inside save) while the NEW write may still be in a
+    # background thread: the old meta sidecar must not outlive the
+    # checkpoint it describes, or a crash mid-background-write leaves
+    # meta lying about a missing .orbax.  Unlinked only after save()
+    # returns, so a submit-time failure leaves the old checkpoint AND
+    # its meta fully intact.
+    if jax.process_index() == 0:
+        _meta_path(path).unlink(missing_ok=True)
     handle = ShardedCheckpointHandle(
         checkpointer, path, {"epoch": epoch + 1, "loss": float(loss)})
     if not async_:
@@ -148,10 +170,15 @@ def restore_sharded(path, params_template, opt_state_template):
         restored = checkpointer.restore(
             path, args=ocp.args.StandardRestore(abstract))
 
-    meta_path = path.parent / (path.name[:-len(".orbax")] + ".meta.json")
+    meta_path = _meta_path(path)
+    meta = {"epoch": 0, "loss": float("inf")}
     if meta_path.exists():
-        with open(meta_path) as f:
-            meta = json.load(f)
-    else:  # meta is auxiliary; a missing sibling must not block restore
-        meta = {"epoch": 0, "loss": float("inf")}
+        try:
+            with open(meta_path) as f:
+                meta = json.load(f)
+        except json.JSONDecodeError:
+            # meta is auxiliary; a corrupt sidecar (e.g. a pre-atomic-
+            # write truncation) must not block restore any more than a
+            # missing one does
+            pass
     return restored["params"], restored["opt_state"], meta

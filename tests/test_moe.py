@@ -259,6 +259,24 @@ class TestExpertChoice:
         with pytest.raises(ValueError, match="capacity-factor"):
             MoEClassifier(capacity_factor=0.0)
 
+    def test_function_defaults_match_model_default(self):
+        """A direct ops-level caller relying on a function default must
+        get the same slot budget the model/CLI documents (2.0) - the
+        three routers' defaults may not drift apart."""
+        import inspect
+
+        from pytorch_distributed_rnn_tpu.models import MoEClassifier
+        from pytorch_distributed_rnn_tpu.ops.moe import (
+            moe_ffn,
+            moe_ffn_expert_choice,
+        )
+
+        model_default = MoEClassifier.__dataclass_fields__[
+            "capacity_factor"].default
+        for fn in (moe_ffn, moe_ffn_expert_choice):
+            assert (inspect.signature(fn).parameters["capacity_factor"]
+                    .default == model_default), fn.__name__
+
     def test_cli_flags_reach_the_model(self):
         import argparse
 

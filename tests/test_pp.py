@@ -662,6 +662,33 @@ class TestInterleaved1F1B:
             MeshTrainer(mesh_axes={"dp": 1, "pp": 2},
                         pp_schedule="interleaved", pp_chunks=2, **common)
 
+    def test_library_surface_rejects_num_chunks_below_one(self):
+        """A direct API call (bypassing the MeshTrainer CLI validation)
+        with num_chunks=0 must fail with a named-flag ValueError, not a
+        ZeroDivisionError from ``L % (n * 0)``."""
+        from pytorch_distributed_rnn_tpu.parallel.pp import (
+            pp_rnn_1f1b_value_and_grad,
+        )
+
+        model = MotionModel(input_dim=IN, hidden_dim=H, layer_dim=2,
+                            output_dim=6, impl="scan")
+        params = model.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (B, T, IN))
+        y = jax.random.randint(jax.random.PRNGKey(2), (B,), 0, 6)
+        mesh = make_mesh({"pp": 2})
+
+        @partial(shard_map, mesh=mesh, in_specs=(P(), P(), P()),
+                 out_specs=(P(), P()), check_vma=False)
+        def run(p, x, y):
+            ls, _, ws, g = pp_rnn_1f1b_value_and_grad(
+                p["rnn"], p["fc"], x, y, "pp", num_microbatches=4,
+                num_chunks=0,
+            )
+            return ls / ws, g
+
+        with pytest.raises(ValueError, match="num_chunks"):
+            jax.jit(run)(params, x, y)
+
 
 class TestPpTpComposition:
     """Attention dp x pp x tp: Megatron head/MLP sharding INSIDE each

@@ -187,6 +187,42 @@ class TestRejects:
         handle.wait()
         assert sidecar.exists()
 
+    def test_overwriting_save_drops_stale_meta_first(self, tmp_path):
+        """best-model overwrite (force=True removes the old .orbax before
+        the new write is durable): the OLD meta sidecar must be dropped
+        at submit time, so a crash mid-background-write cannot leave a
+        sidecar describing a checkpoint that no longer exists."""
+        import json
+
+        import jax.numpy as jnp
+
+        params = {"w": jnp.arange(8.0)}
+        opt = {"count": jnp.zeros((), jnp.int32)}
+        save_sharded(tmp_path, 0, params, opt, 5.0, best=True).wait()
+        sidecar = tmp_path / "best-model.meta.json"
+        assert json.loads(sidecar.read_text())["loss"] == 5.0
+
+        handle = save_sharded(tmp_path, 3, params, opt, 1.0, best=True,
+                              async_=True)
+        assert not sidecar.exists()  # stale sidecar gone while in flight
+        handle.wait()
+        assert json.loads(sidecar.read_text()) == {"epoch": 4, "loss": 1.0}
+
+    def test_truncated_meta_does_not_block_restore(self, tmp_path):
+        """A sidecar truncated by a crash mid-write (pre-atomic-rename
+        artifact) degrades to the no-meta defaults instead of aborting
+        the restore of the durable .orbax next to it."""
+        import jax.numpy as jnp
+
+        params = {"w": jnp.arange(8.0)}
+        opt = {"count": jnp.zeros((), jnp.int32)}
+        save_sharded(tmp_path, 0, params, opt, 5.0, best=True).wait()
+        (tmp_path / "best-model.meta.json").write_text('{"epoch": 1,')
+        rp, _, meta = restore_sharded(tmp_path / "best-model.orbax",
+                                      params, opt)
+        assert meta == {"epoch": 0, "loss": float("inf")}
+        assert float(rp["w"][7]) == 7.0
+
 
 class TestCliSurface:
     def test_fsdp_sharded_checkpoint_and_resume(self, tmp_path,
