@@ -54,6 +54,71 @@ SEED = 123456789
 V5E_BF16_PEAK_FLOPS = 197e12
 
 
+def last_real_chip_evidence(repo: Path = Path(__file__).resolve().parent):
+    """The most recent banked real-chip bench line, for embedding in the
+    emitted JSON whenever the capture-time backend is NOT the TPU.
+
+    The tunnel to the one real chip is flaky; BENCH_r03 and BENCH_r04
+    were both captured during outages and carried only the CPU fallback,
+    silently under-reporting chip numbers that were already committed in
+    mid-round ``results_bench_chip_*.json`` files.  This makes the emit
+    outage-proof for *evidence*, not just for rc: the freshest banked
+    chip line (picked by round number in the filename, then mtime) rides
+    along with its provenance (source file, the commit that banked it,
+    that commit's date)."""
+    import re
+    import subprocess
+
+    best = None
+    for path in repo.glob("results_bench_chip*.json"):
+        try:
+            with open(path) as f:
+                row = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if not isinstance(row, dict) or row.get("backend") != "tpu":
+            continue
+        m = re.search(r"_r(\d+)", path.name)
+        rank = (int(m.group(1)) if m else -1, path.stat().st_mtime)
+        if best is None or rank > best[0]:
+            best = (rank, path, row)
+    if best is None:
+        return None
+    _, path, row = best
+    evidence = {
+        "source_file": path.name,
+        "headline_seq_per_sec": row.get("value"),
+        "vs_baseline": row.get("vs_baseline"),
+    }
+    extras = row.get("extra_metrics") or {}
+    highlights = {}
+    for key in ("char_rnn_50m_bf16", "char_rnn_55m_wide_bf16",
+                "char_rnn_50m_bf16_b512_accum2", "moe_switch_bf16",
+                "attention_seq1024_dim512_flash_bf16",
+                "attention_seq1024_dim512_dense_bf16"):
+        val = extras.get(key)
+        if isinstance(val, dict):
+            highlights[key] = {
+                k: val[k]
+                for k in ("tokens_per_sec", "seq_per_sec",
+                          "mfu_vs_v5e_bf16_peak")
+                if k in val
+            }
+    if highlights:
+        evidence["highlights"] = highlights
+    try:
+        out = subprocess.run(
+            ["git", "log", "-1", "--format=%h %cI", "--", path.name],
+            cwd=repo, capture_output=True, text=True, timeout=10)
+        if out.returncode == 0 and out.stdout.strip():
+            rev, _, date = out.stdout.strip().partition(" ")
+            evidence["git_rev"] = rev
+            evidence["captured_at"] = date
+    except Exception:  # noqa: BLE001 - provenance is best-effort
+        pass
+    return evidence
+
+
 def motion_throughput(impl: str, cell: str = "lstm",
                       batch: int = BATCH_SIZE) -> float:
     """seq/s for the reference workload with the given RNN impl/cell."""
@@ -169,6 +234,134 @@ def char50m_tokens_per_sec(precision: str, batch: int = 32,
     return tokens_per_sec, mfu
 
 
+def moe_flops_per_step(router: str, tokens: int, dim: int, hidden: int,
+                       experts: int, capacity: int) -> float:
+    """Training FLOPs per step of one MoE FFN layer, counting what the
+    MXU actually executes: router (2*N*D*E), the one-hot dispatch AND
+    combine einsums (2*N*E*C*D each - the real cost of the dense
+    TPU-friendly dispatch formulation; C ~ N*cf/E makes them scale with
+    N^2, which is why dispatched MoE routes GROUPS of a few thousand
+    tokens), and the expert FFN over all E*C capacity slots (padded
+    slots compute zeros but still occupy the MXU).  ``router="dense"``
+    has no dispatch: every expert runs every token (N*E slots).
+    Backward ~2x forward (the standard 3x estimate)."""
+    if router == "dense":
+        slots = tokens * experts
+        dispatch = 0.0
+    else:
+        slots = experts * capacity
+        dispatch = 2 * (2.0 * tokens * experts * capacity * dim)
+    fwd = (
+        2.0 * tokens * dim * experts      # router
+        + dispatch
+        + slots * 4.0 * dim * hidden      # expert fc1 + fc2
+    )
+    return 3.0 * fwd
+
+
+def moe_ffn_throughput(router: str, *, tokens: int = 8192, dim: int = 512,
+                       hidden: int = 2048, experts: int = 8,
+                       capacity_factor: float = 2.0, steps: int = 10,
+                       precision: str = "bf16"):
+    """Train-step throughput of ONE MoE FFN layer on the dispatched
+    path: ``router`` in {"switch", "top2", "expert", "dense"} (dense =
+    the exact O(E) A/B reference, ``ops/moe.py::moe_ffn_dense``).
+
+    Returns a row dict: tokens/s, MFU vs the v5e bf16 peak (FLOPs model
+    in :func:`moe_flops_per_step` - executed compute, dispatch einsums
+    included), the REALIZED drop fraction (token-choice: routed
+    assignments that found no capacity slot, counted via the dispatch's
+    own slotting formula; expert-choice: tokens no expert picked - both
+    measured from the actual routing, not the capacity formula), and
+    the config."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_rnn_tpu.ops.moe import (
+        _route_expert_choice,
+        _route_topk,
+        _slot_positions,
+        cast_expert_params,
+        init_moe_ffn,
+        moe_capacity,
+        moe_ffn,
+        moe_ffn_dense,
+        moe_ffn_expert_choice,
+    )
+    from pytorch_distributed_rnn_tpu.ops.rnn import dtype_of
+
+    params = init_moe_ffn(jax.random.PRNGKey(0), dim, experts, hidden)
+    compute_dtype = dtype_of(precision) or jnp.float32
+    x = jax.random.normal(jax.random.PRNGKey(1), (tokens, dim),
+                          jnp.float32)
+
+    num_selected = {"switch": 1, "top2": 2, "expert": 1, "dense": 1}[router]
+    if router == "expert":
+        capacity = moe_capacity(tokens, experts, capacity_factor)
+
+        def ffn(p, xt):
+            return moe_ffn_expert_choice(
+                p, xt, capacity_factor=capacity_factor)
+    elif router == "dense":
+        capacity = 0
+
+        def ffn(p, xt):
+            return moe_ffn_dense(p, xt, num_selected=num_selected)
+    else:
+        capacity = moe_capacity(tokens, experts, capacity_factor,
+                                num_selected)
+
+        def ffn(p, xt):
+            return moe_ffn(p, xt, capacity_factor=capacity_factor,
+                           num_selected=num_selected)
+
+    def loss(p, xx):
+        out, aux = ffn(cast_expert_params(p, compute_dtype),
+                       xx.astype(compute_dtype))
+        return jnp.mean(out.astype(jnp.float32) ** 2) + 0.01 * aux
+
+    step = jax.jit(jax.value_and_grad(loss))
+    l, _ = step(params, x)  # compile
+    float(l)
+    start = time.perf_counter()
+    for _ in range(steps):
+        l, grads = step(params, x)
+    float(l)  # host fetch closes the timed region (see char50m note)
+    dt = (time.perf_counter() - start) / steps
+    flops = moe_flops_per_step(router, tokens, dim, hidden, experts,
+                               capacity)
+
+    # realized drop fraction: route in the SAME compute dtype the timed
+    # step used (bf16 near-ties can pick different experts than f32),
+    # under jit, returning only a scalar - never the (N, E, C) dispatch
+    # tensor (gigabytes at the TPU-sized config)
+    @jax.jit
+    def measure_drop(p, xx):
+        pc = cast_expert_params(p, compute_dtype)
+        xt = xx.astype(compute_dtype)
+        if router == "expert":
+            sel, _ = _route_expert_choice(pc, xt, capacity)
+            covered = jnp.sum(sel, axis=(0, 1)) > 0  # (N,) any slot
+            return 1.0 - jnp.mean(covered.astype(jnp.float32))
+        experts_k, _, _ = _route_topk(pc, xt, num_selected)
+        # choice-major flattening + the shared slotting formula = the
+        # exact pos make_dispatch_topk assigns, so `pos < capacity`
+        # counts precisely the assignments the real dispatch keeps
+        pos = _slot_positions(experts_k.T.reshape(-1), experts)
+        kept = jnp.sum((pos < capacity).astype(jnp.float32))
+        return 1.0 - kept / (tokens * num_selected)
+
+    drop_frac = 0.0 if router == "dense" else float(measure_drop(params, x))
+
+    return {
+        "tokens_per_sec": round(tokens / dt, 0),
+        "mfu_vs_v5e_bf16_peak": round(flops / dt / V5E_BF16_PEAK_FLOPS, 4),
+        "drop_frac": round(drop_frac, 4),
+        "tokens": tokens, "dim": dim, "hidden": hidden,
+        "experts": experts, "capacity_factor": capacity_factor,
+    }
+
+
 def attention_flops_per_seq(dim: int, depth: int, seq_len: int,
                             input_dim: int = NUM_FEATURES,
                             output_dim: int = 6,
@@ -254,11 +447,12 @@ def main():
     import argparse
 
     parser = argparse.ArgumentParser(prog="bench.py")
-    parser.add_argument("--suite", choices=["quick", "stress", "attention"],
+    parser.add_argument("--suite",
+                        choices=["quick", "stress", "attention", "moe"],
                         default="stress",
                         help="quick: headline only; stress: everything; "
-                        "attention: headline + the attention rows only "
-                        "(the fast path for scarce tunnel windows)")
+                        "attention / moe: headline + that family's rows "
+                        "only (fast paths for scarce tunnel windows)")
     parser.add_argument("--append-rows", default=None, metavar="PATH",
                         help="also append each extra row as one JSON line "
                         "to PATH the moment it completes - a killed run "
@@ -281,13 +475,19 @@ def main():
     extras: dict = {}
     rnn_rows = args.suite == "stress"
     attention_rows = args.suite in ("stress", "attention")
-    if rnn_rows or attention_rows:
+    moe_rows = args.suite in ("stress", "moe")
+    if rnn_rows or attention_rows or moe_rows:
         def attempt(name, fn):
             # suite filter lives HERE so the row lists below stay one
-            # flat sequence: attention rows are the "attention_"-prefixed
-            # ones, everything else belongs to the stress suite
-            if not (rnn_rows if not name.startswith("attention_")
-                    else attention_rows):
+            # flat sequence: rows are classed by name prefix (attention_
+            # / moe_); everything else belongs to the stress suite
+            if name.startswith("attention_"):
+                wanted = attention_rows
+            elif name.startswith("moe_"):
+                wanted = moe_rows
+            else:
+                wanted = rnn_rows
+            if not wanted:
                 return
             try:
                 extras[name] = fn()
@@ -383,6 +583,22 @@ def main():
             return curve
 
         attempt("motion_batch_curve_seq_per_sec", _batch_curve)
+
+        # the MoE family's throughput evidence: all three routers on the
+        # dispatched path + the dense-exact A/B.  Runs on every backend
+        # (the EP axis must not stay perf-unmeasured just because the
+        # tunnel is down) with CPU-sized shapes off-TPU; MFU is only
+        # meaningful against the v5e peak on the real chip.
+        moe_kw = (dict(tokens=8192, hidden=2048, steps=10) if on_tpu
+                  else dict(tokens=2048, hidden=512, steps=3))
+        attempt("moe_switch_bf16",
+                lambda: moe_ffn_throughput("switch", **moe_kw))
+        attempt("moe_top2_bf16",
+                lambda: moe_ffn_throughput("top2", **moe_kw))
+        attempt("moe_expert_choice_bf16",
+                lambda: moe_ffn_throughput("expert", **moe_kw))
+        attempt("moe_dense_ab_bf16",
+                lambda: moe_ffn_throughput("dense", **moe_kw))
 
         if on_tpu:
             attempt("char_rnn_50m_bf16", lambda: _lm("bf16"))
@@ -537,27 +753,31 @@ def main():
         elif rnn_rows:
             extras["char_rnn_50m"] = "skipped: no TPU"
             extras["attention"] = "skipped: no TPU"
-        else:
+        elif attention_rows:
             extras["attention"] = "skipped: no TPU"
 
-    print(
-        json.dumps(
-            {
-                "metric": "motion-LSTM train throughput (bs=1440, 1 chip)",
-                "value": round(headline, 1),
-                "unit": "seq/s",
-                "vs_baseline": round(headline / BASELINE_SEQ_PER_SEC, 3),
-                "data": "synthetic (random HAR-shaped arrays / random "
-                        "tokens; real UCI HAR absent in this image)",
-                "backend": jax.default_backend(),
-                "backend_note": (
-                    "ambient backend unavailable; fell back to cpu"
-                    if BACKEND_INFO["fallback"] else "ambient"
-                ),
-                "extra_metrics": extras,
-            }
-        )
-    )
+    payload = {
+        "metric": "motion-LSTM train throughput (bs=1440, 1 chip)",
+        "value": round(headline, 1),
+        "unit": "seq/s",
+        "vs_baseline": round(headline / BASELINE_SEQ_PER_SEC, 3),
+        "data": "synthetic (random HAR-shaped arrays / random "
+                "tokens; real UCI HAR absent in this image)",
+        "backend": jax.default_backend(),
+        "backend_note": (
+            "ambient backend unavailable; fell back to cpu"
+            if BACKEND_INFO["fallback"] else "ambient"
+        ),
+        "extra_metrics": extras,
+    }
+    if not on_tpu:
+        # the capture-time backend is a fallback: carry the freshest
+        # banked chip evidence so the driver artifact still tells the
+        # chip story whatever the tunnel does today
+        evidence = last_real_chip_evidence()
+        if evidence is not None:
+            payload["last_real_chip"] = evidence
+    print(json.dumps(payload))
 
 
 if __name__ == "__main__":

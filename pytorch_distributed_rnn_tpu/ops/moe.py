@@ -109,6 +109,16 @@ def _expert_ffn(params, tokens):
     )
 
 
+def _slot_positions(expert, num_experts: int):
+    """Capacity-slot position of each assignment: how many earlier
+    entries of ``expert`` chose the same expert.  The ONE slotting
+    formula - :func:`make_dispatch` builds its one-hots from it, and a
+    drop-fraction counter summing ``pos < capacity`` matches the real
+    dispatch exactly without materializing the (N, E, C) tensor."""
+    one_hot = jax.nn.one_hot(expert, num_experts, dtype=jnp.int32)
+    return jnp.sum((jnp.cumsum(one_hot, axis=0) - 1) * one_hot, axis=1)
+
+
 def make_dispatch(expert, prob, num_experts: int, capacity: int, dtype):
     """Build the (N, E, C) one-hot dispatch tensor and the prob-weighted
     combine tensor from top-1 assignments.
@@ -117,9 +127,7 @@ def make_dispatch(expert, prob, num_experts: int, capacity: int, dtype):
     same expert; tokens whose position >= capacity are dropped (combine
     weight 0).
     """
-    one_hot = jax.nn.one_hot(expert, num_experts, dtype=jnp.int32)
-    # slot = how many earlier tokens chose the same expert
-    pos = jnp.sum((jnp.cumsum(one_hot, axis=0) - 1) * one_hot, axis=1)
+    pos = _slot_positions(expert, num_experts)
     in_cap = pos < capacity
     dispatch = (
         jax.nn.one_hot(expert, num_experts, dtype=dtype)[:, :, None]

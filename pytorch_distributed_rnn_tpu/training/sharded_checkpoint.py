@@ -170,15 +170,14 @@ def restore_sharded(path, params_template, opt_state_template):
         restored = checkpointer.restore(
             path, args=ocp.args.StandardRestore(abstract))
 
-    meta_path = _meta_path(path)
     meta = {"epoch": 0, "loss": float("inf")}
-    if meta_path.exists():
-        try:
-            with open(meta_path) as f:
-                meta = json.load(f)
-        except json.JSONDecodeError:
-            # meta is auxiliary; a corrupt sidecar (e.g. a pre-atomic-
-            # write truncation) must not block restore any more than a
-            # missing one does
-            pass
+    try:
+        with open(_meta_path(path)) as f:
+            meta = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        # meta is auxiliary: a missing sidecar (never written, or just
+        # unlinked by a concurrent overwriting save), a corrupt one
+        # (pre-atomic-write truncation), or any other read failure must
+        # not block restore of the durable .orbax next to it
+        pass
     return restored["params"], restored["opt_state"], meta

@@ -39,3 +39,90 @@ def test_mfu_is_physical_for_published_numbers():
     flops = bench.lstm_lm_flops_per_token(char_rnn_50m())
     mfu = 306106 * flops / bench.V5E_BF16_PEAK_FLOPS
     assert 0.40 < mfu < 0.50, mfu
+
+
+def test_last_real_chip_evidence_picks_freshest_tpu_line(tmp_path):
+    """CPU-fallback emits must carry the freshest BANKED chip line
+    (newest round number wins; non-tpu lines never count), with the
+    headline + MFU highlights extracted."""
+    import json
+
+    old = {"metric": "m", "value": 60000.0, "vs_baseline": 31.0,
+           "backend": "tpu", "extra_metrics": {}}
+    new = {"metric": "m", "value": 66175.0, "vs_baseline": 34.27,
+           "backend": "tpu",
+           "extra_metrics": {
+               "char_rnn_55m_wide_bf16": {"tokens_per_sec": 345000.0,
+                                          "mfu_vs_v5e_bf16_peak": 0.513,
+                                          "batch": 256},
+               "attention_seq1024_dim512_flash_bf16": {
+                   "seq_per_sec": 100.0, "mfu_vs_v5e_bf16_peak": 0.2},
+           }}
+    cpu = {"metric": "m", "value": 814.0, "backend": "cpu",
+           "extra_metrics": {}}
+    (tmp_path / "results_bench_chip_r3.json").write_text(json.dumps(old))
+    (tmp_path / "results_bench_chip_r4.json").write_text(json.dumps(new))
+    (tmp_path / "results_bench_chip_r9_cpu.json").write_text(
+        json.dumps(cpu))
+
+    ev = bench.last_real_chip_evidence(tmp_path)
+    assert ev["source_file"] == "results_bench_chip_r4.json"
+    assert ev["headline_seq_per_sec"] == 66175.0
+    assert ev["vs_baseline"] == 34.27
+    assert (ev["highlights"]["char_rnn_55m_wide_bf16"]
+            ["mfu_vs_v5e_bf16_peak"] == 0.513)
+    # non-dict rows and absent keys never break extraction
+    assert "attention_seq1024_dim512_flash_bf16" in ev["highlights"]
+
+
+def test_last_real_chip_evidence_none_without_banked_lines(tmp_path):
+    assert bench.last_real_chip_evidence(tmp_path) is None
+
+
+def test_moe_flops_per_step_hand_count():
+    """Switch at N=8, E=2, C=8, D=4, H=16: router 2*8*4*2, two dispatch
+    einsums 2*(2*8*2*8*4), expert FFN 2*8*4*4*16; training = 3x."""
+    fwd = 2 * 8 * 4 * 2 + 2 * (2 * 8 * 2 * 8 * 4) + (2 * 8) * 4 * 4 * 16
+    assert bench.moe_flops_per_step("switch", 8, 4, 16, 2, 8) == 3.0 * fwd
+    # dense: no dispatch, N*E slots
+    fwd_d = 2 * 8 * 4 * 2 + (8 * 2) * 4 * 4 * 16
+    assert bench.moe_flops_per_step("dense", 8, 4, 16, 2, 0) == 3.0 * fwd_d
+
+
+def test_moe_ffn_throughput_rows_are_well_formed():
+    """All four routers produce a finite row with a drop fraction in
+    [0, 1]; ample capacity means token-choice drops exactly 0."""
+    for router in ("switch", "top2", "expert", "dense"):
+        row = bench.moe_ffn_throughput(
+            router, tokens=64, dim=16, hidden=32, experts=4,
+            capacity_factor=4.0, steps=2)
+        assert row["tokens_per_sec"] > 0, router
+        assert 0.0 <= row["drop_frac"] <= 1.0, router
+        if router in ("switch", "top2", "dense"):
+            assert row["drop_frac"] == 0.0, router
+
+
+def test_drop_counter_matches_real_dispatch():
+    """The pos-based drop counter must equal summing the real dispatch
+    tensor under capacity pressure (choice-major slotting included)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_rnn_tpu.ops.moe import (
+        _route_topk,
+        _slot_positions,
+        init_moe_ffn,
+        make_dispatch_topk,
+    )
+
+    params = init_moe_ffn(jax.random.PRNGKey(0), 8, 4, 16)
+    x = jax.random.normal(jax.random.PRNGKey(1), (32, 8))
+    for k in (1, 2):
+        experts_k, probs_k, _ = _route_topk(params, x, k)
+        capacity = 3  # tight: force drops
+        dispatch, _ = make_dispatch_topk(experts_k, probs_k, 4, capacity,
+                                         jnp.float32)
+        pos = _slot_positions(experts_k.T.reshape(-1), 4)
+        kept = int(jnp.sum(pos < capacity))
+        assert kept == int(jnp.sum(dispatch)), k
+        assert kept < 32 * k  # pressure actually dropped something
