@@ -473,10 +473,103 @@ class Trainer:
 
     # -- loop ----------------------------------------------------------------
 
+    # compile-stage failure signature, matched case-insensitively
+    # against the exception text: "compil" covers "compile"/
+    # "Compilation failure"/"remote_compile: HTTP 500: tpu_compile_
+    # helper ..." (the documented batch-512 deep-LM failure class) -
+    # every known producer mentions compilation.  Execution-stage
+    # failures are NOT retried: by then donate_argnums may have
+    # consumed the state buffers, so re-running the step is not safe
+    # (enforced directly by the liveness/progress guards below, not
+    # just by this string heuristic).
+    _COMPILE_FAILURE_MARKS = ("compil",)
+
+    @classmethod
+    def is_compile_failure(cls, exc) -> bool:
+        """Whether ``exc`` looks like a compile-stage failure - the ONE
+        classifier, shared with bench-side ladders so the two can never
+        disagree on what the grad-accum fallback rescues."""
+        msg = str(exc).lower()
+        return any(m in msg for m in cls._COMPILE_FAILURE_MARKS)
+
+    def _grad_accum_fallback(self, exc) -> int | None:
+        """The grad_accum to retry with after a compile-stage failure,
+        or ``None`` when retrying cannot help (not a compile failure,
+        the trainer cannot accumulate, or no further split divides the
+        batch).  Returns the smallest divisor of ``batch_size`` above
+        the current grad_accum (<= 16): each retry shrinks the
+        microbatch program until it compiles like the shapes that work,
+        instead of recording a skip and moving on."""
+        if not self.is_compile_failure(exc):
+            return None
+        if not self.SUPPORTS_GRAD_ACCUM:
+            return None
+        # the marks are a string heuristic; the donation invariant is
+        # checked directly: an EXECUTION-stage failure whose message
+        # merely mentions compilation has already consumed the donated
+        # state buffers, and retrying on deleted arrays would mask the
+        # real error behind a secondary "Array has been deleted"
+        for leaf in jax.tree.leaves((self.params, self.opt_state)):
+            if getattr(leaf, "is_deleted", lambda: False)():
+                return None
+        for k in range(self.grad_accum + 1, 17):
+            if self.batch_size % k == 0:
+                return k
+        return None
+
     def train(self, epochs: int):
         training_history: list[float] = []
         validation_history: list[float] = []
         formatter = self._get_formatter(epochs)
+        while True:
+            # identity snapshot: every completed device program
+            # reassigns self.params, so `is` detects ANY training
+            # progress - including a whole-epoch program that landed
+            # before a later program's compile failed mid-epoch (the
+            # histories alone would miss it and a retry would re-train
+            # epoch 0 on top of the applied updates)
+            params_before = self.params
+            try:
+                memory, duration = self._train_attempt(
+                    epochs, formatter, training_history,
+                    validation_history)
+                break
+            except Exception as exc:  # noqa: BLE001 - gated right below
+                k = self._grad_accum_fallback(exc)
+                if (k is None or training_history or validation_history
+                        or self.params is not params_before):
+                    raise
+                # loud by design (VERDICT r4): the alternative was a
+                # silent skip in every sweep that hit the failing
+                # program class
+                logging.warning(
+                    "train step failed to compile at batch %d (%s: "
+                    "%.160s); retrying with grad_accum=%d (microbatches "
+                    "of %d)", self.batch_size, type(exc).__name__, exc,
+                    k, self.batch_size // k)
+                if self._fuse_run:
+                    logging.warning(
+                        "--fuse-run abandoned for the retry: grad "
+                        "accumulation needs the per-epoch path")
+                    self._fuse_run = False
+                self.grad_accum = k
+                self._train_step_fn = None
+                self._idx_step_fn = None
+                self._epoch_fn = None
+                self._run_fn = None
+
+        logging.info(formatter.performance_message(memory, duration))
+
+        if self.test_set is not None:
+            self._evaluate(self.test_set, formatter)
+
+        return self.params, training_history, validation_history
+
+    def _train_attempt(self, epochs, formatter, training_history,
+                       validation_history):
+        """One full training attempt; returns ``(memory, duration)``.
+        Split out of :meth:`train` so a compile-stage failure can fall
+        back to grad accumulation and re-enter with rebuilt programs."""
         if self.DEVICE_DATA:
             if self._idx_step_fn is None:
                 self._idx_step_fn = self._build_idx_train_step()
@@ -563,12 +656,7 @@ class Trainer:
                 self._drain_checkpoint()
 
         _, memory, duration = measure_memory_and_time(train_inner)
-        logging.info(formatter.performance_message(memory, duration))
-
-        if self.test_set is not None:
-            self._evaluate(self.test_set, formatter)
-
-        return self.params, training_history, validation_history
+        return memory, duration
 
     def _train_run_fused(self, epochs: int):
         """Run ``epochs`` epochs as one device program; returns the

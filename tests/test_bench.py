@@ -126,3 +126,43 @@ def test_drop_counter_matches_real_dispatch():
         kept = int(jnp.sum(pos < capacity))
         assert kept == int(jnp.sum(dispatch)), k
         assert kept < 32 * k  # pressure actually dropped something
+
+
+def test_lm_ladder_auto_accum_rescues_compile_failures(monkeypatch):
+    """A compile-class failure at a batch retries the SAME batch with
+    grad accumulation before stepping down; unrelated failures step
+    down immediately."""
+    calls = []
+
+    def fake_lm(precision, batch=32, steps=50, seq=129, shape="deep",
+                unroll=1, accum=1):
+        calls.append((batch, accum))
+        if batch == 512 and accum == 1:
+            raise RuntimeError(
+                "INTERNAL: remote_compile: HTTP 500: tpu_compile_helper")
+        return 1000.0 * batch * accum, 0.4
+
+    monkeypatch.setattr(bench, "char50m_tokens_per_sec", fake_lm)
+    row = bench.lm_best_row("bf16")
+    # batch 512 failed at accum=1, was rescued at accum=2 - never
+    # stepped down to 256, and the failure stayed visible
+    assert row["batch"] == 512 and row["accum"] == 2
+    assert calls == [(512, 1), (512, 2)]
+    assert "512" in row["skipped_batches"]
+
+
+def test_lm_ladder_steps_down_on_non_compile_failures(monkeypatch):
+    calls = []
+
+    def fake_lm(precision, batch=32, steps=50, seq=129, shape="deep",
+                unroll=1, accum=1):
+        calls.append((batch, accum))
+        if batch == 512:
+            raise RuntimeError("some unrelated failure")
+        return 1000.0 * batch, 0.4
+
+    monkeypatch.setattr(bench, "char50m_tokens_per_sec", fake_lm)
+    row = bench.lm_best_row("bf16")
+    # no accum retry burned on a non-compile error: straight to 256
+    assert calls == [(512, 1), (256, 1)]
+    assert row["batch"] == 256 and "accum" not in row

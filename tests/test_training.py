@@ -430,3 +430,104 @@ class TestGradAccumulation:
             "local",
         ])
         assert (tmp_path / "history.json").exists()
+
+
+class TestAutoGradAccumFallback:
+    """A compile-stage failure of the monolithic program retries with
+    grad accumulation instead of dying (the remote-compile-helper
+    batch-512 failure class) - loudly, and only for compile failures."""
+
+    def _trainer(self, datasets, **kw):
+        train, _, _ = datasets
+        return Trainer(small_model(), train, batch_size=48,
+                       learning_rate=2.5e-3, seed=SEED, **kw)
+
+    def test_compile_failure_retries_with_grad_accum(self, datasets,
+                                                     caplog,
+                                                     monkeypatch):
+        trainer = self._trainer(datasets)
+        real_build = Trainer._build_idx_train_step
+
+        def failing_build(self):
+            if self.grad_accum == 1:
+                raise RuntimeError(
+                    "INTERNAL: http://127.0.0.1:8083/remote_compile: "
+                    "HTTP 500: tpu_compile_helper subprocess exit code 1")
+            return real_build(self)
+
+        monkeypatch.setattr(Trainer, "_build_idx_train_step",
+                            failing_build)
+        with caplog.at_level(logging.WARNING):
+            _, history, _ = trainer.train(epochs=2)
+        assert trainer.grad_accum == 2
+        assert len(history) == 2 and history[-1] < history[0]
+        warns = [r.message for r in caplog.records
+                 if "retrying with grad_accum=2" in r.message]
+        assert len(warns) == 1
+
+    def test_fallback_numerics_match_explicit_grad_accum(self, datasets,
+                                                         monkeypatch):
+        """The fallen-back run IS the --grad-accum run: same final
+        params as a trainer constructed with grad_accum=2."""
+        auto = self._trainer(datasets)
+        real_build = Trainer._build_idx_train_step
+
+        def failing_build(self):
+            if self.grad_accum == 1:
+                raise RuntimeError("XLA compilation failure")
+            return real_build(self)
+
+        monkeypatch.setattr(Trainer, "_build_idx_train_step",
+                            failing_build)
+        p_auto, _, _ = auto.train(epochs=1)
+        monkeypatch.undo()
+        explicit = self._trainer(datasets, grad_accum=2)
+        p_exp, _, _ = explicit.train(epochs=1)
+        for a, b in zip(jax.tree.leaves(p_auto), jax.tree.leaves(p_exp)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_non_compile_failure_reraises(self, datasets, monkeypatch):
+        trainer = self._trainer(datasets)
+
+        def failing_build(self):
+            raise ValueError("boom - some unrelated failure")
+
+        monkeypatch.setattr(Trainer, "_build_idx_train_step",
+                            failing_build)
+        with pytest.raises(ValueError, match="boom"):
+            trainer.train(epochs=1)
+        assert trainer.grad_accum == 1
+
+    def test_fallback_picks_next_batch_divisor(self, datasets):
+        trainer = self._trainer(datasets)  # batch 48
+        exc = RuntimeError("remote_compile: HTTP 500")
+        assert trainer._grad_accum_fallback(exc) == 2
+        trainer.grad_accum = 2
+        assert trainer._grad_accum_fallback(exc) == 3
+        trainer.grad_accum = 16
+        assert trainer._grad_accum_fallback(exc) is None  # cap reached
+        trainer.grad_accum = 1
+        assert trainer._grad_accum_fallback(ValueError("boom")) is None
+
+    def test_no_retry_after_any_training_progress(self, datasets,
+                                                  monkeypatch):
+        """A compile-marked failure AFTER state already advanced (e.g.
+        the whole-epoch program landed, then a later program's compile
+        died) must re-raise: retrying would re-train epoch 0 on top of
+        the applied updates."""
+        trainer = self._trainer(datasets)
+
+        def progressing_then_failing(self, epochs):
+            self.params = {k: v for k, v in self.params.items()}  # new obj
+            raise RuntimeError("remote_compile: HTTP 500")
+
+        monkeypatch.setattr(Trainer, "_train_run_fused",
+                            progressing_then_failing)
+        with pytest.raises(RuntimeError, match="remote_compile"):
+            trainer.train(epochs=1)
+        assert trainer.grad_accum == 1
+
+    def test_capitalized_compile_message_still_matches(self, datasets):
+        trainer = self._trainer(datasets)
+        exc = RuntimeError("INTERNAL: Compilation failure: whatever")
+        assert trainer._grad_accum_fallback(exc) == 2
