@@ -69,7 +69,7 @@ def last_real_chip_evidence(repo: Path = Path(__file__).resolve().parent):
     import re
     import subprocess
 
-    best = None
+    ranked = []
     for path in repo.glob("results_bench_chip*.json"):
         try:
             with open(path) as f:
@@ -80,30 +80,38 @@ def last_real_chip_evidence(repo: Path = Path(__file__).resolve().parent):
             continue
         m = re.search(r"_r(\d+)", path.name)
         rank = (int(m.group(1)) if m else -1, path.stat().st_mtime)
-        if best is None or rank > best[0]:
-            best = (rank, path, row)
-    if best is None:
+        ranked.append((rank, path, row))
+    if not ranked:
         return None
-    _, path, row = best
+    ranked.sort(key=lambda t: t[0], reverse=True)
+    _, path, row = ranked[0]
     evidence = {
         "source_file": path.name,
         "headline_seq_per_sec": row.get("value"),
         "vs_baseline": row.get("vs_baseline"),
     }
-    extras = row.get("extra_metrics") or {}
+    # highlights merge across ALL banked files, newest first: a
+    # family-suite line (e.g. an attention-only bank from a window that
+    # died before the rnn suite ran) must not shadow the older full
+    # line's LM story - per key, the freshest file carrying it wins,
+    # with the source recorded whenever it is not the headline file
     highlights = {}
-    for key in ("char_rnn_50m_bf16", "char_rnn_55m_wide_bf16",
-                "char_rnn_50m_bf16_b512_accum2", "moe_switch_bf16",
-                "attention_seq1024_dim512_flash_bf16",
-                "attention_seq1024_dim512_dense_bf16"):
-        val = extras.get(key)
-        if isinstance(val, dict):
-            highlights[key] = {
-                k: val[k]
-                for k in ("tokens_per_sec", "seq_per_sec",
-                          "mfu_vs_v5e_bf16_peak")
-                if k in val
-            }
+    for _, p, r in ranked:
+        extras = r.get("extra_metrics") or {}
+        for key in ("char_rnn_50m_bf16", "char_rnn_55m_wide_bf16",
+                    "char_rnn_50m_bf16_b512_accum2", "moe_switch_bf16",
+                    "attention_seq1024_dim512_flash_bf16",
+                    "attention_seq1024_dim512_dense_bf16"):
+            val = extras.get(key)
+            if key not in highlights and isinstance(val, dict):
+                highlights[key] = {
+                    k: val[k]
+                    for k in ("tokens_per_sec", "seq_per_sec",
+                              "mfu_vs_v5e_bf16_peak")
+                    if k in val
+                }
+                if p.name != path.name:
+                    highlights[key]["source_file"] = p.name
     if highlights:
         evidence["highlights"] = highlights
     try:
@@ -160,7 +168,7 @@ def lstm_lm_flops_per_token(model) -> float:
 def char50m_tokens_per_sec(precision: str, batch: int = 32,
                            seq: int = 129, steps: int = 50,
                            shape: str = "deep", unroll: int = 1,
-                           accum: int = 1):
+                           accum: int = 1, impl: str = "auto"):
     """(tokens/s, mfu) for a 50M-class LM; mfu vs the v5e bf16 peak.
 
     ``shape="deep"`` is the BASELINE.json preset (4 x 1280); ``"wide"``
@@ -185,10 +193,10 @@ def char50m_tokens_per_sec(precision: str, batch: int = 32,
         from pytorch_distributed_rnn_tpu.models.char_rnn import CharRNN
 
         model = CharRNN(vocab_size=256, embed_dim=512, hidden_dim=2048,
-                        layer_dim=2, cell="lstm", impl="auto",
+                        layer_dim=2, cell="lstm", impl=impl,
                         precision=precision, unroll=unroll)
     else:
-        model = char_rnn_50m(impl="auto", precision=precision,
+        model = char_rnn_50m(impl=impl, precision=precision,
                              unroll=unroll)
     params = model.init(jax.random.PRNGKey(0))
     opt = optax.adam(1e-3)
@@ -362,9 +370,64 @@ def moe_ffn_throughput(router: str, *, tokens: int = 8192, dim: int = 512,
     }
 
 
+def recurrent_roofline_row(hidden: int, batch: int, seq: int = 128,
+                           steps: int = 10):
+    """Train-pass timing of ONE LSTM layer's RECURRENT scan alone -
+    pre-projected inputs, no vocab head - the sequential bottleneck the
+    deep-vs-wide MFU gap lives in (4 x 1280 = 45.8% vs 2 x 2048 = 51.3%,
+    results_bench_chip_r4.json).  The input projection is bulk MXU work
+    that amortizes perfectly and identically for both shapes; what
+    differs is the per-step recurrent matmul size (2*B*H*4H FLOPs) over
+    the same scan overhead, so timing the scan alone across an (H, B)
+    grid separates compute-roofline time from per-step overhead: fitting
+    t_step = flops/eff_peak + tau over the grid yields the tau that
+    bounds deep shapes below wide ones.  Uses the REAL lstm_step (the
+    scan path's cell), fwd+bwd via grad."""
+    from functools import partial as _partial
+
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_rnn_tpu.ops.rnn import lstm_step
+
+    key = jax.random.PRNGKey(0)
+    w_hh_t = (jax.random.normal(key, (hidden, 4 * hidden), jnp.float32)
+              * hidden ** -0.5).astype(jnp.bfloat16)
+    xp = jax.random.normal(jax.random.PRNGKey(1),
+                           (seq, batch, 4 * hidden), jnp.bfloat16)
+    h0 = jnp.zeros((batch, hidden), jnp.float32)
+    c0 = jnp.zeros((batch, hidden), jnp.float32)
+
+    def f(w, xp):
+        _, out = jax.lax.scan(_partial(lstm_step, w), (h0, c0), xp)
+        return jnp.sum(out.astype(jnp.float32))
+
+    step = jax.jit(jax.grad(f, argnums=0))
+    g = step(w_hh_t, xp)  # compile
+    # concrete host fetch, not block_until_ready: on the tunneled axon
+    # backend the latter can return before the enqueued chain executed
+    # (see the char50m timing note), which would bleed warm-up into the
+    # timed region of exactly the tau fit this row feeds
+    float(jnp.sum(g.astype(jnp.float32)))
+    start = time.perf_counter()
+    for _ in range(steps):
+        g = step(w_hh_t, xp)
+    float(jnp.sum(g.astype(jnp.float32)))  # host fetch closes the region
+    dt = (time.perf_counter() - start) / steps
+    flops = 3.0 * seq * 2 * batch * hidden * 4 * hidden
+    # sequential step count is 2*seq (fwd scan + bwd scan); the 3x in
+    # the FLOPs model is the training-FLOPs convention, not a step count
+    return {"ms_per_pass": round(dt * 1000, 3),
+            "us_per_step": round(dt * 1e6 / (2 * seq), 2),
+            "eff_tflops": round(flops / dt / 1e12, 1),
+            "mfu_vs_v5e_bf16_peak": round(
+                flops / dt / V5E_BF16_PEAK_FLOPS, 4),
+            "hidden": hidden, "batch": batch, "seq": seq}
+
+
 def lm_best_row(precision, candidates=((512, 10), (256, 20), (128, 30),
                                        (32, 50)), seq=129, shape="deep",
-                unroll=1):
+                unroll=1, impl="auto"):
     """Largest LM batch that compiles+runs wins (batch 512 failed in the
     r2 remote compile helper - retried every round).  A compile-class
     failure retries the SAME effective batch with grad accumulation
@@ -384,7 +447,7 @@ def lm_best_row(precision, candidates=((512, 10), (256, 20), (128, 30),
             try:
                 tps, mfu = char50m_tokens_per_sec(
                     precision, batch=batch, steps=steps, seq=seq,
-                    shape=shape, unroll=unroll, accum=accum)
+                    shape=shape, unroll=unroll, accum=accum, impl=impl)
                 result = {"tokens_per_sec": round(tps, 0),
                           "mfu_vs_v5e_bf16_peak": round(mfu, 4),
                           "batch": batch, "seq": seq - 1}
@@ -489,11 +552,14 @@ def main():
 
     parser = argparse.ArgumentParser(prog="bench.py")
     parser.add_argument("--suite",
-                        choices=["quick", "stress", "attention", "moe"],
+                        choices=["quick", "stress", "attention", "moe",
+                                 "rnn"],
                         default="stress",
                         help="quick: headline only; stress: everything; "
-                        "attention / moe: headline + that family's rows "
-                        "only (fast paths for scarce tunnel windows)")
+                        "attention / moe / rnn: headline + that family's "
+                        "rows only (fast paths for scarce tunnel windows "
+                        "- a watcher running the family suites must not "
+                        "pay for stress re-measuring them)")
     parser.add_argument("--append-rows", default=None, metavar="PATH",
                         help="also append each extra row as one JSON line "
                         "to PATH the moment it completes - a killed run "
@@ -514,7 +580,7 @@ def main():
     headline = motion_throughput("auto")
 
     extras: dict = {}
-    rnn_rows = args.suite == "stress"
+    rnn_rows = args.suite in ("stress", "rnn")
     attention_rows = args.suite in ("stress", "attention")
     moe_rows = args.suite in ("stress", "moe")
     if rnn_rows or attention_rows or moe_rows:
@@ -658,6 +724,37 @@ def main():
 
             attempt("char_rnn_50m_bf16_unroll", _unroll_ladder)
 
+            # the deep-vs-wide MFU gap diagnostic: the recurrent scan
+            # alone over an (H, B) grid; fit t_step = flops/eff + tau
+            # offline to pin how much of the 45.8%-vs-51.3% gap is
+            # per-step overhead vs roofline (each cell records its own
+            # result or error so one failing shape keeps the others)
+            def _roofline_grid():
+                grid = {}
+                for hidden, batch in ((1280, 256), (2048, 256),
+                                      (1280, 512), (2048, 512)):
+                    cell_key = f"h{hidden}_b{batch}"
+                    try:
+                        grid[cell_key] = recurrent_roofline_row(
+                            hidden, batch)
+                    except Exception as exc:  # noqa: BLE001 - keep cells
+                        grid[cell_key] = (
+                            f"error: {type(exc).__name__}: {exc}"[:160])
+                return grid
+
+            attempt("char_rnn_recurrent_roofline", _roofline_grid)
+
+            # deep-shape MFU levers (VERDICT r4 item 6): the fused
+            # Pallas kernel forced at H=1280 (auto declines it there -
+            # this measures whether that policy is right), and batch
+            # 1024 (bigger per-step recurrent matmuls; the auto-accum
+            # ladder finds the largest microbatch that compiles)
+            attempt("char_rnn_50m_bf16_fused",
+                    lambda: _lm("bf16", candidates=((256, 10), (128, 15)),
+                                impl="fused"))
+            attempt("char_rnn_50m_bf16_b1024",
+                    lambda: _lm("bf16", candidates=((1024, 6),)))
+
             # effective batch 512 despite the environment's remote AOT
             # compile helper dying on the monolithic batch-512 program:
             # 2 microbatches of 256 (the shapes that DO compile),
@@ -765,11 +862,13 @@ def main():
                     lambda: _attn_row(4096, batch=8, steps=5,
                                       impl="dense", precision="bf16",
                                       dim=512, num_heads=4))
-        elif rnn_rows:
-            extras["char_rnn_50m"] = "skipped: no TPU"
-            extras["attention"] = "skipped: no TPU"
-        elif attention_rows:
-            extras["attention"] = "skipped: no TPU"
+        else:
+            # skip notes only for families the selected suite would
+            # actually have measured on a TPU
+            if rnn_rows:
+                extras["char_rnn_50m"] = "skipped: no TPU"
+            if attention_rows:
+                extras["attention"] = "skipped: no TPU"
 
     payload = {
         "metric": "motion-LSTM train throughput (bs=1440, 1 chip)",

@@ -1,24 +1,55 @@
 #!/bin/bash
-# Chip-window watcher: probe the axon tunnel every ~4 min; the moment a
-# probe sees a real TPU, run every queued chip-gated runner that has not
-# yet produced committed evidence this round.  Tunnel windows are scarce
-# (r4: one ~25-min window in ~13 h) - measurements must fire the moment
-# one opens, not when a human notices.
+# Chip-window watcher (r5): probe the axon tunnel every ~4 min; the
+# moment a probe sees a real TPU, run every queued chip-gated runner
+# that has not yet produced committed evidence this round.  Tunnel
+# windows are scarce (r4: one ~25-min window in ~22 h) - measurements
+# must fire the moment one opens, not when a human notices.
 #
-# Flap-safe: the watcher only exits once ALL THREE queued runners have
-# succeeded (ATTN bench rows, batch-512 bisection, run-chip sweep); a
-# tunnel drop mid-run leaves it looping for the next window.  Ordered by
-# value: never-measured work first (the dim-512/seq-4096 attention rows
-# via the fast `--suite attention` path with per-row append, then the
-# batch-512 bisection with its own per-rung append), the long resumable
-# run-chip sweep last.  Before each run-chip attempt, FAILED rows are
-# pruned from the results file - the sweep's resume-by-skip filters on
-# command-string presence regardless of returncode, so a row that failed
-# in a dead window would otherwise be skipped forever.
+# Flap-safe: the watcher only exits once ALL queued runners have
+# succeeded; a tunnel drop mid-run leaves it looping for the next
+# window.  Ordered by value, never-measured work first:
+#   1. ATTN   - the dim-512/head_dim-128 dense-vs-flash rows, the
+#               seq-4096 point, and the block_q x block_k ladder
+#               (--suite attention; per-row append keeps partial
+#               evidence if the window dies mid-suite)
+#   2. B512   - the batch-512 bisection rung ladder (repro_batch512.py
+#               appends one JSON line per rung to results_b512_repro)
+#   3. MOE    - the EP family's first on-chip throughput rows
+#               (--suite moe: 3 routers + dense A/B)
+#   4. RNN    - the RNN/LM family rows only (--suite rnn: the LM ladder
+#               now auto-rescues b512 via grad-accum instead of
+#               skipping, plus the recurrent roofline grid and the
+#               deep-shape lever rows).  NOT --suite stress: that would
+#               re-measure the attention+moe rows the dedicated runners
+#               above just banked, blowing the window budget.
+#   5. CHIP   - the long resumable run-chip CLI sweep (fused +
+#               dropout-0 rows).  Before each attempt, FAILED rows are
+#               pruned from the results file - the sweep's
+#               resume-by-skip filters on command-string presence
+#               regardless of returncode, so a row that failed in a
+#               dead window would otherwise be skipped forever.
+# The watcher does NOT git-commit (it would race the foreground
+# session's index); freshly-banked files are picked up and committed by
+# the session.
 cd /root/repo || exit 1
 ATTN_DONE=0
 B512_DONE=0
+MOE_DONE=0
+RNN_DONE=0
 CHIP_DONE=0
+bank_bench() {
+  # $1 = log file, $2 = destination results file.  Same predicate for
+  # the done-gate and the extraction: the single JSON contract line,
+  # which carries the backend field (bench.py falls back to CPU when
+  # the probe dies - a CPU line must not count).
+  local line
+  line=$(grep '"metric"' "$1" | tail -1)
+  if [ -n "$line" ] && echo "$line" | grep -q '"backend": "tpu"'; then
+    echo "$line" > "$2"
+    return 0
+  fi
+  return 1
+}
 while true; do
   if timeout 90 python -c "
 import jax
@@ -27,23 +58,26 @@ assert jax.default_backend() == 'tpu'
     echo "$(date -u +%FT%TZ) tunnel LIVE - running queued chip runners" >> /tmp/chip_watcher.log
     if [ "$ATTN_DONE" != 1 ]; then
       timeout 1500 python bench.py --suite attention \
-        --append-rows results_bench_attn_rows.jsonl > /tmp/bench_attn.log 2>&1
-      # same predicate for the done-gate and the extraction: the single
-      # JSON contract line, which carries the backend field (bench.py
-      # falls back to CPU when the probe dies - a CPU line must not
-      # count); per-row evidence is already on disk via --append-rows
-      # even when the final emit never happens
-      line=$(grep '"metric"' /tmp/bench_attn.log | tail -1)
-      if [ -n "$line" ] && echo "$line" | grep -q '"backend": "tpu"'; then
-        echo "$line" > results_bench_chip_r4_attn.json
-        ATTN_DONE=1
-      fi
+        --append-rows results_bench_attn_rows_r5.jsonl > /tmp/bench_attn.log 2>&1
+      bank_bench /tmp/bench_attn.log results_bench_chip_r5_attn.json && ATTN_DONE=1
       echo "$(date -u +%FT%TZ) attention bench done=$ATTN_DONE" >> /tmp/chip_watcher.log
     fi
     if [ "$B512_DONE" != 1 ]; then
       timeout 900 python repro_batch512.py >> /tmp/chip_watcher.log 2>&1 \
         && B512_DONE=1
       echo "$(date -u +%FT%TZ) repro_batch512 done=$B512_DONE" >> /tmp/chip_watcher.log
+    fi
+    if [ "$MOE_DONE" != 1 ]; then
+      timeout 900 python bench.py --suite moe \
+        --append-rows results_bench_moe_rows_r5.jsonl > /tmp/bench_moe.log 2>&1
+      bank_bench /tmp/bench_moe.log results_bench_chip_r5_moe.json && MOE_DONE=1
+      echo "$(date -u +%FT%TZ) moe bench done=$MOE_DONE" >> /tmp/chip_watcher.log
+    fi
+    if [ "$RNN_DONE" != 1 ]; then
+      timeout 2400 python bench.py --suite rnn \
+        --append-rows results_bench_rows_r5.jsonl > /tmp/bench_rnn.log 2>&1
+      bank_bench /tmp/bench_rnn.log results_bench_chip_r5.json && RNN_DONE=1
+      echo "$(date -u +%FT%TZ) rnn bench done=$RNN_DONE" >> /tmp/chip_watcher.log
     fi
     if [ "$CHIP_DONE" != 1 ]; then
       python - <<'EOF' >> /tmp/chip_watcher.log 2>&1
@@ -61,7 +95,8 @@ EOF
         >> /tmp/chip_watcher.log 2>&1 && CHIP_DONE=1
       echo "$(date -u +%FT%TZ) run-chip done=$CHIP_DONE" >> /tmp/chip_watcher.log
     fi
-    if [ "$ATTN_DONE" = 1 ] && [ "$B512_DONE" = 1 ] && [ "$CHIP_DONE" = 1 ]; then
+    if [ "$ATTN_DONE" = 1 ] && [ "$B512_DONE" = 1 ] && [ "$MOE_DONE" = 1 ] \
+       && [ "$RNN_DONE" = 1 ] && [ "$CHIP_DONE" = 1 ]; then
       echo "$(date -u +%FT%TZ) all queued runners complete" >> /tmp/chip_watcher.log
       exit 0
     fi

@@ -48,7 +48,13 @@ def test_last_real_chip_evidence_picks_freshest_tpu_line(tmp_path):
     import json
 
     old = {"metric": "m", "value": 60000.0, "vs_baseline": 31.0,
-           "backend": "tpu", "extra_metrics": {}}
+           "backend": "tpu",
+           "extra_metrics": {
+               # only the OLD full line carries the LM story - a newer
+               # family-suite bank must not erase it from the highlights
+               "char_rnn_50m_bf16": {"tokens_per_sec": 303915.0,
+                                     "mfu_vs_v5e_bf16_peak": 0.4519},
+           }}
     new = {"metric": "m", "value": 66175.0, "vs_baseline": 34.27,
            "backend": "tpu",
            "extra_metrics": {
@@ -73,6 +79,11 @@ def test_last_real_chip_evidence_picks_freshest_tpu_line(tmp_path):
             ["mfu_vs_v5e_bf16_peak"] == 0.513)
     # non-dict rows and absent keys never break extraction
     assert "attention_seq1024_dim512_flash_bf16" in ev["highlights"]
+    # cross-file merge: the LM row only the older r3 line carries is
+    # kept, tagged with its source; keys from the headline file are not
+    lm = ev["highlights"]["char_rnn_50m_bf16"]
+    assert lm["source_file"] == "results_bench_chip_r3.json"
+    assert "source_file" not in ev["highlights"]["char_rnn_55m_wide_bf16"]
 
 
 def test_last_real_chip_evidence_none_without_banked_lines(tmp_path):
@@ -135,7 +146,7 @@ def test_lm_ladder_auto_accum_rescues_compile_failures(monkeypatch):
     calls = []
 
     def fake_lm(precision, batch=32, steps=50, seq=129, shape="deep",
-                unroll=1, accum=1):
+                unroll=1, accum=1, impl="auto"):
         calls.append((batch, accum))
         if batch == 512 and accum == 1:
             raise RuntimeError(
@@ -155,7 +166,7 @@ def test_lm_ladder_steps_down_on_non_compile_failures(monkeypatch):
     calls = []
 
     def fake_lm(precision, batch=32, steps=50, seq=129, shape="deep",
-                unroll=1, accum=1):
+                unroll=1, accum=1, impl="auto"):
         calls.append((batch, accum))
         if batch == 512:
             raise RuntimeError("some unrelated failure")
@@ -166,3 +177,24 @@ def test_lm_ladder_steps_down_on_non_compile_failures(monkeypatch):
     # no accum retry burned on a non-compile error: straight to 256
     assert calls == [(512, 1), (256, 1)]
     assert row["batch"] == 256 and "accum" not in row
+
+
+def test_recurrent_roofline_row_well_formed():
+    row = bench.recurrent_roofline_row(16, 8, seq=4, steps=1)
+    assert row["ms_per_pass"] > 0
+    assert row["hidden"] == 16 and row["batch"] == 8
+    # FLOPs model: 3 * seq * 2*B*H*4H
+    assert row["eff_tflops"] >= 0
+
+
+def test_lm_best_row_threads_impl(monkeypatch):
+    seen = {}
+
+    def fake_lm(precision, batch=32, steps=50, seq=129, shape="deep",
+                unroll=1, accum=1, impl="auto"):
+        seen["impl"] = impl
+        return 1000.0, 0.4
+
+    monkeypatch.setattr(bench, "char50m_tokens_per_sec", fake_lm)
+    bench.lm_best_row("bf16", candidates=((32, 5),), impl="fused")
+    assert seen["impl"] == "fused"
