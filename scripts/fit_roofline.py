@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Fit the recurrent-scan roofline from banked bench rows.
+
+Reads the ``char_rnn_recurrent_roofline`` grid out of a banked bench
+line (default: ``results_bench_chip_r5.json``) and fits, per batch size,
+
+    t_pass = flops / eff_peak + (2 * seq) * tau
+
+across the hidden sizes measured - two unknowns (effective peak
+throughput and per-sequential-step overhead tau), two H points per B.
+The tau estimate is the deep-vs-wide MFU gap's explanation candidate:
+deep (4 x 1280) runs 2x the sequential steps of wide (2 x 2048) per
+token at ~2.56x smaller per-step matmuls, so a fixed tau taxes it twice.
+
+Usage: python scripts/fit_roofline.py [results_bench_chip_r5.json]
+"""
+
+import json
+import sys
+from pathlib import Path
+
+
+def fit(rows):
+    """rows: list of roofline row dicts sharing a batch size."""
+    if len(rows) < 2:
+        return None
+    # two-point solve: t = f/P + s*tau with t in seconds,
+    # f = training FLOPs, s = sequential steps (2*seq)
+    (r1, r2) = rows[:2]
+
+    def f(r):
+        return 3.0 * r["seq"] * 2 * r["batch"] * r["hidden"] * 4 * r["hidden"]
+
+    t1, t2 = r1["ms_per_pass"] / 1e3, r2["ms_per_pass"] / 1e3
+    f1, f2 = f(r1), f(r2)
+    s1, s2 = 2 * r1["seq"], 2 * r2["seq"]
+    # [t1]   [f1 s1] [1/P ]
+    # [t2] = [f2 s2] [tau]
+    det = f1 * s2 - f2 * s1
+    if det == 0:
+        return None
+    inv_p = (t1 * s2 - t2 * s1) / det
+    tau = (f1 * t2 - f2 * t1) / det
+    return {"eff_peak_tflops": round(1e-12 / inv_p, 1) if inv_p else None,
+            "tau_us_per_step": round(tau * 1e6, 3)}
+
+
+def main():
+    path = Path(sys.argv[1] if len(sys.argv) > 1
+                else "results_bench_chip_r5.json")
+    line = json.loads(path.read_text())
+    grid = line["extra_metrics"]["char_rnn_recurrent_roofline"]
+    cells = [v for v in grid.values() if isinstance(v, dict)]
+    for batch in sorted({c["batch"] for c in cells}):
+        sub = sorted((c for c in cells if c["batch"] == batch),
+                     key=lambda c: c["hidden"])
+        out = fit(sub)
+        print(f"B={batch}: cells="
+              + ", ".join(f"H{c['hidden']}={c['ms_per_pass']}ms"
+                          f"({c['mfu_vs_v5e_bf16_peak']:.1%})"
+                          for c in sub)
+              + (f" -> eff_peak={out['eff_peak_tflops']} TF/s, "
+                 f"tau={out['tau_us_per_step']} us/step" if out else
+                 " -> not enough cells to fit"))
+
+
+if __name__ == "__main__":
+    main()
