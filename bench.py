@@ -854,6 +854,71 @@ def main():
                 return ladder
 
             attempt("attention_flash_block_ladder", _flash_block_ladder)
+
+            # pure-kernel dense-vs-flash A/B at the MXU-relevant shape:
+            # the model-level rows dilute the attention core to ~25% of
+            # block FLOPs at dim 512 (proj+MLP dominate), so "flash vs
+            # dense" is sharpest timed on the cores alone - same
+            # (B, H, T, D), same grad, only the attention fn differs
+            def _attn_kernel_ab(seq_len=1024, d=128):
+                import jax
+                import jax.numpy as jnp
+
+                from pytorch_distributed_rnn_tpu.ops.attention import (
+                    mha_attention,
+                )
+                from pytorch_distributed_rnn_tpu.ops.pallas_attention import (  # noqa: E501
+                    flash_attention,
+                )
+
+                rng = np.random.RandomState(0)
+                q, k, v = (
+                    jnp.asarray(
+                        rng.randn(8, 8, seq_len, d).astype(np.float32)
+                    ).astype(jnp.bfloat16)
+                    for _ in range(3)
+                )
+                # fwd+bwd FLOPs of the two core matmuls (QK^T and PV),
+                # 2 matmuls x 2*B*H*T^2*D, x3 for training
+                flops = 3.0 * 2 * 2 * 8 * 8 * seq_len * seq_len * d
+                out = {}
+                for name, fn in (("dense", mha_attention),
+                                 ("flash", flash_attention)):
+                    # per-impl isolation (the row-family convention):
+                    # a flash compile/OOM failure must not discard the
+                    # dense timing already measured
+                    try:
+                        def f(q, k, v, _fn=fn):
+                            return jnp.sum(
+                                _fn(q, k, v).astype(jnp.float32))
+
+                        step = jax.jit(jax.grad(f, argnums=(0, 1, 2)))
+                        g = step(q, k, v)  # compile
+                        float(jnp.sum(g[0].astype(jnp.float32)))
+                        iters = 10
+                        start = time.perf_counter()
+                        for _ in range(iters):
+                            g = step(q, k, v)
+                        float(jnp.sum(g[0].astype(jnp.float32)))
+                        dt = (time.perf_counter() - start) / iters
+                        out[name] = {
+                            "ms": round(dt * 1000, 3),
+                            "core_mfu_vs_v5e_bf16_peak": round(
+                                flops / dt / V5E_BF16_PEAK_FLOPS, 4),
+                        }
+                    except Exception as exc:  # noqa: BLE001 - keep other
+                        out[name] = (
+                            f"error: {type(exc).__name__}: {exc}"[:160])
+                if all(isinstance(out.get(n), dict)
+                       for n in ("dense", "flash")):
+                    out["flash_speedup"] = round(
+                        out["dense"]["ms"] / out["flash"]["ms"], 3)
+                return out
+
+            attempt("attention_kernel_ab_seq1024_d128",
+                    lambda: _attn_kernel_ab(1024, 128))
+            attempt("attention_kernel_ab_seq2048_d128",
+                    lambda: _attn_kernel_ab(2048, 128))
             # LAST on purpose: the deliberately-failure-prone row (dense
             # O(T^2) scores at T=4096 may OOM or hang the remote compile
             # helper); everything measured before it is already on disk

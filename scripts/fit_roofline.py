@@ -21,27 +21,23 @@ from pathlib import Path
 
 
 def fit(rows):
-    """rows: list of roofline row dicts sharing a batch size."""
+    """rows: list of roofline row dicts sharing a batch size.  Least
+    squares over ALL rows (exact at two points; overdetermined when the
+    grid grows a third H), solving t = f/P + s*tau with t in seconds,
+    f = training FLOPs, s = sequential steps (2*seq)."""
     if len(rows) < 2:
         return None
-    # two-point solve: t = f/P + s*tau with t in seconds,
-    # f = training FLOPs, s = sequential steps (2*seq)
-    (r1, r2) = rows[:2]
+    import numpy as np
 
     def f(r):
         return 3.0 * r["seq"] * 2 * r["batch"] * r["hidden"] * 4 * r["hidden"]
 
-    t1, t2 = r1["ms_per_pass"] / 1e3, r2["ms_per_pass"] / 1e3
-    f1, f2 = f(r1), f(r2)
-    s1, s2 = 2 * r1["seq"], 2 * r2["seq"]
-    # [t1]   [f1 s1] [1/P ]
-    # [t2] = [f2 s2] [tau]
-    det = f1 * s2 - f2 * s1
-    if det == 0:
+    a = np.array([[f(r), 2 * r["seq"]] for r in rows])
+    t = np.array([r["ms_per_pass"] / 1e3 for r in rows])
+    (inv_p, tau), *_ = np.linalg.lstsq(a, t, rcond=None)
+    if inv_p == 0:
         return None
-    inv_p = (t1 * s2 - t2 * s1) / det
-    tau = (f1 * t2 - f2 * t1) / det
-    return {"eff_peak_tflops": round(1e-12 / inv_p, 1) if inv_p else None,
+    return {"eff_peak_tflops": round(1e-12 / inv_p, 1),
             "tau_us_per_step": round(tau * 1e6, 3)}
 
 

@@ -198,3 +198,29 @@ def test_lm_best_row_threads_impl(monkeypatch):
     monkeypatch.setattr(bench, "char50m_tokens_per_sec", fake_lm)
     bench.lm_best_row("bf16", candidates=((32, 5),), impl="fused")
     assert seen["impl"] == "fused"
+
+
+def test_roofline_fit_recovers_known_constants():
+    """scripts/fit_roofline.py fit() must round-trip synthetic rows
+    generated from known (eff_peak, tau) exactly - the BASELINE.md
+    claim, pinned."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "fit_roofline", REPO / "scripts" / "fit_roofline.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    peak, tau = 150e12, 20e-6
+
+    def cell(h, b, seq=128):
+        f = 3.0 * seq * 2 * b * h * 4 * h
+        t = f / peak + 2 * seq * tau
+        return {"ms_per_pass": t * 1e3, "hidden": h, "batch": b,
+                "seq": seq}
+
+    # two-point exact AND three-point overdetermined (consistent rows)
+    for hs in ((1280, 2048), (1024, 1280, 2048)):
+        out = mod.fit([cell(h, 256) for h in hs])
+        assert out["eff_peak_tflops"] == 150.0, out
+        assert out["tau_us_per_step"] == 20.0, out
