@@ -45,7 +45,7 @@ def test_capacity_drops_zero_out_tokens(setup):
                                rtol=1e-5, atol=1e-6)
 
 
-@pytest.mark.parametrize("ep", [2, 4, 8])
+@pytest.mark.parametrize("ep", [1, 2, 4, 8])
 def test_ep_matches_dense(setup, ep):
     params, x = setup
     mesh = make_mesh({"ep": ep})

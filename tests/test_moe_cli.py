@@ -52,8 +52,12 @@ class TestMoEModel:
 
 
 class TestMoEMeshParity:
+    # two cells, not the full factorization sweep: each cell costs ~75s
+    # of CPU-mesh compile (r5 durations) and dp=4,ep=1 degenerates to
+    # the dp-only path already covered by the strategy matrix; the ep=1
+    # slice/all_to_all edge is exercised cheaply in test_moe.py
     @pytest.mark.parametrize("axes", [
-        {"dp": 1, "ep": 4}, {"dp": 2, "ep": 2}, {"dp": 4, "ep": 1},
+        {"dp": 1, "ep": 4}, {"dp": 2, "ep": 2},
     ])
     def test_ep_loss_and_grads_match_dense(self, axes):
         """Ample capacity => the dispatched expert-parallel program equals
@@ -86,8 +90,11 @@ class TestMoEMeshParity:
         for a, b in zip(jax.tree.leaves(gm), jax.tree.leaves(gd)):
             np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-6)
 
+    # one composed cell: pure-ep top-2 routing parity is covered at the
+    # ops level (test_moe.py top-2 dispatch == dense) and the top-1
+    # cells above cover the dp x ep mesh plumbing
     @pytest.mark.parametrize("axes", [
-        {"dp": 1, "ep": 4}, {"dp": 2, "ep": 2},
+        {"dp": 2, "ep": 2},
     ])
     def test_ep_top2_loss_and_grads_match_dense(self, axes):
         """The GShard top-2 routing composes with the dp x ep mesh: with
