@@ -243,7 +243,8 @@ def char50m_tokens_per_sec(precision: str, batch: int = 32,
 
 
 def moe_flops_per_step(router: str, tokens: int, dim: int, hidden: int,
-                       experts: int, capacity: int) -> float:
+                       experts: int, capacity: int,
+                       n_groups: int = 1) -> float:
     """Training FLOPs per step of one MoE FFN layer, counting what the
     MXU actually executes: router (2*N*D*E), the one-hot dispatch AND
     combine einsums (2*N*E*C*D each - the real cost of the dense
@@ -257,7 +258,10 @@ def moe_flops_per_step(router: str, tokens: int, dim: int, hidden: int,
         slots = tokens * experts
         dispatch = 0.0
     else:
-        slots = experts * capacity
+        # grouped routing (GShard): capacity is PER GROUP, slots total
+        # E*C*G, and each group's dispatch one-hot only spans its own
+        # tokens - so dispatch stays 2*N*E*C*D with the smaller C
+        slots = experts * capacity * n_groups
         dispatch = 2 * (2.0 * tokens * experts * capacity * dim)
     fwd = (
         2.0 * tokens * dim * experts      # router
@@ -270,7 +274,8 @@ def moe_flops_per_step(router: str, tokens: int, dim: int, hidden: int,
 def moe_ffn_throughput(router: str, *, tokens: int = 8192, dim: int = 512,
                        hidden: int = 2048, experts: int = 8,
                        capacity_factor: float = 2.0, steps: int = 10,
-                       precision: str = "bf16"):
+                       precision: str = "bf16",
+                       group_size: int | None = None):
     """Train-step throughput of ONE MoE FFN layer on the dispatched
     path: ``router`` in {"switch", "top2", "expert", "dense"} (dense =
     the exact O(E) A/B reference, ``ops/moe.py::moe_ffn_dense``).
@@ -303,6 +308,11 @@ def moe_ffn_throughput(router: str, *, tokens: int = 8192, dim: int = 512,
     x = jax.random.normal(jax.random.PRNGKey(1), (tokens, dim),
                           jnp.float32)
 
+    if group_size and group_size >= tokens:
+        # mirror moe_ffn's own fallback (one global group) so capacity,
+        # FLOPs slots, and the drop counter all describe the path that
+        # actually ran
+        group_size = None
     num_selected = {"switch": 1, "top2": 2, "expert": 1, "dense": 1}[router]
     if router == "expert":
         capacity = moe_capacity(tokens, experts, capacity_factor)
@@ -316,12 +326,13 @@ def moe_ffn_throughput(router: str, *, tokens: int = 8192, dim: int = 512,
         def ffn(p, xt):
             return moe_ffn_dense(p, xt, num_selected=num_selected)
     else:
-        capacity = moe_capacity(tokens, experts, capacity_factor,
-                                num_selected)
+        capacity = moe_capacity(group_size or tokens, experts,
+                                capacity_factor, num_selected)
 
         def ffn(p, xt):
             return moe_ffn(p, xt, capacity_factor=capacity_factor,
-                           num_selected=num_selected)
+                           num_selected=num_selected,
+                           group_size=group_size)
 
     def loss(p, xx):
         out, aux = ffn(cast_expert_params(p, compute_dtype),
@@ -336,8 +347,9 @@ def moe_ffn_throughput(router: str, *, tokens: int = 8192, dim: int = 512,
         l, grads = step(params, x)
     float(l)  # host fetch closes the timed region (see char50m note)
     dt = (time.perf_counter() - start) / steps
+    n_groups = 1 if not group_size else tokens // group_size
     flops = moe_flops_per_step(router, tokens, dim, hidden, experts,
-                               capacity)
+                               capacity, n_groups)
 
     # realized drop fraction: route in the SAME compute dtype the timed
     # step used (bf16 near-ties can pick different experts than f32),
@@ -352,22 +364,34 @@ def moe_ffn_throughput(router: str, *, tokens: int = 8192, dim: int = 512,
             covered = jnp.sum(sel, axis=(0, 1)) > 0  # (N,) any slot
             return 1.0 - jnp.mean(covered.astype(jnp.float32))
         experts_k, _, _ = _route_topk(pc, xt, num_selected)
+
         # choice-major flattening + the shared slotting formula = the
         # exact pos make_dispatch_topk assigns, so `pos < capacity`
-        # counts precisely the assignments the real dispatch keeps
-        pos = _slot_positions(experts_k.T.reshape(-1), experts)
-        kept = jnp.sum((pos < capacity).astype(jnp.float32))
+        # counts precisely the assignments the real dispatch keeps;
+        # grouped routing slots within each group independently
+        def kept_in(ex):  # (n, k) assignments of one routing group
+            pos = _slot_positions(ex.T.reshape(-1), experts)
+            return jnp.sum((pos < capacity).astype(jnp.float32))
+
+        if n_groups > 1:
+            kept = jnp.sum(jax.vmap(kept_in)(
+                experts_k.reshape(n_groups, group_size, num_selected)))
+        else:
+            kept = kept_in(experts_k)
         return 1.0 - kept / (tokens * num_selected)
 
     drop_frac = 0.0 if router == "dense" else float(measure_drop(params, x))
 
-    return {
+    row = {
         "tokens_per_sec": round(tokens / dt, 0),
         "mfu_vs_v5e_bf16_peak": round(flops / dt / V5E_BF16_PEAK_FLOPS, 4),
         "drop_frac": round(drop_frac, 4),
         "tokens": tokens, "dim": dim, "hidden": hidden,
         "experts": experts, "capacity_factor": capacity_factor,
     }
+    if group_size:
+        row["group_size"] = group_size
+    return row
 
 
 def recurrent_roofline_row(hidden: int, batch: int, seq: int = 128,
@@ -680,6 +704,24 @@ def main():
                 lambda: moe_ffn_throughput("expert", **moe_kw))
         attempt("moe_dense_ab_bf16",
                 lambda: moe_ffn_throughput("dense", **moe_kw))
+
+        # group-size ladder (GShard grouped routing): the one-hot
+        # dispatch einsums cost 2*N*E*C*D with C per ROUTING GROUP, so
+        # smaller groups trade drop locality for linear-in-N dispatch -
+        # the ladder measures the throughput/drop trade directly
+        def _moe_group_ladder():
+            ladder = {}
+            sizes = ((2048, 1024, 512) if on_tpu else (512, 256))
+            for gs in sizes:
+                try:
+                    ladder[f"group{gs}"] = moe_ffn_throughput(
+                        "switch", group_size=gs, **moe_kw)
+                except Exception as exc:  # noqa: BLE001 - keep rungs
+                    ladder[f"group{gs}"] = (
+                        f"error: {type(exc).__name__}: {exc}"[:160])
+            return ladder
+
+        attempt("moe_switch_bf16_group_ladder", _moe_group_ladder)
 
         if on_tpu:
             attempt("char_rnn_50m_bf16", lambda: _lm("bf16"))

@@ -174,21 +174,55 @@ def moe_capacity(n_tokens: int, num_experts: int, capacity_factor: float,
 
 
 def moe_ffn(params, x, *, capacity_factor: float = 2.0,
-            num_selected: int = 1):
-    """Top-k MoE FFN over tokens ``x`` (..., D) via one-hot dispatch."""
+            num_selected: int = 1, group_size: int | None = None):
+    """Top-k MoE FFN over tokens ``x`` (..., D) via one-hot dispatch.
+
+    ``group_size`` routes tokens in independent groups of that size
+    (GShard sec. 3.2: capacity and slot assignment are per group, so
+    the one-hot dispatch/combine einsums cost 2*N*E*C_g*D with
+    C_g ~ group_size*cf/E - LINEAR in N, where ungrouped dispatch's
+    C ~ N*cf/E makes them quadratic).  ``None`` = one global group
+    (exact-union drop semantics, the small-N default).  Gating and the
+    load-balancing aux stay global either way - grouping only changes
+    which assignments compete for capacity slots.
+    """
     shape = x.shape
     d = shape[-1]
     xt = x.reshape(-1, d)
     n = xt.shape[0]
     e = params["w1"].shape[0]
-    capacity = moe_capacity(n, e, capacity_factor, num_selected)
 
     experts, probs, gates = _route_topk(params, xt, num_selected)
-    dispatch, combine = make_dispatch_topk(experts, probs, e, capacity,
-                                           xt.dtype)
-    tokens = jnp.einsum("nec,nd->ecd", dispatch, xt)
-    out = jnp.einsum("nec,ecd->nd", combine, _expert_ffn(params, tokens))
     aux = load_balancing_loss(gates, experts[:, 0], e)
+
+    if group_size is None or group_size >= n:
+        capacity = moe_capacity(n, e, capacity_factor, num_selected)
+        dispatch, combine = make_dispatch_topk(experts, probs, e,
+                                               capacity, xt.dtype)
+        tokens = jnp.einsum("nec,nd->ecd", dispatch, xt)
+        out = jnp.einsum("nec,ecd->nd", combine,
+                         _expert_ffn(params, tokens))
+        return out.reshape(shape), aux
+
+    if group_size <= 0 or n % group_size:
+        raise ValueError(
+            f"{n} tokens do not split into groups of {group_size} "
+            "(moe group_size must be positive and divide the token count)"
+        )
+    g = n // group_size
+    capacity = moe_capacity(group_size, e, capacity_factor, num_selected)
+    disp_g, comb_g = jax.vmap(
+        lambda ex, pr: make_dispatch_topk(ex, pr, e, capacity, xt.dtype)
+    )(experts.reshape(g, group_size, -1), probs.reshape(g, group_size, -1))
+    xg = xt.reshape(g, group_size, d)
+    # per-group pack -> (E, G*C, D) slots so the expert FFN runs ONE
+    # stacked matmul over all groups' slots, then per-group combine
+    tokens = jnp.einsum("gnec,gnd->egcd", disp_g, xg)
+    out_tokens = _expert_ffn(params, tokens.reshape(e, g * capacity, d))
+    out = jnp.einsum(
+        "gnec,egcd->gnd", comb_g,
+        out_tokens.reshape(e, g, capacity, d),
+    )
     return out.reshape(shape), aux
 
 
