@@ -308,8 +308,10 @@ def moe_ffn_throughput(router: str, *, tokens: int = 8192, dim: int = 512,
     x = jax.random.normal(jax.random.PRNGKey(1), (tokens, dim),
                           jnp.float32)
 
-    if group_size and group_size >= tokens:
-        # mirror moe_ffn's own fallback (one global group) so capacity,
+    if group_size and (group_size >= tokens
+                       or router in ("expert", "dense")):
+        # mirror the op's own behavior (one global group; expert/dense
+        # routers have no token-choice grouping at all) so capacity,
         # FLOPs slots, and the drop counter all describe the path that
         # actually ran
         group_size = None

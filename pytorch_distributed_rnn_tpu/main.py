@@ -103,6 +103,14 @@ def build_parser() -> argparse.ArgumentParser:
         "dense-exact path, which computes every expert and drops "
         "nothing - the flag has no effect there",
     )
+    parser.add_argument(
+        "--moe-group-size", default=None, type=int, metavar="G",
+        help="token-choice --model moe on the ep mesh strategy: route "
+        "each shard's tokens in independent groups of G (GShard grouped "
+        "routing) - capacity becomes per-group, keeping the one-hot "
+        "dispatch einsums linear in token count.  Default: one global "
+        "group per shard (exact-union drop semantics)",
+    )
     parser.add_argument("--resume", default=None, type=Path)
     parser.add_argument(
         "--checkpoint-every", default=0, type=int, metavar="N",

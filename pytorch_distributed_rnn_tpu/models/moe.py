@@ -66,6 +66,11 @@ class MoEClassifier:
     # top-C tokens, perfectly balanced by construction, aux loss 0
     expert_hidden: int | None = None  # default 2 * hidden_dim
     capacity_factor: float = 2.0
+    group_size: int | None = None  # token-choice only: route tokens in
+    # independent groups of this size on the DISPATCHED/ep path (GShard
+    # grouped routing - capacity per group keeps dispatch linear in
+    # token count).  The dense-exact local path has no dispatch, so
+    # grouping does not change its numerics.
     aux_weight: float = 0.01  # Switch load-balancing loss weight
     cell: str = "lstm"
     unroll: int = 1
@@ -95,6 +100,18 @@ class MoEClassifier:
                 "routing picks per-expert capacities instead - drop "
                 "--moe-top-k or use --moe-router token"
             )
+        if self.group_size is not None:
+            if self.router_type == "expert":
+                raise ValueError(
+                    "--moe-group-size is a token-choice knob; expert-"
+                    "choice selection is already balanced - drop it or "
+                    "use --moe-router token"
+                )
+            if self.group_size < 1:
+                raise ValueError(
+                    f"--moe-group-size must be >= 1, got "
+                    f"{self.group_size}"
+                )
         import math
 
         # `not (x > 0)` also catches NaN (every comparison is False);

@@ -173,6 +173,46 @@ def moe_capacity(n_tokens: int, num_experts: int, capacity_factor: float,
     return int(-(-n_tokens * num_selected * capacity_factor // num_experts))
 
 
+def grouped_pack_topk(xt, experts_k, probs_k, num_experts: int,
+                      group_size: int, capacity_factor: float,
+                      num_selected: int):
+    """Grouped (GShard) slot packing from top-k assignments: returns
+    ``(tokens (E, G*C, D), combine (G, group_size, E, C), G, C)``.  ONE
+    definition shared by the single-device dispatched path and the
+    ep-sharded path (the :func:`moe_capacity` convention), so the two
+    can never disagree on grouped slotting, capacity, or validation."""
+    n, d = xt.shape
+    if group_size <= 0 or n % group_size:
+        raise ValueError(
+            f"{n} tokens do not split into groups of {group_size} "
+            "(moe group_size must be positive and divide the token count)"
+        )
+    g = n // group_size
+    capacity = moe_capacity(group_size, num_experts, capacity_factor,
+                            num_selected)
+    disp_g, comb_g = jax.vmap(
+        lambda ex, pr: make_dispatch_topk(ex, pr, num_experts, capacity,
+                                          xt.dtype)
+    )(experts_k.reshape(g, group_size, -1),
+      probs_k.reshape(g, group_size, -1))
+    # per-group pack -> (E, G*C, D) slots so the expert FFN (and the ep
+    # path's all_to_all) see ONE stacked slot dim over all groups
+    tokens = jnp.einsum(
+        "gnec,gnd->egcd", disp_g, xt.reshape(g, group_size, d)
+    ).reshape(num_experts, g * capacity, d)
+    return tokens, comb_g, g, capacity
+
+
+def grouped_combine_topk(out_tokens, combine, g: int, capacity: int):
+    """Inverse of :func:`grouped_pack_topk`'s packing: gate-weighted
+    per-group combine of processed ``(E, G*C, D)`` slots back to
+    ``(N, D)`` tokens."""
+    e, _, d = out_tokens.shape
+    return jnp.einsum(
+        "gnec,egcd->gnd", combine, out_tokens.reshape(e, g, capacity, d)
+    ).reshape(g * combine.shape[1], d)
+
+
 def moe_ffn(params, x, *, capacity_factor: float = 2.0,
             num_selected: int = 1, group_size: int | None = None):
     """Top-k MoE FFN over tokens ``x`` (..., D) via one-hot dispatch.
@@ -204,25 +244,10 @@ def moe_ffn(params, x, *, capacity_factor: float = 2.0,
                          _expert_ffn(params, tokens))
         return out.reshape(shape), aux
 
-    if group_size <= 0 or n % group_size:
-        raise ValueError(
-            f"{n} tokens do not split into groups of {group_size} "
-            "(moe group_size must be positive and divide the token count)"
-        )
-    g = n // group_size
-    capacity = moe_capacity(group_size, e, capacity_factor, num_selected)
-    disp_g, comb_g = jax.vmap(
-        lambda ex, pr: make_dispatch_topk(ex, pr, e, capacity, xt.dtype)
-    )(experts.reshape(g, group_size, -1), probs.reshape(g, group_size, -1))
-    xg = xt.reshape(g, group_size, d)
-    # per-group pack -> (E, G*C, D) slots so the expert FFN runs ONE
-    # stacked matmul over all groups' slots, then per-group combine
-    tokens = jnp.einsum("gnec,gnd->egcd", disp_g, xg)
-    out_tokens = _expert_ffn(params, tokens.reshape(e, g * capacity, d))
-    out = jnp.einsum(
-        "gnec,egcd->gnd", comb_g,
-        out_tokens.reshape(e, g, capacity, d),
-    )
+    tokens, comb_g, g, capacity = grouped_pack_topk(
+        xt, experts, probs, e, group_size, capacity_factor, num_selected)
+    out = grouped_combine_topk(_expert_ffn(params, tokens), comb_g, g,
+                               capacity)
     return out.reshape(shape), aux
 
 

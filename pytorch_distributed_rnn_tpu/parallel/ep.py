@@ -25,6 +25,8 @@ from pytorch_distributed_rnn_tpu.ops.moe import (
     _expert_ffn,
     _route_expert_choice,
     _route_topk,
+    grouped_combine_topk,
+    grouped_pack_topk,
     make_dispatch_topk,
     moe_capacity,
 )
@@ -32,7 +34,7 @@ from pytorch_distributed_rnn_tpu.ops.moe import (
 
 def ep_moe_ffn(params, x_local, axis: str, *, capacity_factor: float = 2.0,
                num_selected: int = 1, router: str = "token",
-               stat_axes=None):
+               stat_axes=None, group_size: int | None = None):
     """Expert-parallel MoE FFN inside ``shard_map``.
 
     ``params`` replicated, ``x_local``: this shard's (..., D) tokens
@@ -43,6 +45,13 @@ def ep_moe_ffn(params, x_local, axis: str, *, capacity_factor: float = 2.0,
     standard sharded EC practice: selection is shard-local, so each
     expert owner processes exactly n_shards x C slots - perfectly
     balanced by construction), aux is 0.
+    ``group_size`` (token-choice only): route this shard's tokens in
+    independent groups of that size (GShard grouped routing,
+    ``ops/moe.py::moe_ffn``) - per-group capacity keeps the one-hot
+    dispatch einsums linear in the shard's token count.  The all_to_all
+    slot dim becomes groups x per-group-capacity, which is >= the
+    global capacity whenever the per-group ceil rounds up - slightly
+    more (padded) wire bytes bought for much cheaper dispatch compute.
     Returns ``(out_local, aux_loss)`` with ``aux_loss`` the Switch
     load-balancing loss averaged over ``stat_axes`` (default: the expert
     axis only).  When tokens also shard over other mesh axes (the
@@ -61,6 +70,11 @@ def ep_moe_ffn(params, x_local, axis: str, *, capacity_factor: float = 2.0,
         raise ValueError(f"{e} experts do not shard over {n} devices")
     e_local = e // n
 
+    # group_size=None or >= n_tok -> one global group; anything else
+    # (including invalid <= 0) flows into grouped_pack_topk, whose
+    # shared validation keeps this path's errors identical to moe_ffn's
+    grouped = bool(router != "expert" and group_size is not None
+                   and group_size < n_tok)
     if router == "expert":
         if num_selected != 1:
             # same loud reject as the model surface: --moe-top-k is a
@@ -70,20 +84,34 @@ def ep_moe_ffn(params, x_local, axis: str, *, capacity_factor: float = 2.0,
                 "num_selected is a token-choice knob; expert-choice "
                 "routing picks per-expert capacities instead"
             )
+        if group_size:
+            raise ValueError(
+                "group_size is a token-choice knob; expert-choice "
+                "selection is already per-shard"
+            )
         sel, combine_ecn = _route_expert_choice(
             params, xt, moe_capacity(n_tok, e, capacity_factor))
         dispatch = sel.transpose(2, 0, 1)  # (N, E, C)
         combine = combine_ecn.transpose(2, 0, 1)
     else:
-        capacity = moe_capacity(n_tok, e, capacity_factor, num_selected)
         experts_k, probs_k, gates = _route_topk(params, xt, num_selected)
         expert = experts_k[:, 0]  # first choice drives the aux loss
-        dispatch, combine = make_dispatch_topk(experts_k, probs_k, e,
-                                               capacity, xt.dtype)
+        if grouped:
+            tokens, comb_g, g, capacity = grouped_pack_topk(
+                xt, experts_k, probs_k, e, group_size, capacity_factor,
+                num_selected)
+        else:
+            capacity = moe_capacity(n_tok, e, capacity_factor,
+                                    num_selected)
+            dispatch, combine = make_dispatch_topk(experts_k, probs_k, e,
+                                                   capacity, xt.dtype)
 
     # pack local tokens into (E, C, D) slots, send each expert block to its
-    # owner: (E, C, D) -> (E/n, n*C, D) with slots ordered by source shard
-    tokens = jnp.einsum("nec,nd->ecd", dispatch, xt)
+    # owner: (E, C, D) -> (E/n, n*C, D) with slots ordered by source shard.
+    # Grouped routing already packed (E, G*C_g, D) - same exchange shape
+    # class, smaller one-hots.
+    if not grouped:
+        tokens = jnp.einsum("nec,nd->ecd", dispatch, xt)
     tokens = lax.all_to_all(tokens, axis, split_axis=0, concat_axis=1,
                             tiled=True)
 
@@ -96,7 +124,10 @@ def ep_moe_ffn(params, x_local, axis: str, *, capacity_factor: float = 2.0,
     # return processed slots to their source shards and combine
     out_tokens = lax.all_to_all(out_tokens, axis, split_axis=1,
                                 concat_axis=0, tiled=True)
-    out = jnp.einsum("nec,ecd->nd", combine, out_tokens)
+    if grouped:
+        out = grouped_combine_topk(out_tokens, comb_g, g, capacity)
+    else:
+        out = jnp.einsum("nec,ecd->nd", combine, out_tokens)
 
     if router == "expert":
         # perfectly balanced by construction - no load-balancing loss
@@ -115,7 +146,8 @@ def ep_moe_ffn(params, x_local, axis: str, *, capacity_factor: float = 2.0,
 def make_ep_train_step(optimizer, mesh, axis: str = "ep", *,
                        capacity_factor: float = 2.0,
                        num_selected: int = 1, router: str = "token",
-                       aux_weight: float = 0.01, donate: bool = True):
+                       aux_weight: float = 0.01, donate: bool = True,
+                       group_size: int | None = None):
     """Jitted expert-parallel MoE *training* step (regression shape):
     ``step(params, opt_state, x, y)`` with ``x``/``y`` (N, D) sharded
     along ``axis``; loss = global MSE + aux_weight * Switch aux loss.
@@ -138,7 +170,8 @@ def make_ep_train_step(optimizer, mesh, axis: str = "ep", *,
     def loss_fn(params, x_local, y_local):
         out, aux = ep_moe_ffn(params, x_local, axis,
                               capacity_factor=capacity_factor,
-                              num_selected=num_selected, router=router)
+                              num_selected=num_selected, router=router,
+                              group_size=group_size)
         local = jnp.mean((out - y_local) ** 2)
         return lax.pmean(local, axis) + aux_weight * aux
 
@@ -153,7 +186,8 @@ def make_ep_train_step(optimizer, mesh, axis: str = "ep", *,
 
 def make_ep_moe_forward(mesh, axis: str = "ep", *,
                         capacity_factor: float = 2.0,
-                        num_selected: int = 1, router: str = "token"):
+                        num_selected: int = 1, router: str = "token",
+                        group_size: int | None = None):
     """Jitted expert-parallel MoE FFN: tokens (N, D) sharded along ``axis``
     on entry, outputs sharded the same way; aux loss replicated."""
 
@@ -167,6 +201,7 @@ def make_ep_moe_forward(mesh, axis: str = "ep", *,
     def forward(params, x_local):
         return ep_moe_ffn(params, x_local, axis,
                           capacity_factor=capacity_factor,
-                          num_selected=num_selected, router=router)
+                          num_selected=num_selected, router=router,
+                          group_size=group_size)
 
     return jax.jit(forward)

@@ -956,6 +956,7 @@ def make_moe_mesh_loss_fn(model, mesh, *, weighted: bool = False):
                 num_selected=model.num_selected,
                 router=model.router_type,
                 stat_axes=data,
+                group_size=getattr(model, "group_size", None),
             )
 
         moe_fn = jax.checkpoint(moe_call) if remat else moe_call

@@ -123,6 +123,7 @@ def build_model(args, training_set):
             num_selected=getattr(args, "moe_top_k", 1),
             router_type=getattr(args, "moe_router", "token"),
             capacity_factor=getattr(args, "moe_capacity_factor", 2.0),
+            group_size=getattr(args, "moe_group_size", None),
             cell=getattr(args, "cell", "lstm"),
             precision=getattr(args, "precision", "f32"),
             remat=getattr(args, "remat", False),

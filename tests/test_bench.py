@@ -224,3 +224,19 @@ def test_roofline_fit_recovers_known_constants():
         out = mod.fit([cell(h, 256) for h in hs])
         assert out["eff_peak_tflops"] == 150.0, out
         assert out["tau_us_per_step"] == 20.0, out
+
+
+def test_moe_throughput_ignores_grouping_for_non_token_routers():
+    """expert/dense routers have no token-choice grouping: the row must
+    describe the path that ran (no group_size label, FLOPs not scaled
+    by phantom groups)."""
+    base = bench.moe_ffn_throughput(
+        "expert", tokens=64, dim=16, hidden=32, experts=4,
+        capacity_factor=2.0, steps=2)
+    grouped = bench.moe_ffn_throughput(
+        "expert", tokens=64, dim=16, hidden=32, experts=4,
+        capacity_factor=2.0, steps=2, group_size=16)
+    assert "group_size" not in grouped
+    # same FLOPs model -> MFU within noise of the ungrouped call
+    assert grouped["mfu_vs_v5e_bf16_peak"] < 4 * max(
+        base["mfu_vs_v5e_bf16_peak"], 1e-9)

@@ -430,3 +430,60 @@ class TestGroupedRouting:
         g = jax.grad(loss)(params)
         total = sum(float(jnp.sum(jnp.abs(l))) for l in jax.tree.leaves(g))
         assert np.isfinite(total) and total > 0
+
+    def test_ep_grouped_matches_dense_with_ample_capacity(self):
+        """Grouped routing on the expert-parallel path: ample per-group
+        capacity reproduces the dense reference exactly, for both the
+        pure-ep and a dp x ep-like 2-shard split."""
+        params = init_moe_ffn(jax.random.PRNGKey(0), D, E, HID)
+        x = jax.random.normal(jax.random.PRNGKey(1), (N, D))
+        out_d, aux_d = moe_ffn_dense(params, x)
+        for ep in (2, 4):
+            out_ep, aux_ep = make_ep_moe_forward(
+                make_mesh({"ep": ep}), capacity_factor=float(E),
+                group_size=8)(params, x)
+            np.testing.assert_allclose(out_ep, out_d, rtol=1e-5,
+                                       atol=1e-6)
+            np.testing.assert_allclose(aux_ep, aux_d, rtol=1e-6,
+                                       atol=1e-7)
+
+    def test_ep_group_size_rejects_expert_router(self):
+        params = init_moe_ffn(jax.random.PRNGKey(0), D, E, HID)
+        x = jax.random.normal(jax.random.PRNGKey(1), (N, D))
+        with pytest.raises(ValueError, match="token-choice knob"):
+            make_ep_moe_forward(make_mesh({"ep": 2}), router="expert",
+                                group_size=8)(params, x)
+
+    def test_model_surface_group_size(self):
+        from pytorch_distributed_rnn_tpu.models import MoEClassifier
+
+        with pytest.raises(ValueError, match="moe-group-size"):
+            MoEClassifier(router_type="expert", group_size=8)
+        with pytest.raises(ValueError, match="moe-group-size"):
+            MoEClassifier(group_size=0)
+        assert MoEClassifier(group_size=64).group_size == 64
+
+    def test_cli_group_size_reaches_model(self):
+        import argparse
+
+        from pytorch_distributed_rnn_tpu.training import families
+
+        args = argparse.Namespace(
+            model="moe", hidden_units=8, stacked_layer=1, dropout=0,
+            num_experts=2, moe_top_k=1, moe_router="token",
+            moe_capacity_factor=2.0, moe_group_size=32, cell="lstm",
+            precision="f32", remat=False,
+        )
+
+        class _DS:
+            num_features = 5
+
+        assert families.build_model(args, _DS()).group_size == 32
+
+    def test_ep_invalid_group_size_raises_like_moe_ffn(self):
+        params = init_moe_ffn(jax.random.PRNGKey(0), D, E, HID)
+        x = jax.random.normal(jax.random.PRNGKey(1), (N, D))
+        for bad in (0, -8, 5):
+            with pytest.raises(ValueError, match="group"):
+                make_ep_moe_forward(make_mesh({"ep": 2}),
+                                    group_size=bad)(params, x)
