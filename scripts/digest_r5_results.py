@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Digest the r5 banked chip results into the facts BASELINE.md needs.
+
+Reads whichever of the r5 evidence files exist and prints a compact
+summary: the flash-vs-dense verdicts (model rows, kernel A/B, block
+ladder), the deep-vs-wide story (LM rows, roofline fit via
+``scripts/fit_roofline.py``), the MoE rows, and the b512 bisection
+rungs.  Purely read-only - the human writes the conclusions.
+"""
+
+import json
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _load(name):
+    p = REPO / name
+    if not p.exists():
+        return None
+    try:
+        return json.loads(p.read_text())
+    except json.JSONDecodeError:
+        return None
+
+
+def _fmt(v):
+    return json.dumps(v) if not isinstance(v, dict) else ", ".join(
+        f"{k}={v[k]}" for k in sorted(v))
+
+
+def section(title):
+    print(f"\n=== {title} ===")
+
+
+def main():
+    attn = _load("results_bench_chip_r5_attn.json")
+    if attn:
+        section("attention (results_bench_chip_r5_attn.json)")
+        em = attn.get("extra_metrics", {})
+        for k in sorted(em):
+            if k.startswith("attention"):
+                print(f"{k}: {_fmt(em[k])}")
+        ab = em.get("attention_kernel_ab_seq1024_d128")
+        if isinstance(ab, dict) and isinstance(ab.get("flash_speedup"),
+                                               (int, float)):
+            verdict = ("FLASH WINS" if ab["flash_speedup"] >= 1.5
+                       else "below the 1.5x target")
+            print(f"-> kernel A/B seq1024: {ab['flash_speedup']}x "
+                  f"({verdict})")
+
+    rnn = _load("results_bench_chip_r5.json")
+    if rnn:
+        section("rnn/LM (results_bench_chip_r5.json)")
+        em = rnn.get("extra_metrics", {})
+        for k in sorted(em):
+            if k.startswith(("char_", "motion_")):
+                print(f"{k}: {json.dumps(em[k])[:240]}")
+        if isinstance(em.get("char_rnn_recurrent_roofline"), dict):
+            print("-> run: python scripts/fit_roofline.py "
+                  "results_bench_chip_r5.json")
+
+    moe = _load("results_bench_chip_r5_moe.json")
+    if moe:
+        section("moe (results_bench_chip_r5_moe.json)")
+        em = moe.get("extra_metrics", {})
+        for k in sorted(em):
+            if k.startswith("moe_"):
+                print(f"{k}: {json.dumps(em[k])[:240]}")
+
+    b512 = REPO / "results_b512_repro.json"
+    if b512.exists():
+        section("b512 bisection (results_b512_repro.json)")
+        for line in b512.read_text().splitlines():
+            try:
+                r = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            err = f" {r['error'][:80]}" if r.get("error") else ""
+            print(f"{r['rung']}: {r['status']} ({r['seconds']}s){err}")
+
+    if not any((attn, rnn, moe, b512.exists())):
+        print("no r5 chip evidence banked yet (tunnel has not opened)")
+
+
+if __name__ == "__main__":
+    main()
