@@ -222,6 +222,9 @@ def matrix_configs(extra_parameters=None, backend="cpu"):
             ("mesh --mesh dp=2,ep=2", {"moe-top-k": 2}),
             # expert-choice routing over the ep mesh (r4)
             ("mesh --mesh dp=2,ep=2", {"moe-router": "expert"}),
+            # GShard grouped routing: per-shard tokens (48/4 rows x 128
+            # steps = 1536) split into groups of 256 (r5)
+            ("mesh --mesh dp=2,ep=2", {"moe-group-size": 256}),
         ]),
     ):
         params = {**_MATRIX_BASE, "model": family, **fam_params,

@@ -432,10 +432,11 @@ def test_fsdp_multi_slot_is_a_real_process_world():
 
 def test_matrix_configs_cover_every_readme_cell():
     """run-matrix = one run per strategy x family matrix cell (every cell
-    trainable since r3).  4 families x 6 dp-strategies + 10 mesh rows
+    trainable since r3).  4 families x 6 dp-strategies + 11 mesh rows
     (char carries sp and composed sp x tp; rnn adds the interleaved pp
-    cell, attention the composed pp x tp cell, and moe the GShard top-2
-    and expert-choice cells since r4)."""
+    cell, attention the composed pp x tp cell, moe the GShard top-2 and
+    expert-choice cells since r4 and the grouped-routing cell since
+    r5)."""
     from pytorch_distributed_rnn_tpu.launcher import bench
     from pytorch_distributed_rnn_tpu.launcher.commands import (
         command_string,
@@ -443,7 +444,7 @@ def test_matrix_configs_cover_every_readme_cell():
     )
 
     cfgs = bench.matrix_configs()
-    assert len(cfgs) == 34
+    assert len(cfgs) == 35
     by_family = {}
     for c in cfgs:
         fam = c.parameters_dict()["model"]
@@ -466,6 +467,12 @@ def test_matrix_configs_cover_every_readme_cell():
         and c.parameters_dict().get("moe-top-k") == 2
     ]
     assert len(moe_topk) == 1
+    moe_grouped = [
+        c for c in cfgs
+        if c.parameters_dict()["model"] == "moe"
+        and c.parameters_dict().get("moe-group-size") == 256
+    ]
+    assert len(moe_grouped) == 1
     # every config synthesizes a unique, runnable command
     seen = set()
     for c in cfgs:
