@@ -309,7 +309,7 @@ def _motion_pp_program(dp: int, pp: int, schedule: str = "gpipe",
     return jax.jit(step), (params, state, batch), params
 
 
-def _moe_ep_program(dp: int, ep: int):
+def _moe_ep_program(dp: int, ep: int, group_size: int | None = None):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -324,7 +324,8 @@ def _moe_ep_program(dp: int, ep: int):
 
     mesh = make_mesh({"dp": dp, "ep": ep})
     model = MoEClassifier(input_dim=9, hidden_dim=16, layer_dim=1,
-                          output_dim=6, num_experts=ep * 2)
+                          output_dim=6, num_experts=ep * 2,
+                          group_size=group_size)
     params = model.init(jax.random.PRNGKey(0))
     opt = optax.adam(1e-3)
     state = opt.init(params)
@@ -368,6 +369,12 @@ def report_programs(n_devices: int = 8) -> list[dict]:
          lambda: _char_sp_program(n_devices // 4, 4), None),
         (f"moe mesh dp={n_devices // 4},ep=4 (all_to_all dispatch)",
          lambda: _moe_ep_program(n_devices // 4, 4), None),
+        # grouped routing: per-shard 24 tokens in four groups of 6 - the
+        # all_to_all slot dim grows to groups x per-group-capacity (the
+        # padded-slot wire-bytes trade the ep docstring documents) while
+        # dispatch compute shrinks; this row makes the trade measurable
+        (f"moe mesh dp={n_devices // 4},ep=4 (grouped routing, G=6)",
+         lambda: _moe_ep_program(n_devices // 4, 4, group_size=6), None),
         (f"motion mesh dp={n_devices // 2},pp=2 (GPipe stage ppermute)",
          lambda: _motion_pp_program(n_devices // 2, 2),
          {"schedule": [pp_schedule_stats(2, m, "gpipe")
