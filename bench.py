@@ -581,11 +581,13 @@ def main():
                         choices=["quick", "stress", "attention", "moe",
                                  "rnn"],
                         default="stress",
-                        help="quick: headline only; stress: everything; "
-                        "attention / moe / rnn: headline + that family's "
-                        "rows only (fast paths for scarce tunnel windows "
-                        "- a watcher running the family suites must not "
-                        "pay for stress re-measuring them)")
+                        help="quick: headline only; stress: every "
+                        "family's standard rows (deep diagnostic ladders "
+                        "excluded so the driver's plain run stays inside "
+                        "its budget); attention / moe / rnn: headline + "
+                        "that family's rows INCLUDING its deep ladders "
+                        "(the watcher's fast paths for scarce tunnel "
+                        "windows)")
     parser.add_argument("--append-rows", default=None, metavar="PATH",
                         help="also append each extra row as one JSON line "
                         "to PATH the moment it completes - a killed run "
@@ -610,16 +612,24 @@ def main():
     attention_rows = args.suite in ("stress", "attention")
     moe_rows = args.suite in ("stress", "moe")
     if rnn_rows or attention_rows or moe_rows:
-        def attempt(name, fn):
+        def attempt(name, fn, deep=False):
             # suite filter lives HERE so the row lists below stay one
             # flat sequence: rows are classed by name prefix (attention_
-            # / moe_); everything else belongs to the stress suite
+            # / moe_); everything else belongs to the stress suite.
+            # ``deep`` marks diagnostic ladders that run ONLY in their
+            # dedicated family suite (the watcher's fast paths), never
+            # in stress: the driver runs plain `python bench.py` at
+            # round end, and on a live chip the ladders would stack
+            # ~20 extra compiles onto a run that must finish inside the
+            # driver's budget - the r5 watcher banks them instead.
             if name.startswith("attention_"):
                 wanted = attention_rows
             elif name.startswith("moe_"):
                 wanted = moe_rows
             else:
                 wanted = rnn_rows
+            if deep and args.suite == "stress":
+                wanted = False
             if not wanted:
                 return
             try:
@@ -723,7 +733,8 @@ def main():
                         f"error: {type(exc).__name__}: {exc}"[:160])
             return ladder
 
-        attempt("moe_switch_bf16_group_ladder", _moe_group_ladder)
+        attempt("moe_switch_bf16_group_ladder", _moe_group_ladder,
+                deep=True)
 
         if on_tpu:
             attempt("char_rnn_50m_bf16", lambda: _lm("bf16"))
@@ -766,7 +777,7 @@ def main():
                             f"error: {type(exc).__name__}: {exc}"[:160])
                 return ladder
 
-            attempt("char_rnn_50m_bf16_unroll", _unroll_ladder)
+            attempt("char_rnn_50m_bf16_unroll", _unroll_ladder, deep=True)
 
             # the deep-vs-wide MFU gap diagnostic: the recurrent scan
             # alone over an (H, B) grid; fit t_step = flops/eff + tau
@@ -786,7 +797,8 @@ def main():
                             f"error: {type(exc).__name__}: {exc}"[:160])
                 return grid
 
-            attempt("char_rnn_recurrent_roofline", _roofline_grid)
+            attempt("char_rnn_recurrent_roofline", _roofline_grid,
+                    deep=True)
 
             # deep-shape MFU levers (VERDICT r4 item 6): the fused
             # Pallas kernel forced at H=1280 (auto declines it there -
@@ -795,9 +807,10 @@ def main():
             # ladder finds the largest microbatch that compiles)
             attempt("char_rnn_50m_bf16_fused",
                     lambda: _lm("bf16", candidates=((256, 10), (128, 15)),
-                                impl="fused"))
+                                impl="fused"), deep=True)
             attempt("char_rnn_50m_bf16_b1024",
-                    lambda: _lm("bf16", candidates=((1024, 6),)))
+                    lambda: _lm("bf16", candidates=((1024, 6),)),
+                    deep=True)
 
             # effective batch 512 despite the environment's remote AOT
             # compile helper dying on the monolithic batch-512 program:
@@ -897,7 +910,8 @@ def main():
                             f"error: {type(exc).__name__}: {exc}"[:120])
                 return ladder
 
-            attempt("attention_flash_block_ladder", _flash_block_ladder)
+            attempt("attention_flash_block_ladder", _flash_block_ladder,
+                    deep=True)
 
             # pure-kernel dense-vs-flash A/B at the MXU-relevant shape:
             # the model-level rows dilute the attention core to ~25% of
@@ -960,9 +974,9 @@ def main():
                 return out
 
             attempt("attention_kernel_ab_seq1024_d128",
-                    lambda: _attn_kernel_ab(1024, 128))
+                    lambda: _attn_kernel_ab(1024, 128), deep=True)
             attempt("attention_kernel_ab_seq2048_d128",
-                    lambda: _attn_kernel_ab(2048, 128))
+                    lambda: _attn_kernel_ab(2048, 128), deep=True)
             # LAST on purpose: the deliberately-failure-prone row (dense
             # O(T^2) scores at T=4096 may OOM or hang the remote compile
             # helper); everything measured before it is already on disk
