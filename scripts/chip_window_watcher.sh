@@ -57,7 +57,7 @@ assert jax.default_backend() == 'tpu'
 " >/dev/null 2>&1; then
     echo "$(date -u +%FT%TZ) tunnel LIVE - running queued chip runners" >> /tmp/chip_watcher.log
     if [ "$ATTN_DONE" != 1 ]; then
-      timeout 1500 python bench.py --suite attention \
+      timeout 2100 python bench.py --suite attention \
         --append-rows results_bench_attn_rows_r5.jsonl > /tmp/bench_attn.log 2>&1
       bank_bench /tmp/bench_attn.log results_bench_chip_r5_attn.json && ATTN_DONE=1
       echo "$(date -u +%FT%TZ) attention bench done=$ATTN_DONE" >> /tmp/chip_watcher.log
