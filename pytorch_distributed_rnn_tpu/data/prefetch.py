@@ -1,0 +1,36 @@
+"""Host-path input pipeline: bounded lookahead over a batch iterator.
+
+The torch-DataLoader-worker analogue for the host batch loop
+(``training/base.py:_train_epoch_host``): items are pulled ``depth``
+ahead of the consumer, so each batch's ``device_put`` dispatches (JAX
+transfers are asynchronous) while the previous step is still running
+on the device.  A synchronous deque - not a thread - keeps ordering
+and error propagation deterministic; the overlap comes from XLA's
+async dispatch, not host concurrency.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from collections.abc import Iterable, Iterator
+from typing import TypeVar
+
+T = TypeVar("T")
+
+
+def prefetch(iterable: Iterable[T], depth: int = 2) -> Iterator[T]:
+    """Yield from ``iterable`` in order, pulling ``depth`` items ahead.
+
+    When the consumer holds item ``i``, items ``i+1 .. i+depth`` have
+    already been pulled from the source (and, for device batches, their
+    uploads dispatched).  ``depth`` must be >= 1.
+    """
+    if depth < 1:
+        raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+    buffer: deque[T] = deque()
+    for item in iterable:
+        buffer.append(item)
+        if len(buffer) > depth:
+            yield buffer.popleft()
+    while buffer:
+        yield buffer.popleft()

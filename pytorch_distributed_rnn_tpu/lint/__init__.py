@@ -1,0 +1,60 @@
+"""pdrnn-lint: JAX-aware static analysis for this framework.
+
+The failure classes that cost the most at scale are the silent ones:
+an axis-name typo in a ``lax.psum`` that XLA happily reduces over the
+wrong (or no) mesh axis, a host sync buried in a jitted step that
+serializes every dispatch, a weight-update ``jit`` that forgets buffer
+donation and doubles peak memory, a closure rebuilt per step that
+retraces every call, and stub functions that look implemented.  Each
+round's external review re-derived these by ad-hoc AST scans; this
+package makes the scans first-class, plugin-based, and CI-gated.
+
+Rules
+-----
+- **PD101 axis-consistency** - every axis name passed as a string
+  literal to a collective (``lax.psum``/``pmean``/``all_gather``/
+  ``ppermute``/``axis_index``/... and the package's ``*_tree``
+  wrappers), every ``PartitionSpec`` literal entry, and every
+  ``axis=...`` default/keyword must be declared by a known mesh
+  constructor (``Mesh(...)``, ``make_mesh({...})``, ``*_AXES``
+  constants, axes-dict literals) somewhere in the scanned files.
+- **PD102 host-sync-in-jit** - ``.item()``, ``float()/int()`` on
+  traced values, ``np.asarray``/``np.array``, ``print``, ``time.*``
+  and stdlib ``random.*`` calls reachable inside ``@jit``/
+  ``shard_map``-wrapped or ``lax.scan``-carried functions.
+- **PD103 missing-donation** - ``jax.jit`` sites whose wrapped
+  function's first parameter is a params/opt-state pytree but that
+  pass no ``donate_argnums``/``donate_argnames``.
+- **PD104 retrace-hazard** - ``jax.jit``/``shard_map`` *construction*
+  inside a loop body: the wrapped callable is rebuilt per iteration,
+  so every call retraces and recompiles.
+- **PD105 stub/dead-code** - function bodies that are only ``pass``/
+  ``...``/``raise NotImplementedError`` (abstract methods, overloads
+  and Protocol members excluded).
+
+Run ``python -m pytorch_distributed_rnn_tpu.lint --help`` for the CLI;
+``lint_baseline.json`` at the repo root carries the accepted
+pre-existing findings so CI gates on *new* ones only.
+"""
+
+from pytorch_distributed_rnn_tpu.lint.core import (
+    Finding,
+    LintResult,
+    ModuleInfo,
+    all_rules,
+    run_lint,
+)
+from pytorch_distributed_rnn_tpu.lint.baseline import (
+    load_baseline,
+    write_baseline,
+)
+
+__all__ = [
+    "Finding",
+    "LintResult",
+    "ModuleInfo",
+    "all_rules",
+    "run_lint",
+    "load_baseline",
+    "write_baseline",
+]

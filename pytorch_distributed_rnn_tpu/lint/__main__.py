@@ -1,0 +1,8 @@
+"""``python -m pytorch_distributed_rnn_tpu.lint`` entry point."""
+
+import sys
+
+from pytorch_distributed_rnn_tpu.lint.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

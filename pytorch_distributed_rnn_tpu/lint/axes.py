@@ -1,0 +1,91 @@
+"""Known-mesh-axis registry for PD101.
+
+An axis name is "declared" when some scanned module constructs a mesh
+(or an axes spec that feeds one) carrying it:
+
+- ``Mesh(devices, ("dp", "tp"))`` / ``Mesh(..., axis_names=(...))``
+- ``make_mesh({"dp": 4, "tp": -1})`` / ``make_mesh(axes={...})`` /
+  ``global_device_mesh({...})`` / ``jax.make_mesh(..., ("dp",))``
+- dict literals assigned to an axes-ish name (``axes = {"dp": dp}``,
+  ``mesh_axes=...``, ``self.mesh_axes = ...``) - the package's
+  strategy-resolution idiom builds the dict first, then calls
+  ``make_mesh(axes)``
+- tuple/list constants assigned to ``*_AXES`` module constants
+  (``MODEL_AXES = ("sp", "tp", "pp")``)
+
+The registry is the union over every scanned file, matching how one
+process's mesh axes are visible to every shard_mapped function in the
+package.  ``--known-axes`` extends it for out-of-tree callers.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from pytorch_distributed_rnn_tpu.lint.core import ModuleInfo
+
+_MESH_CALL_TAILS = {"Mesh", "make_mesh", "global_device_mesh"}
+_AXES_VAR_NAMES = {"axes", "mesh_axes", "axis_sizes"}
+_AXIS_NAME_RE = r"[A-Za-z_][A-Za-z0-9_]*"
+
+
+def _is_axis_str(node: ast.AST) -> bool:
+    import re
+
+    return (isinstance(node, ast.Constant) and isinstance(node.value, str)
+            and re.fullmatch(_AXIS_NAME_RE, node.value) is not None)
+
+
+def _strings_in(node: ast.AST | None) -> Iterable[str]:
+    if node is None:
+        return
+    if _is_axis_str(node):
+        yield node.value  # type: ignore[union-attr]
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for elt in node.elts:
+            if _is_axis_str(elt):
+                yield elt.value  # type: ignore[union-attr]
+
+
+def _dict_keys(node: ast.AST | None) -> Iterable[str]:
+    if isinstance(node, ast.Dict):
+        for k in node.keys:
+            if k is not None and _is_axis_str(k):
+                yield k.value  # type: ignore[union-attr]
+
+
+def collect_known_axes(modules: Iterable[ModuleInfo]) -> set[str]:
+    axes: set[str] = set()
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                resolved = mod.resolve(node.func) or ""
+                tail = resolved.rsplit(".", 1)[-1]
+                if tail in _MESH_CALL_TAILS:
+                    for arg in node.args:
+                        axes.update(_strings_in(arg))
+                        axes.update(_dict_keys(arg))
+                    for kw in node.keywords:
+                        if kw.arg in ("axis_names", "axes", None):
+                            axes.update(_strings_in(kw.value))
+                            axes.update(_dict_keys(kw.value))
+            elif isinstance(node, ast.Assign):
+                targets = node.targets
+                names = set()
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+                    elif isinstance(t, ast.Attribute):
+                        names.add(t.attr)
+                if names & _AXES_VAR_NAMES or any(
+                        n.endswith("_AXES") for n in names):
+                    axes.update(_dict_keys(node.value))
+                    axes.update(_strings_in(node.value))
+                    # the resolution idiom merges defaults into the
+                    # literal: axes = {"dp": 1, **axes}
+                    if isinstance(node.value, ast.Dict):
+                        for k, v in zip(node.value.keys, node.value.values):
+                            if k is None:
+                                axes.update(_dict_keys(v))
+    return axes

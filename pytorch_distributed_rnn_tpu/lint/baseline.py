@@ -1,0 +1,102 @@
+"""Baseline handling: accepted pre-existing findings, keyed by a
+line-number-independent fingerprint so unrelated edits (or pure line
+drift) never invalidate the file.
+
+``lint_baseline.json``::
+
+    {
+      "version": 1,
+      "findings": [
+        {"fingerprint": "...", "count": 2,
+         "rule": "PD105", "path": "...", "symbol": "...", "snippet": "..."}
+      ]
+    }
+
+A current finding is suppressed while the baseline still has budget
+for its fingerprint (identical findings in one file share one entry
+with a count).  Regenerate with ``--write-baseline`` after reviewing
+that every remaining finding is genuinely accepted.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from pathlib import Path
+from typing import TYPE_CHECKING, Iterable
+
+if TYPE_CHECKING:  # import cycle: core imports fingerprint lazily
+    from pytorch_distributed_rnn_tpu.lint.core import Finding
+
+_VERSION = 1
+
+
+def fingerprint(finding: "Finding") -> str:
+    key = "|".join((finding.rule, finding.path, finding.symbol,
+                    finding.snippet))
+    return hashlib.sha256(key.encode()).hexdigest()[:16]
+
+
+def load_baseline(path: str | Path) -> dict[str, int]:
+    """fingerprint -> accepted occurrence count.  Missing file = empty
+    baseline (everything is a new finding)."""
+    path = Path(path)
+    if not path.exists():
+        return {}
+    data = json.loads(path.read_text())
+    if data.get("version") != _VERSION:
+        raise ValueError(
+            f"unsupported baseline version {data.get('version')!r} "
+            f"in {path} (expected {_VERSION})"
+        )
+    out: dict[str, int] = {}
+    for entry in data.get("findings", []):
+        out[entry["fingerprint"]] = (
+            out.get(entry["fingerprint"], 0) + int(entry.get("count", 1))
+        )
+    return out
+
+
+def write_baseline(path: str | Path,
+                   findings: Iterable["Finding"]) -> dict:
+    """Serialize ``findings`` as the new accepted baseline."""
+    by_fp: dict[str, dict] = {}
+    for f in findings:
+        fp = fingerprint(f)
+        if fp in by_fp:
+            by_fp[fp]["count"] += 1
+        else:
+            by_fp[fp] = {
+                "fingerprint": fp,
+                "count": 1,
+                "rule": f.rule,
+                "path": f.path,
+                "symbol": f.symbol,
+                "snippet": f.snippet,
+            }
+    data = {
+        "version": _VERSION,
+        "tool": "pdrnn-lint",
+        "findings": sorted(
+            by_fp.values(),
+            key=lambda e: (e["path"], e["rule"], e["symbol"], e["snippet"]),
+        ),
+    }
+    Path(path).write_text(json.dumps(data, indent=2) + "\n")
+    return data
+
+
+def apply_baseline(findings: list["Finding"],
+                   baseline: dict[str, int]) -> tuple[list["Finding"], int]:
+    """Split ``findings`` into (new, suppressed_count)."""
+    budget = dict(baseline)
+    new: list["Finding"] = []
+    suppressed = 0
+    for f in findings:
+        fp = fingerprint(f)
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+            suppressed += 1
+        else:
+            new.append(f)
+    return new, suppressed

@@ -1,0 +1,147 @@
+"""``pdrnn-lint`` command line.
+
+::
+
+    python -m pytorch_distributed_rnn_tpu.lint [paths...]
+        [--format text|json] [--select PD101,PD105] [--ignore PD103]
+        [--baseline lint_baseline.json | --no-baseline]
+        [--write-baseline] [--known-axes dp,tp] [--list-rules]
+
+Exit status: 0 = clean (all findings baselined or none), 1 = new
+findings, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from pytorch_distributed_rnn_tpu.lint.baseline import (
+    load_baseline,
+    write_baseline,
+)
+from pytorch_distributed_rnn_tpu.lint.core import all_rules, run_lint
+
+_DEFAULT_BASELINE = "lint_baseline.json"
+
+
+def _csv(value: str) -> list[str]:
+    return [v.strip() for v in value.split(",") if v.strip()]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="pdrnn-lint",
+        description="JAX-aware static analysis for "
+                    "pytorch_distributed_rnn_tpu (rules PD101-PD105)",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["pytorch_distributed_rnn_tpu"],
+        help="files or directories to lint "
+             "(default: the package directory)",
+    )
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text", dest="fmt")
+    parser.add_argument("--select", type=_csv, default=None, metavar="RULES",
+                        help="comma-separated rule codes to run exclusively")
+    parser.add_argument("--ignore", type=_csv, default=None, metavar="RULES",
+                        help="comma-separated rule codes to skip")
+    parser.add_argument("--known-axes", type=_csv, default=[],
+                        metavar="AXES",
+                        help="extra mesh-axis names to treat as declared")
+    parser.add_argument("--baseline", default=None, metavar="PATH",
+                        help=f"baseline file (default: ./{_DEFAULT_BASELINE} "
+                             "when present)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report every finding, baseline ignored")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="accept all current findings into the "
+                             "baseline file and exit 0")
+    parser.add_argument("--list-rules", action="store_true")
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for code, rule in sorted(all_rules().items()):
+            print(f"{code} {rule.name}: {rule.description}")
+        return 0
+
+    # a typo'd rule code must not turn the gate vacuously green
+    known_codes = set(all_rules())
+    unknown = set(args.select or ()) | set(args.ignore or ())
+    unknown -= known_codes
+    if unknown:
+        print(f"pdrnn-lint: unknown rule code(s): "
+              f"{', '.join(sorted(unknown))} "
+              f"(known: {', '.join(sorted(known_codes))})",
+              file=sys.stderr)
+        return 2
+
+    # a filtered run sees only a subset of findings; writing it out
+    # would silently drop every other rule's accepted entries
+    if args.write_baseline and (args.select or args.ignore):
+        print("pdrnn-lint: --write-baseline must run unfiltered "
+              "(drop --select/--ignore)", file=sys.stderr)
+        return 2
+
+    baseline_path = Path(args.baseline or _DEFAULT_BASELINE)
+    baseline: dict[str, int] = {}
+    if not args.no_baseline and not args.write_baseline:
+        try:
+            baseline = load_baseline(baseline_path)
+        except ValueError as e:
+            print(f"pdrnn-lint: {e}", file=sys.stderr)
+            return 2
+
+    try:
+        result = run_lint(
+            args.paths,
+            select=args.select,
+            ignore=args.ignore,
+            known_axes=args.known_axes,
+            baseline=baseline,
+            # report paths relative to the baseline's directory (the
+            # repo root), so fingerprints match no matter the cwd
+            root=baseline_path.resolve().parent,
+        )
+    except FileNotFoundError as e:
+        print(f"pdrnn-lint: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        data = write_baseline(baseline_path, result.findings)
+        print(f"pdrnn-lint: wrote {len(data['findings'])} baseline "
+              f"entries ({len(result.findings)} findings) to "
+              f"{baseline_path}")
+        return 0
+
+    if args.fmt == "json":
+        print(json.dumps({
+            "version": 1,
+            "files": result.files,
+            "known_axes": sorted(result.known_axes),
+            "counts": result.counts(),
+            "baseline_suppressed": result.suppressed,
+            "findings": [f.to_dict() for f in result.findings],
+        }, indent=2))
+    else:
+        for f in result.findings:
+            print(f.render())
+        summary = (
+            f"pdrnn-lint: {len(result.findings)} finding(s) in "
+            f"{result.files} file(s)"
+        )
+        if result.suppressed:
+            summary += f" ({result.suppressed} baselined)"
+        print(summary)
+
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via __main__
+    sys.exit(main())

@@ -1,0 +1,294 @@
+"""Linter core: module model, import-alias resolution, rule registry,
+and the ``run_lint`` driver.
+
+Everything is pure ``ast`` - the linter never imports the code it
+checks, so it runs identically with or without jax/TPU runtimes
+installed (and in CI before any heavyweight import).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Iterable, Iterator
+
+# ---------------------------------------------------------------------------
+# Findings
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str  # "PD101"
+    path: str  # repo-relative posix path
+    line: int  # 1-based
+    col: int  # 0-based
+    message: str
+    symbol: str = ""  # enclosing function qualname, "" at module scope
+    snippet: str = ""  # stripped source line (line-number-stable key)
+
+    def to_dict(self) -> dict:
+        from pytorch_distributed_rnn_tpu.lint.baseline import fingerprint
+
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "symbol": self.symbol,
+            "message": self.message,
+            "snippet": self.snippet,
+            "fingerprint": fingerprint(self),
+        }
+
+    def render(self) -> str:
+        where = f"{self.path}:{self.line}:{self.col + 1}"
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{where}: {self.rule}{sym} {self.message}"
+
+
+# ---------------------------------------------------------------------------
+# Per-module model
+
+_NOQA_RE = re.compile(
+    r"#\s*(?:noqa:|pdrnn-lint:\s*ignore\[)\s*([A-Z]{2}\d{3}(?:[,\s]+[A-Z]{2}\d{3})*)"
+)
+
+
+@dataclass
+class ModuleInfo:
+    """A parsed module plus the lookup tables every rule needs."""
+
+    path: str  # repo-relative posix path
+    source: str
+    tree: ast.Module
+    lines: list[str] = field(default_factory=list)
+    aliases: dict[str, str] = field(default_factory=dict)
+    functions: dict[str, ast.FunctionDef] = field(default_factory=dict)
+    parents: dict[ast.AST, ast.AST] = field(default_factory=dict)
+
+    @classmethod
+    def parse(cls, path: str, source: str) -> "ModuleInfo":
+        tree = ast.parse(source, filename=path)
+        info = cls(path=path, source=source, tree=tree,
+                   lines=source.splitlines())
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                info.parents[child] = node
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    info.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    info.aliases[a.asname or a.name] = (
+                        f"{node.module}.{a.name}"
+                    )
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # name -> def; later (nested) defs shadow earlier ones,
+                # which is the right lookup for jit(local_fn) sites
+                info.functions[node.name] = node  # type: ignore[assignment]
+        return info
+
+    def resolve(self, node: ast.AST) -> str | None:
+        """Dotted path of a Name/Attribute chain with import aliases
+        expanded: ``lax.psum`` -> ``jax.lax.psum`` when the module did
+        ``from jax import lax``.  None for anything unresolvable."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = self.aliases.get(node.id, node.id)
+        parts.append(root)
+        return ".".join(reversed(parts))
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def noqa_rules(self, lineno: int) -> set[str]:
+        m = _NOQA_RE.search(self.line_text(lineno))
+        if not m:
+            return set()
+        return set(re.findall(r"[A-Z]{2}\d{3}", m.group(1)))
+
+    def enclosing_function(self, node: ast.AST) -> str:
+        names: list[str] = []
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                names.append(cur.name)
+            cur = self.parents.get(cur)
+        return ".".join(reversed(names))
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        lineno = getattr(node, "lineno", 1)
+        return Finding(
+            rule=rule,
+            path=self.path,
+            line=lineno,
+            col=getattr(node, "col_offset", 0),
+            message=message,
+            symbol=self.enclosing_function(node),
+            snippet=self.line_text(lineno),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Package-wide context shared by the rules
+
+
+@dataclass
+class PackageIndex:
+    modules: list[ModuleInfo]
+    known_axes: set[str]
+
+
+# ---------------------------------------------------------------------------
+# Rule registry
+
+RuleFn = Callable[[ModuleInfo, PackageIndex], Iterator[Finding]]
+
+_REGISTRY: dict[str, "Rule"] = {}
+
+
+@dataclass(frozen=True)
+class Rule:
+    code: str
+    name: str
+    description: str
+    check: RuleFn
+
+
+def register(code: str, name: str, description: str):
+    """Decorator adding a rule function to the registry (the plugin
+    surface: a rule is just a ``(module, index) -> findings`` callable)."""
+
+    def deco(fn: RuleFn) -> RuleFn:
+        if code in _REGISTRY:
+            raise ValueError(f"duplicate lint rule {code}")
+        _REGISTRY[code] = Rule(code=code, name=name,
+                               description=description, check=fn)
+        return fn
+
+    return deco
+
+
+def all_rules() -> dict[str, Rule]:
+    # import for side effect: rule registration
+    from pytorch_distributed_rnn_tpu.lint import rules  # noqa: F401
+
+    return dict(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# Driver
+
+
+def collect_files(paths: Iterable[str | Path]) -> list[Path]:
+    files: list[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            # skip hidden/__pycache__ components BELOW the requested
+            # root only - the root itself may live under a dotted
+            # checkout path (~/.cache CI workspaces etc.)
+            files.extend(
+                f for f in sorted(p.rglob("*.py"))
+                if "__pycache__" not in f.relative_to(p).parts
+                and not any(part.startswith(".")
+                            for part in f.relative_to(p).parts)
+            )
+        elif p.suffix == ".py":
+            files.append(p)
+        else:
+            raise FileNotFoundError(f"not a Python file or directory: {p}")
+    return files
+
+
+@dataclass
+class LintResult:
+    findings: list[Finding]  # new (non-baselined, non-noqa) findings
+    suppressed: int  # baselined findings matched this run
+    known_axes: set[str]
+    files: int
+
+    def counts(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return dict(sorted(out.items()))
+
+
+def _rel(path: Path, root: Path) -> str:
+    try:
+        return path.resolve().relative_to(root.resolve()).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+def run_lint(
+    paths: Iterable[str | Path],
+    *,
+    select: Iterable[str] | None = None,
+    ignore: Iterable[str] | None = None,
+    known_axes: Iterable[str] = (),
+    baseline: dict[str, int] | None = None,
+    root: str | Path | None = None,
+) -> LintResult:
+    """Lint ``paths`` (files or directories) and return the result.
+
+    ``baseline`` maps finding fingerprints to accepted occurrence
+    counts (see :mod:`.baseline`); matched findings are suppressed.
+    ``known_axes`` extends the mesh-axis registry scanned from the
+    files themselves.
+    """
+    from pytorch_distributed_rnn_tpu.lint.axes import collect_known_axes
+    from pytorch_distributed_rnn_tpu.lint.baseline import apply_baseline
+
+    root = Path(root) if root is not None else Path.cwd()
+    files = collect_files(paths)
+    modules: list[ModuleInfo] = []
+    findings: list[Finding] = []
+    for f in files:
+        try:
+            source = f.read_text()
+            modules.append(ModuleInfo.parse(_rel(f, root), source))
+        except (SyntaxError, UnicodeDecodeError) as e:
+            findings.append(Finding(
+                rule="PD000", path=_rel(f, root),
+                line=getattr(e, "lineno", 1) or 1, col=0,
+                message=f"unparseable module: {e.__class__.__name__}: {e}",
+            ))
+
+    index = PackageIndex(
+        modules=modules,
+        known_axes=collect_known_axes(modules) | set(known_axes),
+    )
+
+    rules = all_rules()
+    active = set(rules)
+    if select:
+        active &= set(select)
+    if ignore:
+        active -= set(ignore)
+
+    for mod in modules:
+        for code in sorted(active):
+            for finding in rules[code].check(mod, index):
+                if finding.rule in mod.noqa_rules(finding.line):
+                    continue
+                findings.append(finding)
+
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    new, suppressed = apply_baseline(findings, baseline or {})
+    return LintResult(findings=new, suppressed=suppressed,
+                      known_axes=index.known_axes, files=len(files))

@@ -1,0 +1,466 @@
+"""The built-in rule plugins (PD101-PD105).
+
+Each rule is a ``(module, index) -> Iterator[Finding]`` function added
+via :func:`pytorch_distributed_rnn_tpu.lint.core.register`; new rules
+only need this module (or any importer) to call ``register``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from pytorch_distributed_rnn_tpu.lint.core import (
+    Finding,
+    ModuleInfo,
+    PackageIndex,
+    register,
+)
+
+# ---------------------------------------------------------------------------
+# shared call-resolution helpers
+
+
+def _tail(resolved: str) -> str:
+    return resolved.rsplit(".", 1)[-1]
+
+
+def _is_jit(resolved: str | None) -> bool:
+    return resolved is not None and (
+        resolved == "jax.jit" or
+        (resolved.startswith("jax.") and resolved.endswith(".jit"))
+    )
+
+
+def _is_shard_map(resolved: str | None) -> bool:
+    return resolved is not None and _tail(resolved) == "shard_map"
+
+
+def _is_partial(resolved: str | None) -> bool:
+    return resolved is not None and _tail(resolved) == "partial"
+
+
+def _jit_construction(mod: ModuleInfo, call: ast.Call) -> ast.Call | None:
+    """The jit call itself for ``jax.jit(...)`` or
+    ``partial(jax.jit, ...)`` forms, else None."""
+    resolved = mod.resolve(call.func)
+    if _is_jit(resolved):
+        return call
+    if _is_partial(resolved) and call.args:
+        if _is_jit(mod.resolve(call.args[0])):
+            return call
+    return None
+
+
+def _first_wrapped_param(mod: ModuleInfo, node: ast.AST) -> str | None:
+    """First parameter name of the function a jit/shard_map call wraps:
+    an inline lambda, a local def referenced by name, or a bound method
+    referenced as ``self.name`` (methods are indexed by name too).
+    ``self``/``cls`` leaders are skipped."""
+    if isinstance(node, ast.Lambda):
+        params = [a.arg for a in node.args.args]
+    else:
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        fn = mod.functions.get(name) if name else None
+        if fn is None:
+            return None
+        params = [a.arg for a in fn.args.args]
+    while params and params[0] in ("self", "cls"):
+        params = params[1:]
+    return params[0] if params else None
+
+
+# ---------------------------------------------------------------------------
+# PD101 axis-consistency
+
+# axis-name argument position per collective (jax.lax primitives and the
+# package's pytree wrappers in parallel/collectives.py)
+_AXIS_ARG_POS = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1,
+    "all_gather": 1, "ppermute": 1, "psum_scatter": 1, "all_to_all": 1,
+    "axis_index": 0, "axis_size": 0,
+    "psum_tree": 1, "pmean_tree": 1, "allgather_tree": 1,
+    "broadcast_from": 1,
+}
+_JAX_COLLECTIVES = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "ppermute",
+    "psum_scatter", "all_to_all", "axis_index", "axis_size",
+}
+_AXIS_KWARGS = ("axis_name", "axis")
+# pandas-style string axes that are not mesh axes
+_NON_MESH_AXIS_STRINGS = {"index", "columns", "rows"}
+
+
+def _literal_axis_names(node: ast.AST | None) -> Iterator[tuple[ast.AST, str]]:
+    if node is None:
+        return
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        yield node, node.value
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            yield from _literal_axis_names(elt)
+
+
+def _collective_axis_arg(mod: ModuleInfo, call: ast.Call) -> ast.AST | None:
+    resolved = mod.resolve(call.func)
+    if resolved is None:
+        return None
+    tail = _tail(resolved)
+    if tail not in _AXIS_ARG_POS:
+        return None
+    if tail in _JAX_COLLECTIVES and not (
+            resolved.startswith("jax.") or resolved == tail):
+        return None  # someone else's psum
+    pos = _AXIS_ARG_POS[tail]
+    if len(call.args) > pos:
+        return call.args[pos]
+    for kw in call.keywords:
+        if kw.arg in _AXIS_KWARGS:
+            return kw.value
+    return None
+
+
+@register(
+    "PD101", "axis-consistency",
+    "string-literal mesh-axis names must be declared by a known mesh/"
+    "shard_map axis set",
+)
+def check_axis_consistency(mod: ModuleInfo,
+                           index: PackageIndex) -> Iterator[Finding]:
+    known = index.known_axes
+
+    def check(node: ast.AST, name: str, context: str) -> Iterator[Finding]:
+        if name in known:
+            return
+        shown = ", ".join(sorted(known)) or "<none>"
+        yield mod.finding(
+            "PD101", node,
+            f'unknown mesh axis "{name}" in {context} '
+            f"(declared axes: {shown})",
+        )
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            resolved = mod.resolve(node.func) or ""
+            # collectives: the axis argument
+            axis_arg = _collective_axis_arg(mod, node)
+            for lit, name in _literal_axis_names(axis_arg):
+                yield from check(lit, name, f"{_tail(resolved)}()")
+            # PartitionSpec literals: every string entry is an axis
+            if _tail(resolved) == "PartitionSpec":
+                for arg in node.args:
+                    for lit, name in _literal_axis_names(arg):
+                        yield from check(lit, name, "PartitionSpec")
+            # axis-ish keywords on any call (axis="dp", tp_axis="tp",
+            # stat_axes=("dp", "ep"), ...)
+            if axis_arg is None:
+                for kw in node.keywords:
+                    if kw.arg and (kw.arg in _AXIS_KWARGS
+                                   or kw.arg.endswith("_axis")
+                                   or kw.arg.endswith("_axes")):
+                        for lit, name in _literal_axis_names(kw.value):
+                            # pandas-style string axes only exist on
+                            # this generic-kwarg path, never as a
+                            # collective/PartitionSpec argument
+                            if name in _NON_MESH_AXIS_STRINGS:
+                                continue
+                            yield from check(lit, name, f"{kw.arg}=")
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # axis-ish parameter defaults: def f(..., axis="dp")
+            args = node.args
+            pos = args.posonlyargs + args.args
+            for arg, default in zip(pos[len(pos) - len(args.defaults):],
+                                    args.defaults):
+                if (arg.arg in _AXIS_KWARGS or arg.arg.endswith("_axis")
+                        or arg.arg.endswith("_axes")):
+                    for lit, name in _literal_axis_names(default):
+                        yield from check(lit, name,
+                                         f"default {arg.arg}=")
+            for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+                if default is not None and (
+                        arg.arg in _AXIS_KWARGS
+                        or arg.arg.endswith("_axis")
+                        or arg.arg.endswith("_axes")):
+                    for lit, name in _literal_axis_names(default):
+                        yield from check(lit, name,
+                                         f"default {arg.arg}=")
+
+
+# ---------------------------------------------------------------------------
+# PD102 host-sync-in-jit
+
+# calls through these control-flow primitives trace their function
+# arguments (so host syncs inside those functions fire per-trace or,
+# worse, per-step via callbacks that silently block dispatch)
+_TRACING_CALL_TAILS = {"scan", "fori_loop", "while_loop", "cond", "switch",
+                       "shard_map", "jit", "remat", "checkpoint", "vmap",
+                       "grad", "value_and_grad", "pmap"}
+_SHAPE_ATTRS = {"shape", "ndim", "dtype", "size", "sharding"}
+
+
+def _traced_functions(mod: ModuleInfo) -> dict[str, ast.AST]:
+    """Local defs that run under tracing: jit/shard_map decorated, or
+    passed (by name) into jit/shard_map/lax control-flow calls."""
+    traced: dict[str, ast.AST] = {}
+
+    def mark(name: str | None):
+        if name and name in mod.functions:
+            traced[name] = mod.functions[name]
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                target = deco.func if isinstance(deco, ast.Call) else deco
+                resolved = mod.resolve(target)
+                if _is_jit(resolved) or _is_shard_map(resolved):
+                    traced[node.name] = node
+                elif isinstance(deco, ast.Call) and _is_partial(resolved):
+                    if deco.args and (_is_jit(mod.resolve(deco.args[0]))
+                                      or _is_shard_map(
+                                          mod.resolve(deco.args[0]))):
+                        traced[node.name] = node
+        elif isinstance(node, ast.Call):
+            resolved = mod.resolve(node.func)
+            if resolved is None:
+                continue
+            if _tail(resolved) in _TRACING_CALL_TAILS and (
+                    resolved.startswith("jax.")
+                    or _is_shard_map(resolved)):
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        mark(arg.id)
+            elif _jit_construction(mod, node) is not None:
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        mark(arg.id)
+    return traced
+
+
+def _is_host_sync(mod: ModuleInfo, call: ast.Call,
+                  param_names: set[str]) -> str | None:
+    """Why this call blocks (or breaks) tracing, or None."""
+    func = call.func
+    if isinstance(func, ast.Attribute) and func.attr == "item":
+        return ".item() forces a device->host transfer"
+    resolved = mod.resolve(func)
+    if resolved is not None:
+        if resolved in ("print",):
+            return "print() runs per-trace, not per-step (use jax.debug.print)"
+        if resolved.startswith("time."):
+            return "host time.* call traces to a constant"
+        if (resolved.startswith("random.")
+                or resolved.startswith("numpy.random.")):
+            return ("host RNG traces to a constant "
+                    "(use jax.random with a threaded key)")
+        if resolved in ("numpy.array", "numpy.asarray"):
+            return ("np.asarray/np.array on a traced value forces a "
+                    "host sync (use jnp.asarray)")
+        if resolved in ("float", "int", "bool") and len(call.args) == 1:
+            arg = call.args[0]
+            names = {n.id for n in ast.walk(arg)
+                     if isinstance(n, ast.Name)}
+            attrs = {n.attr for n in ast.walk(arg)
+                     if isinstance(n, ast.Attribute)}
+            if names & param_names and not attrs & _SHAPE_ATTRS:
+                return (f"{resolved}() on a traced value forces a "
+                        "host sync")
+    return None
+
+
+@register(
+    "PD102", "host-sync-in-jit",
+    "host-blocking calls (.item(), float/int on traced values, "
+    "np.asarray, print, time.*, random.*) inside traced functions",
+)
+def check_host_sync_in_jit(mod: ModuleInfo,
+                           index: PackageIndex) -> Iterator[Finding]:
+    for name, fn in _traced_functions(mod).items():
+        params = {a.arg for a in fn.args.args + fn.args.kwonlyargs}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                why = _is_host_sync(mod, node, params)
+                if why is not None:
+                    yield mod.finding(
+                        "PD102", node,
+                        f"inside traced function `{name}`: {why}",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# PD103 missing-donation
+
+_DONATABLE_FIRST_PARAMS = {
+    "params", "param", "state", "opt_state", "train_state", "weights",
+}
+_DONATE_KWARGS = {"donate_argnums", "donate_argnames"}
+
+
+def _has_donation(call: ast.Call) -> bool:
+    return any(kw.arg in _DONATE_KWARGS for kw in call.keywords)
+
+
+@register(
+    "PD103", "missing-donation",
+    "jax.jit over a params/opt-state step without "
+    "donate_argnums/donate_argnames doubles peak memory",
+)
+def check_missing_donation(mod: ModuleInfo,
+                           index: PackageIndex) -> Iterator[Finding]:
+    seen: set[int] = set()
+    for node in ast.walk(mod.tree):
+        # decorator form: @jax.jit / @partial(jax.jit, ...) def step(params, ...)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            params = [a.arg for a in node.args.args]
+            while params and params[0] in ("self", "cls"):
+                params = params[1:]
+            first = params[0] if params else None
+            if first not in _DONATABLE_FIRST_PARAMS:
+                continue
+            for deco in node.decorator_list:
+                target = deco.func if isinstance(deco, ast.Call) else deco
+                resolved = mod.resolve(target)
+                donated = isinstance(deco, ast.Call) and _has_donation(deco)
+                if _is_jit(resolved) and not donated:
+                    seen.add(id(deco))
+                    yield mod.finding(
+                        "PD103", node,
+                        f"`@jit` step `{node.name}({first}, ...)` "
+                        "updates state in place but donates no buffers",
+                    )
+                elif (isinstance(deco, ast.Call) and _is_partial(resolved)
+                        and deco.args
+                        and _is_jit(mod.resolve(deco.args[0]))
+                        and not donated):
+                    seen.add(id(deco))
+                    yield mod.finding(
+                        "PD103", node,
+                        f"`@partial(jax.jit, ...)` step "
+                        f"`{node.name}({first}, ...)` donates no buffers",
+                    )
+        elif isinstance(node, ast.Call) and id(node) not in seen:
+            jit_call = _jit_construction(mod, node)
+            if jit_call is None or _has_donation(jit_call):
+                continue
+            wrapped_args = (node.args[1:] if _is_partial(
+                mod.resolve(node.func)) else node.args)
+            if not wrapped_args:
+                continue
+            first = _first_wrapped_param(mod, wrapped_args[0])
+            if first in _DONATABLE_FIRST_PARAMS:
+                yield mod.finding(
+                    "PD103", node,
+                    f"jit site wraps a step whose first argument "
+                    f"`{first}` is an updated pytree but donates no "
+                    "buffers",
+                )
+
+
+# ---------------------------------------------------------------------------
+# PD104 retrace-hazard
+
+
+@register(
+    "PD104", "retrace-hazard",
+    "jit/shard_map constructed inside a loop body retraces and "
+    "recompiles every iteration",
+)
+def check_retrace_hazard(mod: ModuleInfo,
+                         index: PackageIndex) -> Iterator[Finding]:
+    flagged: set[int] = set()
+    for loop in ast.walk(mod.tree):
+        if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+            continue
+        for node in ast.walk(loop):
+            if node is loop or not isinstance(node, ast.Call):
+                continue
+            if id(node) in flagged:
+                continue
+            resolved = mod.resolve(node.func)
+            what = None
+            if _jit_construction(mod, node) is not None:
+                what = "jax.jit"
+            elif _is_shard_map(resolved):
+                what = "shard_map"
+            if what is not None:
+                flagged.add(id(node))
+                yield mod.finding(
+                    "PD104", node,
+                    f"{what}(...) constructed inside a loop: the "
+                    "wrapped callable is rebuilt per iteration, so "
+                    "every call retraces (hoist the construction out "
+                    "of the loop)",
+                )
+
+
+# ---------------------------------------------------------------------------
+# PD105 stub/dead-code
+
+_ABSTRACT_DECOS = {
+    "abstractmethod", "abstractproperty", "abstractclassmethod",
+    "abstractstaticmethod", "overload",
+}
+
+
+def _is_stub_body(body: list[ast.stmt]) -> bool:
+    stmts = list(body)
+    if (stmts and isinstance(stmts[0], ast.Expr)
+            and isinstance(stmts[0].value, ast.Constant)
+            and isinstance(stmts[0].value.value, str)):
+        stmts = stmts[1:]  # docstring
+    if not stmts:
+        return True  # docstring-only body
+    if len(stmts) != 1:
+        return False
+    stmt = stmts[0]
+    if isinstance(stmt, ast.Pass):
+        return True
+    if (isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant)
+            and stmt.value.value is Ellipsis):
+        return True
+    if isinstance(stmt, ast.Raise) and stmt.exc is not None:
+        exc = stmt.exc
+        name = exc.func if isinstance(exc, ast.Call) else exc
+        if isinstance(name, ast.Name) and name.id == "NotImplementedError":
+            return True
+        if (isinstance(name, ast.Attribute)
+                and name.attr == "NotImplementedError"):
+            return True
+    return False
+
+
+@register(
+    "PD105", "stub-dead-code",
+    "function bodies that are only pass/.../raise NotImplementedError "
+    "(abstract methods and overloads excluded)",
+)
+def check_stub_dead_code(mod: ModuleInfo,
+                         index: PackageIndex) -> Iterator[Finding]:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _is_stub_body(node.body):
+            continue
+        deco_tails = set()
+        for deco in node.decorator_list:
+            target = deco.func if isinstance(deco, ast.Call) else deco
+            resolved = mod.resolve(target)
+            if resolved:
+                deco_tails.add(_tail(resolved))
+        if deco_tails & _ABSTRACT_DECOS:
+            continue
+        # Protocol members are interface declarations, not stubs
+        parent = mod.parents.get(node)
+        if isinstance(parent, ast.ClassDef) and any(
+                isinstance(b, (ast.Name, ast.Attribute))
+                and _tail(mod.resolve(b) or "") == "Protocol"
+                for b in parent.bases):
+            continue
+        yield mod.finding(
+            "PD105", node,
+            f"`{node.name}` has a stub body "
+            "(pass/.../raise NotImplementedError)",
+        )

@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from pytorch_distributed_rnn_tpu.utils.compat import shard_map
 
 from pytorch_distributed_rnn_tpu.ops.moe import (
     _expert_ffn,
@@ -84,7 +84,10 @@ def ep_moe_ffn(params, x_local, axis: str, *, capacity_factor: float = 2.0,
                 "num_selected is a token-choice knob; expert-choice "
                 "routing picks per-expert capacities instead"
             )
-        if group_size:
+        if group_size is not None:
+            # `is not None`, not truthiness: group_size=0 is invalid
+            # everywhere and must be rejected here as loudly as the
+            # token-choice path rejects it, not silently accepted
             raise ValueError(
                 "group_size is a token-choice knob; expert-choice "
                 "selection is already per-shard"

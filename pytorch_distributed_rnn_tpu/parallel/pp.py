@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from pytorch_distributed_rnn_tpu.utils.compat import shard_map
 
 from pytorch_distributed_rnn_tpu.ops.rnn import gru_step, lstm_step
 from pytorch_distributed_rnn_tpu.parallel.collectives import broadcast_from

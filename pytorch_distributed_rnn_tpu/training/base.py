@@ -473,16 +473,29 @@ class Trainer:
 
     # -- loop ----------------------------------------------------------------
 
-    # compile-stage failure signature, matched case-insensitively
-    # against the exception text: "compil" covers "compile"/
-    # "Compilation failure"/"remote_compile: HTTP 500: tpu_compile_
-    # helper ..." (the documented batch-512 deep-LM failure class) -
-    # every known producer mentions compilation.  Execution-stage
-    # failures are NOT retried: by then donate_argnums may have
-    # consumed the state buffers, so re-running the step is not safe
-    # (enforced directly by the liveness/progress guards below, not
-    # just by this string heuristic).
-    _COMPILE_FAILURE_MARKS = ("compil",)
+    # compile-stage failure signatures, matched case-insensitively
+    # against the exception text.  Specific markers, not the bare
+    # "compil" substring: "XLA compilation failure", "remote_compile:
+    # HTTP 500: tpu_compile_helper ..." (the documented batch-512
+    # deep-LM failure class) all carry one of these, while an
+    # execution-stage error that merely *mentions* compilation (e.g. a
+    # shape error naming a "compiled program") must not trigger a
+    # retry - by then donate_argnums may have consumed the state
+    # buffers (also enforced directly by the liveness/progress guards
+    # below, not just by this string heuristic).
+    _COMPILE_FAILURE_MARKS = (
+        "compilation failure",
+        "tpu_compile",
+        "remote_compile",
+        # the TPU compile-stage OOM producer: "XLA:TPU compile
+        # permanent error. Ran out of memory in memory space hbm..."
+        "compile permanent error",
+    )
+    # fallback retries allowed per train() call: each retry climbs to
+    # the next batch divisor, and three rungs of microbatch shrinking
+    # is past the point where a deeper split has ever rescued a
+    # compile (BENCH r5); beyond that, fail with the ORIGINAL error
+    _MAX_COMPILE_RETRIES = 3
 
     @classmethod
     def is_compile_failure(cls, exc) -> bool:
@@ -521,6 +534,8 @@ class Trainer:
         training_history: list[float] = []
         validation_history: list[float] = []
         formatter = self._get_formatter(epochs)
+        first_exc: Exception | None = None
+        retries = 0
         while True:
             # identity snapshot: every completed device program
             # reassigns self.params, so `is` detects ANY training
@@ -536,9 +551,24 @@ class Trainer:
                 break
             except Exception as exc:  # noqa: BLE001 - gated right below
                 k = self._grad_accum_fallback(exc)
-                if (k is None or training_history or validation_history
-                        or self.params is not params_before):
+                progressed = bool(training_history or validation_history
+                                  or self.params is not params_before)
+                if (k is None or retries >= self._MAX_COMPILE_RETRIES
+                        or progressed):
+                    if (first_exc is not None and not progressed
+                            and self.is_compile_failure(exc)):
+                        # retries exhausted on the same failure class:
+                        # the FIRST failure is the diagnostic one - the
+                        # original batch-size program's error, not the
+                        # error of whichever shrunken retry died last.
+                        # A later NON-compile failure, or any failure
+                        # AFTER training progressed (a different
+                        # program died), is a different problem and
+                        # re-raises as itself.
+                        raise first_exc
                     raise
+                first_exc = first_exc or exc
+                retries += 1
                 # loud by design (VERDICT r4): the alternative was a
                 # silent skip in every sweep that hit the failing
                 # program class

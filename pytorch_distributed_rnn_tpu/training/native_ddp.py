@@ -28,6 +28,7 @@ docker-compose fake-cluster analogue).
 
 from __future__ import annotations
 
+import functools
 import json
 import logging
 
@@ -134,7 +135,10 @@ class NativeDDPTrainer(Trainer):
             jax.value_and_grad(self._loss_and_metrics, has_aux=True)
         )
 
-        @jax.jit
+        # the previous params/opt_state are dead once the update lands
+        # (the step reassigns both), so donate them - without this the
+        # update holds two full copies of the state at peak (PD103)
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
         def apply_update(params, opt_state, grads):
             updates, opt_state = self.optimizer.update(
                 grads, opt_state, params

@@ -1,0 +1,64 @@
+"""JAX version-compatibility shims.
+
+The framework targets the jax>=0.9 public API (``jax.shard_map``,
+``pltpu.CompilerParams``); older 0.4.x installs keep the same objects
+under their pre-promotion names.  Everything version-sensitive imports
+through here so the call sites stay written against the current API.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:  # jax >= 0.6: top-level export
+    from jax import shard_map
+except ImportError:  # jax 0.4.x
+    import functools
+
+    from jax.experimental.shard_map import shard_map as _shard_map_04
+
+    @functools.wraps(_shard_map_04)
+    def shard_map(*args, **kwargs):  # type: ignore[no-redef]
+        # the replication-check kwarg was renamed check_rep -> check_vma
+        # when shard_map was promoted out of jax.experimental; the
+        # framework is written against the promoted spelling
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map_04(*args, **kwargs)
+
+    # Re-export so `from jax import shard_map` resolves in any module
+    # loaded after this one (the package __init__ imports this shim).
+    jax.shard_map = shard_map
+
+
+if not hasattr(jax.distributed, "is_initialized"):  # added after 0.4.x
+    def _distributed_is_initialized() -> bool:
+        from jax._src import distributed as _distributed
+
+        return _distributed.global_state.client is not None
+
+    jax.distributed.is_initialized = _distributed_is_initialized
+
+
+try:  # jax >= 0.6
+    axis_size = jax.lax.axis_size
+except AttributeError:  # jax 0.4.x: axis_frame(name) IS the size
+    def axis_size(axis_name):
+        return jax.core.axis_frame(axis_name)
+
+    # patch onto jax.lax so the package's `lax.axis_size(...)` call
+    # sites (written against the promoted API) resolve everywhere
+    jax.lax.axis_size = axis_size
+
+
+def pallas_tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` (jax>=0.7) / ``TPUCompilerParams`` (0.4.x)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
+__all__ = ["shard_map", "axis_size", "pallas_tpu_compiler_params"]

@@ -76,8 +76,11 @@ def main():
                 r = json.loads(line)
             except json.JSONDecodeError:
                 continue
+            # placeholders, not KeyError: one malformed rung line must
+            # degrade the report, not kill it (ADVICE r5)
             err = f" {r['error'][:80]}" if r.get("error") else ""
-            print(f"{r['rung']}: {r['status']} ({r['seconds']}s){err}")
+            print(f"{r.get('rung', '?')}: {r.get('status', '?')} "
+                  f"({r.get('seconds', '?')}s){err}")
 
     if not any((attn, rnn, moe, b512.exists())):
         print("no r5 chip evidence banked yet (tunnel has not opened)")

@@ -52,7 +52,7 @@ class TestCompiledPrograms:
         tree's bytes through all-reduce per step - the invariant the
         scaling model's communication term is built on."""
         from jax.sharding import PartitionSpec as P
-        from jax import shard_map
+        from pytorch_distributed_rnn_tpu.utils.compat import shard_map
 
         mesh = make_mesh({"dp": 8})
         w = jnp.zeros((64, 64), jnp.float32)
@@ -78,7 +78,7 @@ class TestCompiledPrograms:
         depends on this; plain HLO parsing undercounts)."""
         from functools import partial
 
-        from jax import shard_map
+        from pytorch_distributed_rnn_tpu.utils.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         from pytorch_distributed_rnn_tpu.evaluation.collectives import (

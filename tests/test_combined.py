@@ -86,7 +86,7 @@ class TestSpTpRnn:
     def test_matches_unsharded_stack(self, cell):
         from functools import partial
 
-        from jax import shard_map
+        from pytorch_distributed_rnn_tpu.utils.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         from pytorch_distributed_rnn_tpu.ops.rnn import (
@@ -201,7 +201,7 @@ class TestSpTpRnn:
         output tracks the unsharded bf16 stack; remat is exact."""
         from functools import partial
 
-        from jax import shard_map
+        from pytorch_distributed_rnn_tpu.utils.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         from pytorch_distributed_rnn_tpu.ops.rnn import (

@@ -1,0 +1,458 @@
+"""pdrnn-lint: rule unit tests (each rule fires on a known-bad fixture
+and stays silent on a known-good one), CLI contract (json schema,
+select/ignore, exit codes, baseline round-trip), and the package gate
+(the whole package is clean against the committed baseline)."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from pytorch_distributed_rnn_tpu.lint import (
+    load_baseline,
+    run_lint,
+    write_baseline,
+)
+from pytorch_distributed_rnn_tpu.lint.cli import main as lint_main
+from pytorch_distributed_rnn_tpu.lint.core import all_rules
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+PACKAGE = REPO_ROOT / "pytorch_distributed_rnn_tpu"
+BASELINE = REPO_ROOT / "lint_baseline.json"
+
+# every fixture declares its own mesh so PD101's registry is built the
+# same way it is for the real package
+MESH_PREAMBLE = """\
+import functools
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from pytorch_distributed_rnn_tpu.parallel.mesh import make_mesh
+
+mesh = make_mesh({"dp": 4, "tp": 2})
+"""
+
+
+def lint_src(tmp_path, src, name="fixture.py", **kw):
+    f = tmp_path / name
+    f.write_text(MESH_PREAMBLE + src)
+    return run_lint([f], root=tmp_path, **kw)
+
+
+def codes(result):
+    return [f.rule for f in result.findings]
+
+
+class TestPD101AxisConsistency:
+    def test_axis_typo_in_psum_is_caught(self, tmp_path):
+        """The acceptance demo: a deliberate axis-name typo seeded into
+        a lax.psum call is caught."""
+        result = lint_src(tmp_path, """
+def grads(g):
+    return lax.psum(g, "dq")  # typo for "dp"
+""")
+        assert codes(result) == ["PD101"]
+        (finding,) = result.findings
+        assert '"dq"' in finding.message and "psum" in finding.message
+        assert finding.line > 0 and finding.symbol == "grads"
+
+    def test_declared_axis_is_silent(self, tmp_path):
+        result = lint_src(tmp_path, """
+def grads(g):
+    return lax.psum(g, "dp")
+
+
+def both(g):
+    return lax.pmean(g, ("dp", "tp"))
+""")
+        assert codes(result) == []
+
+    def test_partition_spec_and_defaults(self, tmp_path):
+        result = lint_src(tmp_path, """
+spec = P("dp", None)
+bad_spec = P("dpp", None)
+
+
+def f(x, axis="tq"):
+    return x
+
+
+def g(x, axis="tp"):
+    return x
+""")
+        assert codes(result) == ["PD101", "PD101"]
+        messages = " ".join(f.message for f in result.findings)
+        assert "dpp" in messages and "tq" in messages
+
+    def test_known_axes_extends_registry(self, tmp_path):
+        result = lint_src(tmp_path, """
+def grads(g):
+    return lax.psum(g, "dcn")
+""")
+        assert codes(result) == ["PD101"]
+        result = lint_src(tmp_path, """
+def grads(g):
+    return lax.psum(g, "dcn")
+""", known_axes=["dcn"])
+        assert codes(result) == []
+
+    def test_mesh_constructor_tuple_declares(self, tmp_path):
+        result = lint_src(tmp_path, """
+import numpy as np
+
+mesh2 = Mesh(np.array(jax.devices()), ("rows", "cols"))
+spec = P("rows", "cols")
+""")
+        assert codes(result) == []
+
+    def test_pandas_axis_names_only_skipped_on_generic_kwargs(
+            self, tmp_path):
+        """df.mean(axis="columns") is not a mesh-axis use, but an
+        UNDECLARED "rows"/"columns" in a collective still fires."""
+        result = lint_src(tmp_path, """
+def summarize(df, g):
+    part = lax.psum(g, "rows")  # undeclared mesh axis: must fire
+    return df.mean(axis="columns"), part  # pandas: must not fire
+""")
+        assert codes(result) == ["PD101"]
+        assert '"rows"' in result.findings[0].message
+
+
+class TestPD102HostSyncInJit:
+    def test_host_syncs_inside_jit_fire(self, tmp_path):
+        result = lint_src(tmp_path, """
+import time
+import random
+import numpy as np
+
+
+@jax.jit
+def step(x, batch):
+    print("loss", x)
+    t = time.perf_counter()
+    r = random.random()
+    v = float(batch)
+    a = np.asarray(batch)
+    return batch.sum().item() + t + r + v + a.sum()
+""")
+        assert codes(result) == ["PD102"] * 6
+
+    def test_same_calls_outside_jit_are_silent(self, tmp_path):
+        result = lint_src(tmp_path, """
+import time
+
+
+def host_loop(batches):
+    t = time.time()
+    for b in batches:
+        print("batch", b, t)
+""")
+        assert codes(result) == []
+
+    def test_scan_carried_function_is_traced(self, tmp_path):
+        result = lint_src(tmp_path, """
+def scanned(carry, x):
+    print(x)
+    return carry, x
+
+
+def run(xs):
+    return lax.scan(scanned, 0.0, xs)
+""")
+        assert codes(result) == ["PD102"]
+
+    def test_traced_float_of_shape_is_silent(self, tmp_path):
+        result = lint_src(tmp_path, """
+@jax.jit
+def step(x, batch):
+    scale = float(batch.shape[0])
+    return x, scale
+""")
+        assert codes(result) == []
+
+
+class TestPD103MissingDonation:
+    def test_undonated_step_fires(self, tmp_path):
+        result = lint_src(tmp_path, """
+def step(params, opt_state, batch):
+    return params, opt_state
+
+
+jitted = jax.jit(step)
+""")
+        assert codes(result) == ["PD103"]
+
+    def test_donated_step_is_silent(self, tmp_path):
+        result = lint_src(tmp_path, """
+def step(params, opt_state, batch):
+    return params, opt_state
+
+
+jitted = jax.jit(step, donate_argnums=(0, 1))
+""")
+        assert codes(result) == []
+
+    def test_decorator_form_fires_and_donated_partial_is_silent(
+            self, tmp_path):
+        result = lint_src(tmp_path, """
+@jax.jit
+def update(opt_state, grads):
+    return opt_state
+
+
+@functools.partial(jax.jit, donate_argnames=("state",))
+def update2(state, grads):
+    return state
+""")
+        assert codes(result) == ["PD103"]
+
+    def test_non_state_first_arg_is_silent(self, tmp_path):
+        result = lint_src(tmp_path, """
+def forward(x, scale):
+    return x * scale
+
+
+jitted = jax.jit(forward)
+""")
+        assert codes(result) == []
+
+
+class TestPD104RetraceHazard:
+    def test_jit_in_loop_fires(self, tmp_path):
+        result = lint_src(tmp_path, """
+def build(fns):
+    out = []
+    for fn in fns:
+        out.append(jax.jit(fn))
+    return out
+""")
+        assert codes(result) == ["PD104"]
+
+    def test_module_scope_jit_is_silent(self, tmp_path):
+        result = lint_src(tmp_path, """
+def forward(x):
+    return x
+
+
+jitted = jax.jit(forward)
+
+
+def apply_all(fs, x):
+    for f in fs:
+        x = f(x)  # invoking jitted fns in a loop is fine
+    return x
+""")
+        assert codes(result) == []
+
+
+class TestPD105StubDeadCode:
+    def test_stub_bodies_fire(self, tmp_path):
+        result = lint_src(tmp_path, """
+def todo():
+    pass
+
+
+def later():
+    ...
+
+
+def unfinished():
+    raise NotImplementedError("soon")
+""")
+        assert codes(result) == ["PD105"] * 3
+
+    def test_abstract_and_protocol_are_silent(self, tmp_path):
+        result = lint_src(tmp_path, """
+import abc
+from typing import Protocol
+
+
+class Base(abc.ABC):
+    @abc.abstractmethod
+    def run(self):
+        ...
+
+
+class Iface(Protocol):
+    def run(self):
+        ...
+
+
+def real():
+    return 1
+""")
+        assert codes(result) == []
+
+
+class TestNoqa:
+    def test_inline_noqa_suppresses_only_that_rule(self, tmp_path):
+        result = lint_src(tmp_path, """
+def grads(g):
+    return lax.psum(g, "dq")  # noqa: PD101
+
+
+def grads2(g):
+    return lax.psum(g, "dq")
+""")
+        assert codes(result) == ["PD101"]
+        assert result.findings[0].symbol == "grads2"
+
+
+class TestCLI:
+    def _write_bad(self, tmp_path):
+        f = tmp_path / "bad.py"
+        f.write_text(MESH_PREAMBLE + """
+def grads(g):
+    return lax.psum(g, "dq")
+
+
+def todo():
+    pass
+""")
+        return f
+
+    def test_nonzero_exit_and_text_output(self, tmp_path, capsys):
+        f = self._write_bad(tmp_path)
+        rc = lint_main([str(f), "--no-baseline"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "PD101" in out and "PD105" in out
+        assert "2 finding(s)" in out
+
+    def test_json_schema(self, tmp_path, capsys):
+        f = self._write_bad(tmp_path)
+        rc = lint_main([str(f), "--no-baseline", "--format", "json"])
+        assert rc == 1
+        report = json.loads(capsys.readouterr().out)
+        assert set(report) == {"version", "files", "known_axes", "counts",
+                               "baseline_suppressed", "findings"}
+        assert report["files"] == 1
+        assert report["counts"] == {"PD101": 1, "PD105": 1}
+        assert {"dp", "tp"} <= set(report["known_axes"])
+        for finding in report["findings"]:
+            assert set(finding) == {"rule", "path", "line", "col", "symbol",
+                                    "message", "snippet", "fingerprint"}
+            assert finding["line"] > 0
+
+    def test_select_and_ignore(self, tmp_path, capsys):
+        f = self._write_bad(tmp_path)
+        rc = lint_main([str(f), "--no-baseline", "--select", "PD105"])
+        report = capsys.readouterr().out
+        assert rc == 1 and "PD105" in report and "PD101" not in report
+
+        rc = lint_main([str(f), "--no-baseline", "--ignore",
+                        "PD101,PD105"])
+        assert rc == 0
+        assert "0 finding(s)" in capsys.readouterr().out
+
+    def test_baseline_round_trip(self, tmp_path, capsys):
+        f = self._write_bad(tmp_path)
+        baseline = tmp_path / "baseline.json"
+
+        rc = lint_main([str(f), "--baseline", str(baseline),
+                        "--write-baseline"])
+        assert rc == 0
+        assert "wrote 2 baseline entries" in capsys.readouterr().out
+
+        # suppressed by the baseline -> clean exit
+        rc = lint_main([str(f), "--baseline", str(baseline)])
+        assert rc == 0
+        assert "(2 baselined)" in capsys.readouterr().out
+
+        # a NEW finding still fails against the old baseline
+        f.write_text(f.read_text() + """
+
+def grads_new(g):
+    return lax.pmean(g, "qq")
+""")
+        rc = lint_main([str(f), "--baseline", str(baseline)])
+        out = capsys.readouterr().out
+        assert rc == 1 and "qq" in out and "(2 baselined)" in out
+
+    def test_write_then_load_baseline_api(self, tmp_path):
+        f = self._write_bad(tmp_path)
+        result = run_lint([f], root=tmp_path)
+        path = tmp_path / "b.json"
+        write_baseline(path, result.findings)
+        loaded = load_baseline(path)
+        assert sum(loaded.values()) == len(result.findings)
+        again = run_lint([f], root=tmp_path, baseline=loaded)
+        assert again.findings == [] and again.suppressed == 2
+
+    def test_list_rules(self, capsys):
+        assert lint_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for code in ("PD101", "PD102", "PD103", "PD104", "PD105"):
+            assert code in out
+
+    def test_missing_path_is_usage_error(self, tmp_path):
+        assert lint_main([str(tmp_path / "nope.txt")]) == 2
+
+    def test_unknown_rule_code_is_usage_error(self, tmp_path, capsys):
+        """A typo'd --select/--ignore must not turn the gate vacuously
+        green."""
+        f = self._write_bad(tmp_path)
+        rc = lint_main([str(f), "--no-baseline", "--select", "PD1O1"])
+        assert rc == 2
+        assert "unknown rule code" in capsys.readouterr().err
+
+    def test_filtered_write_baseline_is_refused(self, tmp_path, capsys):
+        """--write-baseline under --select/--ignore would clobber every
+        other rule's accepted entries."""
+        f = self._write_bad(tmp_path)
+        baseline = tmp_path / "baseline.json"
+        rc = lint_main([str(f), "--baseline", str(baseline),
+                        "--select", "PD105", "--write-baseline"])
+        assert rc == 2
+        assert "unfiltered" in capsys.readouterr().err
+        assert not baseline.exists()
+
+    def test_hidden_ancestor_of_root_is_linted(self, tmp_path):
+        """Only components BELOW the requested root are hidden-filtered:
+        a checkout under a dotted path still gets scanned."""
+        proj = tmp_path / ".workspace" / "proj"
+        proj.mkdir(parents=True)
+        (proj / "bad.py").write_text("def todo():\n    pass\n")
+        result = run_lint([proj], root=tmp_path)
+        assert result.files == 1
+        assert codes(result) == ["PD105"]
+        # ...while hidden dirs inside the root stay skipped
+        hidden = proj / ".venv"
+        hidden.mkdir()
+        (hidden / "dep.py").write_text("def stub():\n    pass\n")
+        result = run_lint([proj], root=tmp_path)
+        assert result.files == 1
+
+
+class TestPackageGate:
+    """The linter's contract with CI: the package itself stays clean."""
+
+    def test_all_rules_registered(self):
+        assert sorted(all_rules()) == ["PD101", "PD102", "PD103",
+                                       "PD104", "PD105"]
+
+    def test_package_has_zero_non_baselined_findings(self):
+        baseline = load_baseline(BASELINE)
+        result = run_lint([PACKAGE], root=REPO_ROOT, baseline=baseline)
+        assert result.findings == [], (
+            "new lint findings (fix them or regenerate lint_baseline.json "
+            "with --write-baseline after review):\n"
+            + "\n".join(f.render() for f in result.findings)
+        )
+
+    def test_package_axis_registry_is_complete(self):
+        result = run_lint([PACKAGE], root=REPO_ROOT,
+                          baseline=load_baseline(BASELINE))
+        assert {"dp", "tp", "pp", "sp", "ep"} <= result.known_axes
+
+    @pytest.mark.slow
+    def test_module_cli_exits_zero_against_committed_baseline(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytorch_distributed_rnn_tpu.lint",
+             "pytorch_distributed_rnn_tpu", "--baseline", str(BASELINE)],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=300,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr

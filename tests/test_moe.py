@@ -454,6 +454,16 @@ class TestGroupedRouting:
             make_ep_moe_forward(make_mesh({"ep": 2}), router="expert",
                                 group_size=8)(params, x)
 
+    def test_ep_group_size_zero_rejects_expert_router(self):
+        """group_size=0 with router='expert' must be rejected as loudly
+        as any other group_size - the old truthy guard let 0 slip
+        through as if the knob had not been passed (ADVICE r5)."""
+        params = init_moe_ffn(jax.random.PRNGKey(0), D, E, HID)
+        x = jax.random.normal(jax.random.PRNGKey(1), (N, D))
+        with pytest.raises(ValueError, match="token-choice knob"):
+            make_ep_moe_forward(make_mesh({"ep": 2}), router="expert",
+                                group_size=0)(params, x)
+
     def test_model_surface_group_size(self):
         from pytorch_distributed_rnn_tpu.models import MoEClassifier
 

@@ -536,3 +536,85 @@ class TestAutoGradAccumFallback:
         trainer = self._trainer(datasets)
         exc = RuntimeError("INTERNAL: Compilation failure: whatever")
         assert trainer._grad_accum_fallback(exc) == 2
+
+    def test_bare_compile_mention_no_longer_matches(self, datasets):
+        """The classifier needs a specific compile-stage marker; an
+        execution-stage error that merely *mentions* a compiled program
+        must not trigger the (donation-unsafe) retry (ADVICE r5)."""
+        trainer = self._trainer(datasets)
+        for msg in ("error while running the compiled program",
+                    "failed to compile regex",  # unrelated 'compil'
+                    "some other failure"):
+            assert trainer._grad_accum_fallback(RuntimeError(msg)) is None
+        for msg in ("XLA compilation failure",
+                    "remote_compile: HTTP 500",
+                    "tpu_compile_helper subprocess exit code 1",
+                    "XLA:TPU compile permanent error. Ran out of memory"
+                    " in memory space hbm."):
+            assert trainer._grad_accum_fallback(RuntimeError(msg)) == 2
+
+    def test_retry_cap_and_first_exception_preserved(self, datasets,
+                                                     monkeypatch):
+        """Every rebuild failing: train() stops after
+        _MAX_COMPILE_RETRIES fallbacks and re-raises the FIRST
+        exception (the original batch-size program's diagnostic), not
+        whichever shrunken retry died last."""
+        trainer = self._trainer(datasets)
+        calls = []
+
+        def always_failing_build(self):
+            calls.append(self.grad_accum)
+            raise RuntimeError(
+                f"remote_compile: HTTP 500 at grad_accum={self.grad_accum}")
+
+        monkeypatch.setattr(Trainer, "_build_idx_train_step",
+                            always_failing_build)
+        with pytest.raises(RuntimeError,
+                           match="grad_accum=1") as excinfo:
+            trainer.train(epochs=1)
+        # the original attempt plus at most _MAX_COMPILE_RETRIES rebuilds
+        assert len(calls) <= 1 + Trainer._MAX_COMPILE_RETRIES
+        assert "grad_accum=1" in str(excinfo.value)
+
+    def test_compile_failure_after_progress_raises_itself(self, datasets,
+                                                          monkeypatch):
+        """first_exc is only the diagnostic when NO progress was made:
+        a compile-class failure of a LATER program (after a rescued
+        retry already trained) is a different problem and must surface
+        as itself, not as the already-worked-around first error."""
+        trainer = self._trainer(datasets)
+        real_build = Trainer._build_idx_train_step
+
+        def failing_first_build(self):
+            if self.grad_accum == 1:
+                raise RuntimeError("remote_compile: first program")
+            return real_build(self)
+
+        def progressing_then_failing(self, *a):
+            self.params = {k: v for k, v in self.params.items()}  # new obj
+            raise RuntimeError("remote_compile: second program")
+
+        monkeypatch.setattr(Trainer, "_build_idx_train_step",
+                            failing_first_build)
+        monkeypatch.setattr(Trainer, "_train_run_fused",
+                            progressing_then_failing)
+        monkeypatch.setattr(Trainer, "_train_epoch",
+                            progressing_then_failing)
+        with pytest.raises(RuntimeError, match="second program"):
+            trainer.train(epochs=1)
+
+    def test_later_non_compile_failure_raises_itself(self, datasets,
+                                                     monkeypatch):
+        """A retry that dies with a DIFFERENT, non-compile error must
+        surface THAT error - re-raising the already-worked-around first
+        compile failure would bury the real one."""
+        trainer = self._trainer(datasets)
+
+        def build(self):
+            if self.grad_accum == 1:
+                raise RuntimeError("remote_compile: HTTP 500")
+            raise ValueError("shape mismatch in the retried program")
+
+        monkeypatch.setattr(Trainer, "_build_idx_train_step", build)
+        with pytest.raises(ValueError, match="shape mismatch"):
+            trainer.train(epochs=1)
