@@ -126,10 +126,11 @@ def get_command(config: RunConfig, python: str | None = None):
     else:
         raise ValueError(f"unknown trainer {config.trainer!r}")
 
-    if config.fault_type == "delay" and config.fault_value:
-        env["PDRNN_FAULT_DELAY_MS"] = str(config.fault_value)
-    elif config.fault_type == "loss" and config.fault_value:
-        env["PDRNN_FAULT_LOSS_PROB"] = str(config.fault_value)
+    # the netem-analogue env contract lives in resilience/faults.py so the
+    # bench sweep and the chaos harness's net:* events share one mechanism
+    from pytorch_distributed_rnn_tpu.resilience import fault_env
+
+    env.update(fault_env(config.fault_type, config.fault_value))
 
     return argv, env
 

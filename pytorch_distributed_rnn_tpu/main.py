@@ -111,11 +111,40 @@ def build_parser() -> argparse.ArgumentParser:
         "dispatch einsums linear in token count.  Default: one global "
         "group per shard (exact-union drop semantics)",
     )
-    parser.add_argument("--resume", default=None, type=Path)
+    parser.add_argument(
+        "--resume", default=None, type=Path, metavar="PATH|auto",
+        help="restore params/optimizer state before training.  A path "
+        "loads that checkpoint and retrains the full --epochs on top of "
+        "it (historical behavior); the literal 'auto' finds the newest "
+        "VALID checkpoint under --checkpoint-directory (corrupt/"
+        "truncated files are skipped - resilience/guard.py), CONTINUES "
+        "from its epoch, and starts fresh when none exists - the "
+        "crash-restart contract",
+    )
     parser.add_argument(
         "--checkpoint-every", default=0, type=int, metavar="N",
         help="also write checkpoint-epoch-N.ckpt every N epochs "
         "(0 = best-model-only, the reference's trigger)",
+    )
+    parser.add_argument(
+        "--keep-checkpoints", default=0, type=int, metavar="N",
+        help="rotate periodic epoch checkpoints, keeping only the newest "
+        "N (0 = keep all; best-model.ckpt is never rotated)",
+    )
+    parser.add_argument(
+        "--max-bad-steps", default=0, type=int, metavar="K",
+        help="non-finite guard: skip (not apply) any update step whose "
+        "gradients contain NaN/Inf, count it, and abort only after K "
+        "consecutive bad steps; 0 disables the guard (historical "
+        "behavior: a NaN poisons the params)",
+    )
+    parser.add_argument(
+        "--faults", default=None, metavar="SPEC",
+        help="deterministic chaos schedule (resilience/faults.py), e.g. "
+        "'step:3:nan,step:7:stall:0.5,epoch:2:kill,net:delay:100,"
+        "seed:7'; also read from the PDRNN_CHAOS env when the flag is "
+        "absent.  net:* events bridge onto the transport's "
+        "PDRNN_FAULT_* contract (the bench netem analogue)",
     )
     parser.add_argument(
         "--grad-accum", default=1, type=int, metavar="K",

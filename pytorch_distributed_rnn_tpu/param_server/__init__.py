@@ -31,6 +31,24 @@ def add_sub_command(sub_parser):
         help="async: apply each worker's gradient on arrival (reference-"
         "style); sync: average one gradient per worker per step",
     )
+    parser.add_argument(
+        "--ps-quorum", type=float, default=1.0, metavar="F",
+        help="sync mode: fraction of workers whose gradients close a "
+        "round once --ps-sync-timeout expires (1.0 = strict, a straggler "
+        "is fatal; 0.5 = degrade to half the world and keep training - "
+        "the preemptible-worker contract).  Dead workers are dropped "
+        "from later rounds while at least ceil(F x workers) survive",
+    )
+    parser.add_argument(
+        "--ps-sync-timeout", type=float, default=300.0, metavar="SECONDS",
+        help="sync mode: how long a round waits for stragglers before "
+        "erroring (--ps-quorum 1.0) or degrading (< 1.0)",
+    )
+    parser.add_argument(
+        "--ps-transport-retries", type=int, default=3, metavar="N",
+        help="worker-side retries (exponential backoff + jitter) for a "
+        "failed push/pull exchange before giving up",
+    )
     parser.set_defaults(func=execute)
 
 

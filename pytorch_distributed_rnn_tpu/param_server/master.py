@@ -25,6 +25,7 @@ update.
 from __future__ import annotations
 
 import logging
+import math
 import threading
 
 import numpy as np
@@ -36,37 +37,64 @@ log = logging.getLogger(__name__)
 
 class ParameterServerMaster:
     def __init__(self, comm, flat_params: np.ndarray, apply_update,
-                 sync_mode=False, sync_timeout: float = 300.0):
+                 sync_mode=False, sync_timeout: float = 300.0,
+                 quorum: float = 1.0):
         """``apply_update(flat_grads) -> flat_params`` advances the owned
         state by one optimizer step and returns the new flat params.
         ``sync_timeout`` bounds how long a sync-mode round waits for
-        stragglers before erroring (the reference's RPC timeout analogue,
-        ``/root/reference/src/motion/param_server/master.py:56``)."""
+        stragglers (the reference's RPC timeout analogue,
+        ``/root/reference/src/motion/param_server/master.py:56``).
+
+        ``quorum`` is the fraction of workers whose gradients suffice to
+        close a sync round once ``sync_timeout`` expires: at the default
+        1.0 a straggler past the timeout is fatal (strict DDP-equivalent
+        rounds), while e.g. 0.5 lets the round DEGRADE - average what
+        arrived, apply, release the waiters - so a preempted worker slows
+        the world instead of killing it (the Podracer/pjit preemptible-
+        worker baseline).  A straggler's late gradient joins the next
+        round as an ordinary (stale) contribution."""
+        if not 0.0 < quorum <= 1.0:
+            raise ValueError(f"quorum must be in (0, 1], got {quorum}")
         self.comm = comm
         self.params = flat_params.astype(np.float32)
         self.apply_update = apply_update
         self.sync_mode = sync_mode
         self.sync_timeout = float(sync_timeout)
+        self.quorum = float(quorum)
         self.lock = threading.Lock()
         self.num_params = int(flat_params.size)
         self.updates_applied = 0
+        self.degraded_rounds = 0
         # sync-mode rendezvous state
         self._pending: dict[int, np.ndarray] = {}
         self._sync_cv = threading.Condition(self.lock)
         self._waiting: set[int] = set()
+        # workers whose transport died (quorum mode tolerates them):
+        # excluded from later rounds so the world shrinks instead of
+        # timing out on a corpse every round
+        self._dead: set[int] = set()
 
     def serve(self):
-        """Block until every worker sends DONE.  A failure in any worker's
+        """Block until every worker sends DONE.  A failure in a worker's
         service thread (socket error, integrity assertion) is re-raised
         here so the master process reports failure instead of silently
-        finishing on a reduced worker set."""
+        finishing on a reduced worker set - EXCEPT in quorum-degraded
+        sync mode (``quorum < 1``), where a dying worker is marked dead,
+        dropped from later rounds, and only a quorum-breaking loss of
+        workers is fatal (the preemptible-worker contract)."""
+        num_workers = self.comm.world_size - 1
         errors: dict[int, BaseException] = {}
+        tolerated: dict[int, BaseException] = {}
 
         def guarded(worker):
             try:
                 self._serve_worker(worker)
             except BaseException as exc:  # noqa: BLE001 - propagated below
-                errors[worker] = exc
+                if self.sync_mode and self.quorum < 1.0:
+                    tolerated[worker] = exc
+                    self._mark_dead(worker, exc)
+                else:
+                    errors[worker] = exc
 
         threads = [
             threading.Thread(target=guarded, args=(w,))
@@ -82,14 +110,43 @@ class ParameterServerMaster:
                 f"parameter-server worker thread(s) failed: "
                 f"{sorted(errors)} (first: worker {worker})"
             ) from exc
+        survivors = num_workers - len(tolerated)
+        if tolerated and survivors < self._quorum_count(num_workers):
+            worker, exc = next(iter(tolerated.items()))
+            raise RuntimeError(
+                f"parameter server lost quorum: {sorted(tolerated)} "
+                f"worker(s) died, {survivors} survivor(s) < quorum "
+                f"{self._quorum_count(num_workers)}"
+            ) from exc
         log.info(
             f"parameter server done: {self.updates_applied} updates applied"
+            + (f", {self.degraded_rounds} degraded round(s), "
+               f"{len(tolerated)} worker(s) lost" if tolerated
+               or self.degraded_rounds else "")
         )
         return self.params
 
+    def _mark_dead(self, worker: int, exc: BaseException):
+        """Quorum mode: drop a dead worker from the rendezvous so later
+        rounds close over the survivors instead of timing out on a
+        corpse; if the in-flight round now has every live worker's
+        gradient, close it here."""
+        log.warning(
+            f"worker {worker} dropped from the sync rendezvous "
+            f"({type(exc).__name__}: {exc}); degrading to survivors"
+        )
+        with self._sync_cv:
+            self._dead.add(worker)
+            self._pending.pop(worker, None)
+            self._waiting.discard(worker)
+            live = self.comm.world_size - 1 - len(self._dead)
+            if self._pending and len(self._pending) >= max(1, live):
+                self._close_round()
+
     def _serve_worker(self, worker: int):
+        last_push_seq = None
         while True:
-            opcode, grads = protocol.recv_request(
+            opcode, grads, seq = protocol.recv_request(
                 self.comm, worker, self.num_params
             )
             if opcode == protocol.OP_DONE:
@@ -99,6 +156,19 @@ class ParameterServerMaster:
                     protocol.send_params(self.comm, worker, self.params)
                 continue
             # OP_PUSH
+            if seq == last_push_seq:
+                # a retried push whose ORIGINAL made it through but whose
+                # reply leg failed (resilience/retry.py retries the whole
+                # exchange): the gradient is already in an update - do
+                # not average it in twice, just resend current params
+                log.warning(
+                    f"worker {worker} re-sent push seq {seq}; replying "
+                    "with current params without re-applying"
+                )
+                with self.lock:
+                    protocol.send_params(self.comm, worker, self.params)
+                continue
+            last_push_seq = seq
             assert grads is not None and grads.size == self.num_params, (
                 f"worker {worker} pushed a malformed gradient"
             )
@@ -115,33 +185,76 @@ class ParameterServerMaster:
                     self.updates_applied += 1
                     protocol.send_params(self.comm, worker, self.params)
 
-    def _push_sync(self, worker: int, grads: np.ndarray):
-        """Gather one gradient per worker, average, apply once, release."""
-        num_workers = self.comm.world_size - 1
-        with self._sync_cv:
-            self._pending[worker] = grads
-            if len(self._pending) == num_workers:
-                mean_grad = np.mean(list(self._pending.values()), axis=0)
-                self.params = self.apply_update(mean_grad)
-                self.updates_applied += 1
-                self._pending.clear()
-                for w in list(self._waiting) + [worker]:
-                    protocol.send_params(self.comm, w, self.params)
-                self._waiting.clear()
-                self._sync_cv.notify_all()
-            else:
-                self._waiting.add(worker)
-                generation = self.updates_applied
-                completed = self._sync_cv.wait_for(
-                    lambda: self.updates_applied > generation,
-                    timeout=self.sync_timeout,
+    def _close_round(self):
+        """Average the gathered gradients, apply ONE update, reply to
+        every worker owed fresh params, wake the waiters.  Caller holds
+        the lock."""
+        mean_grad = np.mean(list(self._pending.values()), axis=0)
+        self.params = self.apply_update(mean_grad)
+        self.updates_applied += 1
+        for w in sorted(self._pending):
+            try:
+                protocol.send_params(self.comm, w, self.params)
+            except Exception as exc:
+                if self.quorum >= 1.0:
+                    raise
+                # a worker that died between push and reply: its service
+                # thread will also fail and _mark_dead it; do not let the
+                # broken reply socket kill the worker thread CLOSING the
+                # round on everyone else's behalf
+                log.warning(
+                    f"reply to worker {w} failed ({exc}); leaving it to "
+                    "the rendezvous death path"
                 )
-                if not completed:
-                    # a straggler never delivered: fail loudly instead of
-                    # silently proceeding with stale parameters
-                    raise RuntimeError(
-                        f"sync-mode round timed out after "
-                        f"{self.sync_timeout}s waiting on "
-                        f"{num_workers - len(self._pending)} missing "
-                        f"gradient(s) (worker {worker} was waiting)"
-                    )
+        self._pending.clear()
+        self._waiting.clear()
+        self._sync_cv.notify_all()
+
+    def _quorum_count(self, num_workers: int) -> int:
+        return max(1, math.ceil(self.quorum * num_workers))
+
+    def _push_sync(self, worker: int, grads: np.ndarray):
+        """Gather one gradient per worker, average, apply once, release.
+
+        On straggler timeout the round degrades to the configured quorum
+        (``quorum < 1`` and enough gradients arrived) or fails loudly
+        (strict mode, or not even a quorum delivered)."""
+        with self._sync_cv:
+            num_workers = self.comm.world_size - 1 - len(self._dead)
+            self._pending[worker] = grads
+            if len(self._pending) >= num_workers:
+                self._close_round()
+                return
+            self._waiting.add(worker)
+            generation = self.updates_applied
+            completed = self._sync_cv.wait_for(
+                lambda: self.updates_applied > generation,
+                timeout=self.sync_timeout,
+            )
+            if completed:
+                return
+            # wait_for re-checks under the lock, so exactly one waiter
+            # observes the still-open round and owns the timeout decision;
+            # later waiters see updates_applied advanced and return above
+            missing = num_workers - len(self._pending)
+            if self.quorum < 1.0 and len(self._pending) >= self._quorum_count(
+                num_workers
+            ):
+                self.degraded_rounds += 1
+                log.warning(
+                    f"sync round degraded to quorum: {len(self._pending)}/"
+                    f"{num_workers} gradient(s) after {self.sync_timeout}s "
+                    f"({missing} straggler(s)); applying partial average "
+                    f"(degraded rounds so far: {self.degraded_rounds})"
+                )
+                self._close_round()
+                return
+            # a straggler never delivered and no quorum covers it: fail
+            # loudly instead of silently proceeding with stale parameters
+            raise RuntimeError(
+                f"sync-mode round timed out after {self.sync_timeout}s "
+                f"waiting on {missing} missing gradient(s) (worker "
+                f"{worker} was waiting; quorum "
+                f"{self._quorum_count(num_workers)}/{num_workers} "
+                f"{'not met' if self.quorum < 1.0 else 'disabled'})"
+            )

@@ -14,6 +14,14 @@ Messages (worker -> master):
 Master replies to PULL/PUSH with the current flat parameter vector.  Loss
 stays local to the worker (shipping it per batch would force a host sync
 on the worker's device loss scalar for a value the master never needs).
+
+The header carries a per-worker SEQUENCE NUMBER so a retried exchange
+(``resilience/retry.py``: the worker re-runs the whole push when only the
+reply leg failed) is idempotent: the master detects a duplicate PUSH seq,
+skips the re-apply, and just resends current params - without it a
+lost-reply retry would average the same gradient into two consecutive
+updates.  float32 carries step counts exactly up to 2^24 (~16.7M steps
+per run, far past any schedule here).
 """
 
 from __future__ import annotations
@@ -25,11 +33,12 @@ OP_PUSH = 2
 OP_DONE = 3
 
 _HEADER_DTYPE = np.float32
-_HEADER_LEN = 1  # [opcode]
+_HEADER_LEN = 2  # [opcode, seq]
 
 
-def send_request(comm, opcode: int, grads: np.ndarray = None):
-    header = np.array([float(opcode)], dtype=_HEADER_DTYPE)
+def send_request(comm, opcode: int, grads: np.ndarray = None,
+                 seq: int = 0):
+    header = np.array([float(opcode), float(seq)], dtype=_HEADER_DTYPE)
     comm.send(0, header)
     if opcode == OP_PUSH:
         comm.send(0, grads.astype(np.float32, copy=False))
@@ -37,13 +46,14 @@ def send_request(comm, opcode: int, grads: np.ndarray = None):
 
 def recv_request(comm, worker: int, num_params: int):
     """Master side: receive one request from ``worker``.
-    Returns (opcode, grads-or-None)."""
+    Returns (opcode, grads-or-None, seq)."""
     header = comm.recv(worker, (_HEADER_LEN,), np.float32)
     opcode = int(header[0])
+    seq = int(header[1])
     grads = None
     if opcode == OP_PUSH:
         grads = comm.recv(worker, (num_params,), np.float32)
-    return opcode, grads
+    return opcode, grads, seq
 
 
 def send_params(comm, worker: int, flat_params: np.ndarray):

@@ -80,12 +80,25 @@ def run_master(args):
     )
     try:
         master = ParameterServerMaster(
-            comm, flat, apply_update, sync_mode=(args.ps_mode == "sync")
+            comm, flat, apply_update, sync_mode=(args.ps_mode == "sync"),
+            sync_timeout=getattr(args, "ps_sync_timeout", 300.0),
+            quorum=getattr(args, "ps_quorum", 1.0),
         )
         final = master.serve()
     finally:
         comm.close()
     return final
+
+
+def _worker_faults(args, rank: int | None = None):
+    """The worker-side chaos schedule (``--faults`` / ``PDRNN_CHAOS``),
+    bound to the worker's rank so ``@rank``-qualified events (preempt
+    ONE worker) fire in the right process.  Network events ride the
+    ``PDRNN_FAULT_*`` env, exported both here and by :func:`run` before
+    spawning (children inherit it)."""
+    from pytorch_distributed_rnn_tpu.resilience import FaultSchedule
+
+    return FaultSchedule.resolve(args, rank=rank)
 
 
 def run_worker(args, rank: int):
@@ -125,6 +138,8 @@ def run_worker(args, rank: int):
             checkpoint_format=getattr(args, "checkpoint_format",
                                       "gathered"),
             checkpoint_async=getattr(args, "checkpoint_async", False),
+            transport_retries=getattr(args, "ps_transport_retries", 3),
+            faults=_worker_faults(args, rank),
         )
         _, train_history, _ = trainer.train(epochs=args.epochs)
         trainer.finish()
@@ -154,6 +169,23 @@ def _spawn_entry(args, rank):
 def run(args):
     if args.world_size < 2:
         raise SystemExit("parameter-server needs --world-size >= 2")
+    if getattr(args, "max_bad_steps", 0):
+        # loud, not silent: the optimizer that applies updates lives on
+        # the master, so a worker-side apply_if_finite wrap would never
+        # see an update - the master's finite-gradient assertion (and,
+        # under --ps-quorum < 1, dropping the offending worker) is the
+        # PS-side integrity story
+        log.warning(
+            "--max-bad-steps has no effect under the parameter-server "
+            "strategy: the master asserts gradient integrity per push "
+            "instead (quorum mode drops a worker whose pushes fail)"
+        )
+    # bridge the chaos schedule's net events onto the transport's
+    # PDRNN_FAULT_* contract BEFORE any communicator (or spawned child,
+    # which inherits the env) is constructed
+    faults = _worker_faults(args)
+    if faults is not None:
+        faults.export_network()
     if args.rank is not None:
         # one role per invocation (multi-node layout)
         if args.rank == 0:
@@ -170,7 +202,32 @@ def run(args):
         p.start()
     for p in procs:
         p.join()
-    failed = [p.exitcode for p in procs if p.exitcode != 0]
+    failed = {rank: p.exitcode for rank, p in enumerate(procs)
+              if p.exitcode != 0}
     if failed:
-        raise SystemExit(f"parameter-server processes failed: {failed}")
+        # quorum-degraded sync mode tolerates preempted WORKERS at the
+        # process level too, mirroring the master's in-run policy: the
+        # run succeeded if the master finished (it enforced quorum on
+        # every round) and a quorum of workers completed
+        import math
+
+        quorum = getattr(args, "ps_quorum", 1.0)
+        num_workers = args.world_size - 1
+        survivors = num_workers - sum(1 for r in failed if r >= 1)
+        if (
+            args.ps_mode == "sync"
+            and quorum < 1.0
+            and 0 not in failed
+            and survivors >= max(1, math.ceil(quorum * num_workers))
+        ):
+            log.warning(
+                f"parameter-server run degraded: worker process(es) "
+                f"{sorted(failed)} died ({failed}), {survivors}/"
+                f"{num_workers} workers completed (quorum held)"
+            )
+            return 0
+        raise SystemExit(
+            f"parameter-server processes failed: "
+            f"{sorted(failed.values())}"
+        )
     return 0

@@ -23,6 +23,7 @@ from jax.flatten_util import ravel_pytree
 
 from pytorch_distributed_rnn_tpu.data.sampler import DistributedSampler
 from pytorch_distributed_rnn_tpu.param_server import protocol
+from pytorch_distributed_rnn_tpu.resilience.retry import retry_transport
 from pytorch_distributed_rnn_tpu.training.base import Trainer
 from pytorch_distributed_rnn_tpu.training.formatter import TrainingMessageFormatter
 
@@ -51,6 +52,12 @@ class ParameterServerWorkerTrainer(Trainer):
         fuse_run: bool = False,
         checkpoint_format: str = "gathered",
         checkpoint_async: bool = False,
+        transport_retries: int = 3,
+        # resilience knobs; on PS workers only `faults` is meaningful
+        # (checkpointing is disabled here, and the optimizer that applies
+        # updates lives on the MASTER, whose finite-gradient assertion is
+        # the PS-side integrity guard)
+        **kwargs,
     ):
         sampler = DistributedSampler(
             len(training_set),
@@ -63,6 +70,7 @@ class ParameterServerWorkerTrainer(Trainer):
             training_set=training_set,
             # global-batch semantics: each worker loads its share
             batch_size=max(1, batch_size // num_workers),
+            **kwargs,
             learning_rate=learning_rate,
             validation_set=None,  # eval disabled on PS workers (reference parity)
             test_set=None,
@@ -83,13 +91,36 @@ class ParameterServerWorkerTrainer(Trainer):
         self.comm = comm
         self.worker_rank = worker_rank
         self.num_workers = num_workers
+        # transient transport errors (injected faults, preemptible
+        # networks) retry with exponential backoff + jitter seeded by the
+        # rank, so workers decorrelate their retry storms while a chaos
+        # run stays reproducible
+        self._transport_retries = int(transport_retries)
+        # per-step push sequence number: a RETRY re-sends the same seq,
+        # so the master can detect a duplicate (reply leg failed after
+        # the update applied) and not average the gradient in twice
+        self._push_seq = 0
         flat, self._unravel = ravel_pytree(self.params)
         self.num_params = int(flat.size)
 
         # initial pull: adopt the master's authoritative parameters
         # (hvd.broadcast_parameters / DDP-wrap analogue for the PS world)
+        self._adopt(self._exchange(self._pull_params, what="initial pull"))
+
+    def _pull_params(self):
         protocol.send_request(self.comm, protocol.OP_PULL)
-        self._adopt(protocol.recv_params(self.comm, self.num_params))
+        return protocol.recv_params(self.comm, self.num_params)
+
+    def _exchange(self, fn, what: str):
+        """One protocol exchange under the retry policy.  An exchange is
+        retried WHOLE (request + reply); safe for pushes because the
+        header's per-step sequence number lets the master detect a
+        duplicate (original applied, reply leg lost) and resend params
+        without averaging the gradient in twice."""
+        return retry_transport(
+            fn, retries=self._transport_retries, seed=self.worker_rank,
+            what=f"{what} (worker {self.worker_rank})",
+        )
 
     def _adopt(self, flat_params: np.ndarray):
         assert flat_params.size == self.num_params, "parameter size mismatch"
@@ -108,13 +139,21 @@ class ParameterServerWorkerTrainer(Trainer):
             jax.value_and_grad(self._loss_and_metrics, has_aux=True)
         )
 
+        def push_pull(flat_grads, seq):
+            protocol.send_request(
+                self.comm, protocol.OP_PUSH, grads=flat_grads, seq=seq
+            )
+            return protocol.recv_params(self.comm, self.num_params)
+
         def step(params, opt_state, batch, *extra):
             (loss, metrics), grads = grad_fn(params, batch, *extra)
             flat_grads, _ = ravel_pytree(grads)
-            protocol.send_request(
-                self.comm, protocol.OP_PUSH, grads=np.asarray(flat_grads)
+            flat_grads = np.asarray(flat_grads)
+            self._push_seq += 1  # once per STEP; retries re-send the same
+            seq = self._push_seq
+            new_flat = self._exchange(
+                lambda: push_pull(flat_grads, seq), what="gradient push"
             )
-            new_flat = protocol.recv_params(self.comm, self.num_params)
             self._adopt(new_flat)
             return self.params, opt_state, loss, metrics
 

@@ -1,0 +1,44 @@
+"""Resilience layer: deterministic chaos injection and the hardening
+that makes the training stack survive it.
+
+The reference cluster treated failure as a benchmark axis - its fabfile
+wrapped every run in ``tc netem`` delay/loss (SURVEY §L4) - but never
+implemented the recovery half: checkpoints were write-only, a straggler
+killed the run.  This package supplies both sides:
+
+- ``faults``: a seedable :class:`FaultSchedule` (``--faults`` /
+  ``PDRNN_CHAOS``) that injects data-loader stalls/exceptions, non-finite
+  gradients, simulated preemption (SIGKILL), and network delay/loss -
+  the latter bridged onto the native transport's ``PDRNN_FAULT_*``
+  contract so the bench netem sweep and the chaos tests share one
+  mechanism.
+- ``guard``: the :class:`NonFiniteGuard` (XLA-level skip of non-finite
+  updates, host-level abort after K consecutive bad steps) and
+  checkpoint auto-resume with fallback across corrupt files.
+- ``retry``: exponential backoff with deterministic jitter for
+  transport-level operations (the parameter-server worker's push/pull).
+"""
+
+from pytorch_distributed_rnn_tpu.resilience.faults import (
+    ChaosError,
+    FaultEvent,
+    FaultSchedule,
+    fault_env,
+)
+from pytorch_distributed_rnn_tpu.resilience.guard import (
+    NonFiniteAbort,
+    NonFiniteGuard,
+    resume_latest,
+)
+from pytorch_distributed_rnn_tpu.resilience.retry import retry_transport
+
+__all__ = [
+    "ChaosError",
+    "FaultEvent",
+    "FaultSchedule",
+    "fault_env",
+    "NonFiniteAbort",
+    "NonFiniteGuard",
+    "resume_latest",
+    "retry_transport",
+]

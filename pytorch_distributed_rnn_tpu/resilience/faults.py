@@ -1,0 +1,306 @@
+"""Deterministic, seedable fault-injection schedules.
+
+The reference benchmarked under injected network faults (``tc netem``
+delay 0-400 ms / loss 0-15 % around every run, fabfile.py:130-191); the
+TPU port reproduces that half through the native transport's
+``PDRNN_FAULT_DELAY_MS`` / ``PDRNN_FAULT_LOSS_PROB`` env contract
+(``runtime/native.py``).  A :class:`FaultSchedule` extends the same idea
+to the rest of the stack - data pipeline, gradients, process lifetime -
+with triggers addressed to exact steps/epochs (or seeded per-step
+probabilities), so a chaos run is exactly reproducible.
+
+Spec grammar (``--faults`` flag / ``PDRNN_CHAOS`` env)::
+
+    event[,event...]
+    event := step:<n>:<action>[:<arg>]      fire at optimizer step n (0-based,
+                                            run-relative)
+           | epoch:<n>:<action>[:<arg>]     fire at the start of epoch n
+           | prob:<p>:<action>[:<arg>]      fire each step with probability p
+                                            (seeded, per-step deterministic)
+           | net:delay:<ms>                 transport delay (PDRNN_FAULT_* bridge)
+           | net:loss:<prob>                transport loss (PDRNN_FAULT_* bridge)
+           | seed:<int>                     RNG seed for prob events (default 0)
+    action := nan                           corrupt the step's batch to NaN
+                                            (non-finite grads; pairs with the
+                                            NonFiniteGuard skip path)
+            | stall[:<seconds>]             data-loader stall (default 0.25 s)
+            | exc                           data-loader exception (ChaosError)
+            | kill                          SIGKILL this process (simulated
+                                            preemption; pairs with --resume auto)
+
+An event may carry an ``@<rank>`` suffix (``epoch:1:kill@2``): it then
+fires only in the process bound to that rank via :meth:`FaultSchedule.
+for_rank` (the parameter-server runner binds each worker's rank), so a
+multi-process chaos run can preempt ONE worker while the rest survive.
+Unsuffixed events fire everywhere.
+
+Example: ``step:3:nan,step:7:stall:0.5,epoch:2:kill@1,net:delay:100,seed:7``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import signal
+import time
+from dataclasses import dataclass
+
+log = logging.getLogger(__name__)
+
+CHAOS_ENV = "PDRNN_CHAOS"
+# the native transport's netem-analogue contract (runtime/native.py reads
+# these at Communicator construction; launcher/commands.py exports them
+# around benchmark runs) - the ONE mechanism chaos and bench share
+FAULT_DELAY_ENV = "PDRNN_FAULT_DELAY_MS"
+FAULT_LOSS_ENV = "PDRNN_FAULT_LOSS_PROB"
+
+_ACTIONS = ("nan", "stall", "exc", "kill")
+_TRIGGERS = ("step", "epoch", "prob")
+_DEFAULT_STALL_S = 0.25
+
+
+class ChaosError(RuntimeError):
+    """An injected data-pipeline failure (the ``exc`` action)."""
+
+
+def fault_env(fault_type: str | None, fault_value: float) -> dict[str, str]:
+    """The ``PDRNN_FAULT_*`` env for one netem-analogue rule - shared by
+    the bench sweep's command synthesis and :meth:`FaultSchedule.network_env`
+    so the two can never drift apart."""
+    if not fault_type or not fault_value:
+        return {}
+    if fault_type == "delay":
+        return {FAULT_DELAY_ENV: str(fault_value)}
+    if fault_type == "loss":
+        return {FAULT_LOSS_ENV: str(fault_value)}
+    raise ValueError(f"unknown fault type {fault_type!r} (delay|loss)")
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    """One scheduled fault: ``trigger`` addresses when, ``action`` what."""
+
+    trigger: str  # step | epoch | prob
+    at: float  # step/epoch index, or probability for prob triggers
+    action: str  # nan | stall | exc | kill
+    arg: float | None = None  # stall seconds
+    rank: int | None = None  # only fire in the process bound to this rank
+
+    def __str__(self):
+        base = f"{self.trigger}:{self.at:g}:{self.action}"
+        if self.arg is not None:
+            base += f":{self.arg:g}"
+        if self.rank is not None:
+            base += f"@{self.rank}"
+        return base
+
+
+class FaultSchedule:
+    """A parsed chaos spec; owns trigger matching and action execution.
+
+    Deterministic by construction: step/epoch triggers are exact
+    addresses, and ``prob`` triggers draw from ``random.Random((seed,
+    step, event_index))`` - stateless per (step, event), so concurrent
+    queries from the producer thread and the consumer loop cannot
+    reorder draws.
+    """
+
+    def __init__(self, events: list[FaultEvent], network=(), seed: int = 0,
+                 rank: int | None = None):
+        for e in events:
+            if e.trigger not in _TRIGGERS:
+                raise ValueError(f"unknown trigger {e.trigger!r}")
+            if e.action not in _ACTIONS:
+                raise ValueError(f"unknown action {e.action!r}")
+        self.events = tuple(events)
+        self.network = tuple(network)  # ((type, value), ...)
+        self.seed = int(seed)
+        # the process's rank for @rank-qualified events: None (unbound)
+        # fires only unqualified events
+        self.rank = rank
+        # observability: {action: count} of faults actually fired
+        self.fired: dict[str, int] = {}
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultSchedule":
+        events, network = [], []
+        seed = 0
+        for raw in str(spec).split(","):
+            part = raw.strip()
+            if not part:
+                continue
+            body, _, rank_s = part.partition("@")
+            fields = body.split(":")
+            kind = fields[0]
+            try:
+                rank = int(rank_s) if rank_s else None
+                if kind == "seed":
+                    (seed,) = fields[1:]
+                    seed = int(seed)
+                elif kind == "net":
+                    _, net_type, net_value = fields
+                    fault_env(net_type, float(net_value) or 1e-9)  # validate
+                    network.append((net_type, float(net_value)))
+                elif kind in _TRIGGERS:
+                    at = float(fields[1])
+                    action = fields[2]
+                    arg = float(fields[3]) if len(fields) > 3 else None
+                    if action == "stall" and arg is None:
+                        arg = _DEFAULT_STALL_S
+                    events.append(FaultEvent(kind, at, action, arg, rank))
+                else:
+                    raise ValueError(f"unknown trigger {kind!r}")
+            except (IndexError, ValueError) as exc:
+                raise ValueError(
+                    f"bad fault event {part!r} in spec {spec!r}: {exc}"
+                ) from exc
+        return cls(events, network, seed)
+
+    @classmethod
+    def from_env(cls, env=None) -> "FaultSchedule | None":
+        """The ``PDRNN_CHAOS`` contract: a schedule for every run in the
+        process, without touching the CLI (how the chaos CI job and the
+        bench harness inject)."""
+        spec = (env if env is not None else os.environ).get(CHAOS_ENV)
+        return cls.parse(spec) if spec else None
+
+    @classmethod
+    def resolve(cls, args, rank: int | None = None) -> "FaultSchedule | None":
+        """The ONE CLI resolution path (``--faults`` flag beats the
+        ``PDRNN_CHAOS`` env), shared by every strategy entry point so a
+        flag can never be silently dropped by one of them: binds the
+        rank (for ``@rank`` events) and exports net events onto the
+        transport contract as a side effect."""
+        spec = getattr(args, "faults", None)
+        faults = cls.parse(spec) if spec else cls.from_env()
+        if faults is None:
+            return None
+        if rank is not None:
+            faults = faults.for_rank(rank)
+        faults.export_network()
+        return faults
+
+    def __str__(self):
+        parts = [str(e) for e in self.events]
+        parts += [f"net:{t}:{v:g}" for t, v in self.network]
+        if self.seed:
+            parts.append(f"seed:{self.seed}")
+        return ",".join(parts)
+
+    # -- network bridge ------------------------------------------------------
+
+    def network_env(self) -> dict[str, str]:
+        """``PDRNN_FAULT_*`` vars for this schedule's net events."""
+        env: dict[str, str] = {}
+        for net_type, value in self.network:
+            env.update(fault_env(net_type, value))
+        return env
+
+    def export_network(self, env=None):
+        """Export net events into ``env`` (default ``os.environ``) so
+        communicators constructed after this point - including ones in
+        spawned child processes - pick the faults up."""
+        target = os.environ if env is None else env
+        for key, value in self.network_env().items():
+            target[key] = value
+
+    # -- rank binding --------------------------------------------------------
+
+    def for_rank(self, rank: int) -> "FaultSchedule":
+        """Bind the schedule to one process's rank so ``@rank``-qualified
+        events can fire there (the parameter-server runner binds each
+        worker).  Counters are fresh - each process owns its own."""
+        bound = FaultSchedule(list(self.events), self.network, self.seed,
+                              rank=int(rank))
+        return bound
+
+    # -- trigger matching ----------------------------------------------------
+
+    @property
+    def has_step_events(self) -> bool:
+        return any(
+            e.trigger in ("step", "prob") for e in self.events
+            if e.rank is None or e.rank == self.rank
+        )
+
+    def _matches(self, trigger_kinds, index: int):
+        for i, e in enumerate(self.events):
+            if e.rank is not None and e.rank != self.rank:
+                continue
+            if e.trigger in ("step", "epoch") and e.trigger in trigger_kinds:
+                if int(e.at) == index:
+                    yield e
+            elif e.trigger == "prob" and "prob" in trigger_kinds:
+                # stateless integer mix (NOT a shared RNG stream): the
+                # draw for (seed, step, event) is the same whatever order
+                # the producer thread and consumer loop ask in
+                mixed = (self.seed * 1_000_003 + index) * 1_000_003 + i
+                if random.Random(mixed).random() < e.at:
+                    yield e
+
+    def _fire(self, event: FaultEvent, where: str):
+        self.fired[event.action] = self.fired.get(event.action, 0) + 1
+        log.warning(f"chaos: injecting {event} at {where}")
+
+    # -- action execution ----------------------------------------------------
+
+    def on_producer_item(self, step: int):
+        """Data-pipeline faults for the batch feeding step ``step`` -
+        called in the loader/prefetch PRODUCER so stalls and exceptions
+        originate where real loader failures do (and must propagate
+        through the prefetch thread to the consumer)."""
+        for e in self._matches(("step", "prob"), step):
+            if e.action == "stall":
+                self._fire(e, f"loader step {step}")
+                time.sleep(e.arg or _DEFAULT_STALL_S)
+            elif e.action == "exc":
+                self._fire(e, f"loader step {step}")
+                raise ChaosError(
+                    f"injected data-loader failure at step {step} ({e})"
+                )
+
+    def corrupt_batch(self, step: int, batch):
+        """Non-finite-gradient injection: replace step ``step``'s features
+        with NaN (NaN activations -> NaN loss -> NaN grads), exercising
+        the NonFiniteGuard skip path end to end."""
+        for e in self._matches(("step", "prob"), step):
+            if e.action == "nan":
+                self._fire(e, f"step {step}")
+                import jax.numpy as jnp
+
+                features, labels = batch
+                return jnp.full_like(features, jnp.nan), labels
+        return batch
+
+    def maybe_kill(self, *, step: int | None = None,
+                   epoch: int | None = None):
+        """Simulated preemption: SIGKILL this process at the addressed
+        step/epoch - no cleanup, no atexit, exactly like a preempted VM.
+        Epoch triggers fire at epoch START (work since the last
+        checkpoint is lost, the case auto-resume exists for)."""
+        if step is not None:
+            events = [e for e in self._matches(("step", "prob"), step)
+                      if e.action == "kill"]
+            where = f"step {step}"
+        else:
+            events = [e for e in self._matches(("epoch",), epoch)
+                      if e.action == "kill"]
+            where = f"epoch {epoch}"
+        for e in events:
+            self._fire(e, where)
+            logging.shutdown()  # flush handlers; SIGKILL won't
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def on_epoch_start(self, epoch: int):
+        """Epoch-granularity faults (kill/stall/exc; nan is per-step)."""
+        self.maybe_kill(epoch=epoch)
+        for e in self._matches(("epoch",), epoch):
+            if e.action == "stall":
+                self._fire(e, f"epoch {epoch}")
+                time.sleep(e.arg or _DEFAULT_STALL_S)
+            elif e.action == "exc":
+                self._fire(e, f"epoch {epoch}")
+                raise ChaosError(f"injected failure at epoch {epoch} ({e})")

@@ -1,0 +1,54 @@
+"""Exponential backoff with deterministic jitter for transport calls.
+
+The parameter-server worker's push/pull rides the native TCP transport;
+under injected faults (and on real preemptible clusters) an exchange can
+fail transiently.  ``retry_transport`` re-runs the exchange with
+exponential backoff plus seeded jitter - deterministic for a given
+(seed, attempt), so chaos runs replay exactly, while distinct workers
+(distinct seeds) still decorrelate their retry storms.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import time
+
+log = logging.getLogger(__name__)
+
+
+def backoff_delays(retries: int, base_delay: float = 0.05,
+                   max_delay: float = 2.0, seed: int = 0):
+    """The retry sleep sequence: ``base * 2**attempt`` capped at
+    ``max_delay``, plus up to 50 % seeded jitter."""
+    rng = random.Random(seed)
+    return [
+        min(base_delay * (2 ** attempt), max_delay) * (1.0 + 0.5 * rng.random())
+        for attempt in range(retries)
+    ]
+
+
+def retry_transport(fn, *, retries: int = 3, base_delay: float = 0.05,
+                    max_delay: float = 2.0, seed: int = 0,
+                    retryable=(RuntimeError, OSError), what: str = "exchange",
+                    sleep=time.sleep):
+    """Run ``fn()``; on a retryable transport error, back off and re-run.
+
+    Raises the FIRST error (the diagnostic one, matching the trainer's
+    compile-retry convention) once ``retries`` re-attempts are exhausted.
+    """
+    delays = backoff_delays(retries, base_delay, max_delay, seed)
+    first_exc = None
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except retryable as exc:
+            first_exc = first_exc or exc
+            if attempt == retries:
+                raise first_exc
+            delay = delays[attempt]
+            log.warning(
+                f"transport {what} failed ({type(exc).__name__}: {exc}); "
+                f"retry {attempt + 1}/{retries} in {delay:.3f}s"
+            )
+            sleep(delay)

@@ -143,6 +143,14 @@ def _log_and_trim_datasets(args, training_set, validation_set, test_set):
 def _run_trainer(args, trainer_class, model, datasets):
     """The strategy-independent tail of every CLI run: construct, resume,
     (optionally trace,) train, dump rank-0 history."""
+    from pytorch_distributed_rnn_tpu.resilience import FaultSchedule
+
+    # resolve() also bridges net events onto the transport's
+    # PDRNN_FAULT_* contract before any communicator is constructed
+    faults = FaultSchedule.resolve(args)
+    if faults is not None:
+        logging.warning(f"chaos schedule active: {faults}")
+
     training_set, validation_set, test_set = datasets
     trainer = trainer_class(
         model=model,
@@ -158,11 +166,26 @@ def _run_trainer(args, trainer_class, model, datasets):
         fuse_run=getattr(args, "fuse_run", False),
         checkpoint_format=getattr(args, "checkpoint_format", "gathered"),
         checkpoint_async=getattr(args, "checkpoint_async", False),
+        faults=faults,
+        max_bad_steps=getattr(args, "max_bad_steps", 0),
+        keep_checkpoints=getattr(args, "keep_checkpoints", 0),
     )
 
-    if getattr(args, "resume", None):
-        meta = trainer.resume_from(args.resume)
-        logging.info(f"Resumed from {args.resume} at epoch {meta['epoch']}")
+    resume = getattr(args, "resume", None)
+    if resume is not None and str(resume) == "auto":
+        # crash-restart contract: newest VALID checkpoint wins, corrupt
+        # files fall back to the previous one, none = fresh start
+        from pytorch_distributed_rnn_tpu.resilience import resume_latest
+
+        meta = resume_latest(trainer, args.checkpoint_directory)
+        if meta is None:
+            logging.info(
+                "--resume auto: no usable checkpoint in "
+                f"{args.checkpoint_directory}; starting fresh"
+            )
+    elif resume:
+        meta = trainer.resume_from(resume)
+        logging.info(f"Resumed from {resume} at epoch {meta['epoch']}")
 
     logging.info(f"Training model for {args.epochs} epochs...")
     import contextlib

@@ -34,8 +34,10 @@ from pytorch_distributed_rnn_tpu.data.loader import DataLoader
 from pytorch_distributed_rnn_tpu.data.prefetch import prefetch
 from pytorch_distributed_rnn_tpu.data.sampler import DistributedSampler
 from pytorch_distributed_rnn_tpu.ops.losses import cross_entropy_loss
+from pytorch_distributed_rnn_tpu.resilience.guard import NonFiniteGuard
 from pytorch_distributed_rnn_tpu.training.checkpoint import (
     load_checkpoint,
+    rotate_checkpoints,
     save_checkpoint,
 )
 from pytorch_distributed_rnn_tpu.training.formatter import TrainingMessageFormatter
@@ -90,6 +92,9 @@ class Trainer:
         fuse_run: bool = False,
         checkpoint_format: str = "gathered",
         checkpoint_async: bool = False,
+        faults=None,
+        max_bad_steps: int = 0,
+        keep_checkpoints: int = 0,
     ):
         self.model = model
         # gathered: the reference-parity single file (training/
@@ -122,6 +127,18 @@ class Trainer:
         # periodic epoch checkpoints (checkpoint-epoch-N.ckpt) in addition
         # to best-model.ckpt; 0 = best-only (reference trigger, base.py:88-91)
         self.checkpoint_every = int(checkpoint_every or 0)
+        # rotation: keep only the newest N epoch checkpoints (0 = keep all;
+        # best-model.ckpt is never rotated) - resilience/guard.py auto-resume
+        # walks whatever survives, newest first
+        self.keep_checkpoints = int(keep_checkpoints or 0)
+        # chaos harness (resilience/faults.py): a FaultSchedule whose
+        # step-granularity events force the per-batch host loop so faults
+        # can address individual optimizer steps
+        self._faults = faults
+        # non-finite-step guard (resilience/guard.py): with K > 0 the
+        # optimizer is wrapped so NaN/Inf-gradient steps are skipped inside
+        # the compiled program and the host aborts past K consecutive
+        self.guard = NonFiniteGuard(max_bad_steps) if max_bad_steps else None
         self.rank = 0
         self.world_size = 1
 
@@ -159,6 +176,8 @@ class Trainer:
 
         self.params = model.init(jax.random.PRNGKey(seed if seed is not None else 0))
         self.optimizer = self._get_optimizer(learning_rate)
+        if self.guard is not None:
+            self.optimizer = self.guard.wrap(self.optimizer)
         self.opt_state = self.optimizer.init(self.params)
 
         # train-mode dropout: real here, unlike the reference's dead
@@ -180,6 +199,12 @@ class Trainer:
         self._eval_data_cache = {}
         self._resume_best_loss = None
         self._epoch = 0
+        # auto-resume: epochs [0, _start_epoch) are already banked in the
+        # restored checkpoint; train() continues from there
+        self._start_epoch = 0
+        # run-relative optimizer-step counter - the address space for the
+        # fault schedule's step triggers
+        self._steps_done = 0
 
     # -- subclass hooks ------------------------------------------------------
 
@@ -536,6 +561,7 @@ class Trainer:
         formatter = self._get_formatter(epochs)
         first_exc: Exception | None = None
         retries = 0
+        self._steps_done = 0  # fault-schedule step addresses are run-relative
         while True:
             # identity snapshot: every completed device program
             # reassigns self.params, so `is` detects ANY training
@@ -589,6 +615,13 @@ class Trainer:
                 self._run_fn = None
 
         logging.info(formatter.performance_message(memory, duration))
+        if self.guard is not None and self.guard.total_skipped:
+            logging.info(
+                f"non-finite guard: skipped {self.guard.total_skipped} "
+                "bad step(s); training continued"
+            )
+        if self._faults is not None and self._faults.fired:
+            logging.info(f"chaos: faults fired {self._faults.fired}")
 
         if self.test_set is not None:
             self._evaluate(self.test_set, formatter)
@@ -600,7 +633,7 @@ class Trainer:
         """One full training attempt; returns ``(memory, duration)``.
         Split out of :meth:`train` so a compile-stage failure can fall
         back to grad accumulation and re-enter with rebuilt programs."""
-        if self.DEVICE_DATA:
+        if self.DEVICE_DATA and not self._chaos_host_loop():
             if self._idx_step_fn is None:
                 self._idx_step_fn = self._build_idx_train_step()
             if self._epoch_fn is None:
@@ -627,6 +660,10 @@ class Trainer:
             # the fused run's weighted loss (per-example mask) is not
             # expressible as equal-microbatch accumulation
             and self.grad_accum == 1
+            # chaos injection and epoch-offset resume both need the host
+            # at epoch (or step) boundaries
+            and self._faults is None
+            and self._start_epoch == 0
         )
         if self._fuse_run and not fusable:
             # the user explicitly asked for one-program training; falling
@@ -635,8 +672,9 @@ class Trainer:
             raise ValueError(
                 "--fuse-run needs a run with no host work between epochs: "
                 "device-resident data, --no-validation, no "
-                "--checkpoint-every, --grad-accum 1, and (with dropout) a "
-                "batch size dividing the training set"
+                "--checkpoint-every, --grad-accum 1, no --faults schedule "
+                "or epoch-offset resume, and (with dropout) a batch size "
+                "dividing the training set"
             )
         fused_run = fusable and (
             self._fuse_run
@@ -651,7 +689,9 @@ class Trainer:
             # worse post-resume epoch cannot clobber best-model.ckpt
             best_loss = self._resume_best_loss
             try:
-                for epoch in range(epochs):
+                for epoch in range(self._start_epoch, epochs):
+                    if self._faults is not None:
+                        self._faults.on_epoch_start(epoch)
                     self.sampler.set_epoch(epoch)
                     self._epoch = epoch
                     logging.info(formatter.epoch_start_message(epoch))
@@ -717,12 +757,25 @@ class Trainer:
             self.params, self.opt_state, features, labels, idx_mat, w_mat,
             *extra,
         )
+        # the fused run's ONE host visit: the guard decides here - the
+        # in-program apply_if_finite already rejected every non-finite
+        # update, so the late check only delays the abort, never
+        # corrupts state
+        if self.guard is not None:
+            self.guard.check(self.opt_state)
         losses = np.asarray(losses).reshape(epochs, num_batches)
         n = len(self.training_set)
         return [float(losses[e].sum()) / n for e in range(epochs)]
 
+    def _chaos_host_loop(self) -> bool:
+        """Whether an attached fault schedule forces the per-batch host
+        loop: step-addressed faults (NaN injection, per-step kill/stall)
+        need the host between optimizer steps, which the scanned
+        device-resident programs by design do not visit."""
+        return self._faults is not None and self._faults.has_step_events
+
     def _train_epoch(self, formatter):
-        if not self.DEVICE_DATA:
+        if not self.DEVICE_DATA or self._chaos_host_loop():
             return self._train_epoch_host(formatter)
 
         # per-batch progress moved INFO -> DEBUG (conscious fix, PARITY.md):
@@ -799,6 +852,10 @@ class Trainer:
         # parity quirk kept: sum of batch-mean losses / dataset size
         train_loss = total_loss / len(self.training_set)
         train_acc = total_correct / len(self.training_set)
+        # scanned paths visit the host once per epoch, so the non-finite
+        # guard decides here (updates were already skipped in-program)
+        if self.guard is not None:
+            self.guard.check(self.opt_state)
         return train_loss, train_acc
 
     # host-path input pipeline: how many prepared batches ride ahead of
@@ -826,10 +883,19 @@ class Trainer:
             if self._dropout > 0.0
             else None
         )
-        stream = prefetch(
-            (self._prepare_batch(f, l) for f, l in loader),
-            depth=self.PREFETCH_DEPTH,
-        )
+        faults = self._faults
+        epoch_base = self._steps_done  # run-relative fault addresses
+
+        def source():
+            for i, (f, l) in enumerate(loader):
+                if faults is not None:
+                    # loader-side faults (stall/exception) originate in
+                    # the PRODUCER - a real loader failure's position -
+                    # and must cross the prefetch thread to the consumer
+                    faults.on_producer_item(epoch_base + i)
+                yield self._prepare_batch(f, l)
+
+        stream = prefetch(source(), depth=self.PREFETCH_DEPTH)
         # device-scalar accumulators, fetched after the loop: the
         # programs' loss/metrics outputs are replicated over the
         # (possibly multi-process) mesh, so a post-loop fetch is legal on
@@ -837,35 +903,52 @@ class Trainer:
         # zero could land the sum on a device other controllers cannot
         # address
         losses, corrects = [], []
-        for batch_idx, batch in enumerate(stream):
-            extra = (keys[batch_idx],) if keys is not None else ()
-            self.params, self.opt_state, loss, metrics = self._train_step_fn(
-                self.params, self.opt_state, batch, *extra
-            )
-            if log_progress:
-                # the progress message needs the values NOW - accumulate
-                # the already-fetched floats instead of re-fetching at
-                # epoch end
-                losses.append(float(loss))
-                corrects.append(float(metrics["correct"]))
-                logging.debug(
-                    formatter.train_progress_message(
-                        batch_idx=batch_idx,
-                        batches=num_batches,
-                        training_examples=len(batch[0]),
-                        correct=_correct_count(corrects[-1]),
-                        loss=losses[-1],
-                    )
+        try:
+            for batch_idx, batch in enumerate(stream):
+                step = epoch_base + batch_idx
+                if faults is not None:
+                    faults.maybe_kill(step=step)
+                    batch = faults.corrupt_batch(step, batch)
+                extra = (keys[batch_idx],) if keys is not None else ()
+                self.params, self.opt_state, loss, metrics = self._train_step_fn(
+                    self.params, self.opt_state, batch, *extra
                 )
-            else:
-                losses.append(loss)
-                corrects.append(metrics["correct"])
+                self._steps_done = step + 1
+                if self.guard is not None and faults is not None:
+                    # chaos runs are per-batch already; deciding per step
+                    # costs one counter fetch and aborts K+1 steps after
+                    # divergence starts instead of at epoch end
+                    self.guard.check(self.opt_state)
+                if log_progress:
+                    # the progress message needs the values NOW - accumulate
+                    # the already-fetched floats instead of re-fetching at
+                    # epoch end
+                    losses.append(float(loss))
+                    corrects.append(float(metrics["correct"]))
+                    logging.debug(
+                        formatter.train_progress_message(
+                            batch_idx=batch_idx,
+                            batches=num_batches,
+                            training_examples=len(batch[0]),
+                            correct=_correct_count(corrects[-1]),
+                            loss=losses[-1],
+                        )
+                    )
+                else:
+                    losses.append(loss)
+                    corrects.append(metrics["correct"])
+        finally:
+            # an early exit (injected exception, guard abort) must not
+            # leave the prefetch producer thread running behind us
+            stream.close()
 
         total_loss = sum(float(l) for l in losses)
         total_correct = sum(float(c) for c in corrects)
         # parity quirk kept: sum of batch-mean losses / dataset size
         train_loss = total_loss / len(self.training_set)
         train_acc = total_correct / len(self.training_set)
+        if self.guard is not None:
+            self.guard.check(self.opt_state)
         return train_loss, train_acc
 
     def _evaluate(self, dataset, formatter, epoch=None):
@@ -934,6 +1017,11 @@ class Trainer:
         save_checkpoint(
             self.checkpoint_dir, epoch, params, opt_state, loss, best=best
         )
+        if not best and self.keep_checkpoints:
+            # rotation only ever DELETES strictly-older epoch files, so
+            # running it after each periodic write keeps exactly the
+            # newest N without touching best-model.ckpt
+            rotate_checkpoints(self.checkpoint_dir, self.keep_checkpoints)
 
     def _drain_checkpoint(self):
         """Block until the in-flight async sharded save (if any) is
@@ -942,13 +1030,19 @@ class Trainer:
             self._pending_ckpt.wait()
             self._pending_ckpt = None
 
-    def resume_from(self, checkpoint_path):
+    def resume_from(self, checkpoint_path, advance_epoch: bool = False):
         """Restore params/optimizer state (new capability; the reference's
         checkpoints were write-only).  Returns the checkpoint metadata.
 
         Dispatches on the path's shape: a ``.orbax`` DIRECTORY restores
         shard-by-shard onto the live state's shardings (no gather); a
-        file is the gathered single-file format."""
+        file is the gathered single-file format.
+
+        ``advance_epoch=True`` (the auto-resume path) additionally makes
+        ``train()`` continue from the checkpoint's epoch instead of
+        retraining from epoch 0 on top of the restored state - a run
+        killed after epoch E and restarted covers exactly the remaining
+        epochs, reproducing the uninterrupted run."""
         from pytorch_distributed_rnn_tpu.training.sharded_checkpoint import (
             is_sharded_checkpoint,
             restore_sharded,
@@ -971,4 +1065,6 @@ class Trainer:
                 checkpoint_path, self.params, self.opt_state
             )
         self._resume_best_loss = meta["loss"]
+        if advance_epoch:
+            self._start_epoch = int(meta["epoch"])
         return meta

@@ -6,23 +6,45 @@ bundles ``{epoch, model_state, optimizer_state, loss}``, written as
 ``best-model.ckpt`` on a new best validation loss or
 ``checkpoint-epoch-N.ckpt`` otherwise.
 
-New capability the reference lacks (its checkpoints are write-only,
+New capabilities the reference lacks (its checkpoints are write-only,
 SURVEY §5): ``load_checkpoint`` restores params/optimizer state into
-templates so training can RESUME.
+templates so training can RESUME, and the write path is CRASH-SAFE - a
+process killed mid-write (the ``resilience/faults.py`` preemption model)
+can never leave a half-written file under the checkpoint name:
 
-Format: one binary file - a JSON header line with metadata and section
-lengths, followed by two flax-msgpack sections (model state, optimizer
-state).  Portable and pickle-free.
+- writes go to a temp file, ``fsync``, then atomic ``os.replace``;
+- the header carries a CRC32 per section, verified on load;
+- ``load_checkpoint`` rejects truncated/corrupt files with
+  :class:`CheckpointCorruptError` so auto-resume
+  (``resilience/guard.py``) falls back to the previous valid file;
+- ``rotate_checkpoints`` bounds disk growth (``--keep-checkpoints N``).
+
+Format: one binary file - a JSON header line with metadata, section
+lengths and CRCs, followed by two flax-msgpack sections (model state,
+optimizer state).  Portable and pickle-free.  Pre-CRC files (no ``crcs``
+header field) still load; lengths are validated either way.
 """
 
 from __future__ import annotations
 
 import json
+import logging
+import os
+import re
+import zlib
 from pathlib import Path
 
 import jax
 import numpy as np
 from flax import serialization
+
+log = logging.getLogger(__name__)
+
+_EPOCH_CKPT_RE = re.compile(r"^checkpoint-epoch-(\d+)\.ckpt$")
+
+
+class CheckpointCorruptError(RuntimeError):
+    """The file is truncated, unparseable, or fails CRC verification."""
 
 
 def _to_host(tree):
@@ -32,7 +54,7 @@ def _to_host(tree):
 def save_checkpoint(
     checkpoint_dir, epoch: int, params, opt_state, loss: float, best: bool = False
 ) -> Path:
-    """Write a checkpoint; returns the path."""
+    """Write a checkpoint atomically; returns the path."""
     checkpoint_dir = Path(checkpoint_dir)
     checkpoint_dir.mkdir(parents=True, exist_ok=True)
     name = "best-model.ckpt" if best else f"checkpoint-epoch-{epoch + 1}.ckpt"
@@ -46,25 +68,172 @@ def save_checkpoint(
             "loss": float(loss),
             "model_len": len(model_bytes),
             "opt_len": len(opt_bytes),
+            "crcs": {
+                "model": zlib.crc32(model_bytes),
+                "opt": zlib.crc32(opt_bytes),
+            },
         }
     ).encode()
-    with open(path, "wb") as f:
-        f.write(header + b"\n")
-        f.write(model_bytes)
-        f.write(opt_bytes)
+    # temp-write + fsync + atomic rename: a crash at ANY point leaves
+    # either the previous complete file or no file - never a truncated
+    # one under the checkpoint name.  pid-suffixed temp so concurrent
+    # writers (multi-process strategies misconfigured to all write)
+    # cannot interleave into one temp file.
+    tmp = path.with_name(path.name + f".tmp.{os.getpid()}")
+    try:
+        with open(tmp, "wb") as f:
+            f.write(header + b"\n")
+            f.write(model_bytes)
+            f.write(opt_bytes)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if tmp.exists():  # replace failed or write raised
+            tmp.unlink()
+    # fsync the directory so the rename itself is durable (best-effort:
+    # not every filesystem supports directory fds)
+    try:
+        dir_fd = os.open(checkpoint_dir, os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)
+    except OSError:  # pragma: no cover - platform-dependent
+        pass
     return path
+
+
+def _read_sections(path):
+    """Parse ``(header, model_bytes, opt_bytes)`` off ``path``, raising
+    :class:`CheckpointCorruptError` on any structural damage."""
+    try:
+        with open(path, "rb") as f:
+            header_line = f.readline()
+            try:
+                header = json.loads(header_line.decode())
+            except (UnicodeDecodeError, json.JSONDecodeError) as exc:
+                raise CheckpointCorruptError(
+                    f"{path}: unparseable header ({exc})"
+                ) from exc
+            try:
+                model_len = int(header["model_len"])
+                opt_len = int(header["opt_len"])
+            except (KeyError, TypeError, ValueError) as exc:
+                raise CheckpointCorruptError(
+                    f"{path}: header missing section lengths ({exc})"
+                ) from exc
+            model_bytes = f.read(model_len)
+            opt_bytes = f.read(opt_len)
+            trailing = f.read(1)
+    except OSError as exc:
+        raise CheckpointCorruptError(f"{path}: unreadable ({exc})") from exc
+    # a short read deserializes garbage (the historical truncation bug:
+    # f.read(n) returns what is there, not n bytes) - validate lengths
+    if len(model_bytes) != model_len or len(opt_bytes) != opt_len:
+        raise CheckpointCorruptError(
+            f"{path}: truncated - expected {model_len}+{opt_len} section "
+            f"bytes, found {len(model_bytes)}+{len(opt_bytes)}"
+        )
+    if trailing:
+        raise CheckpointCorruptError(
+            f"{path}: trailing bytes past the declared sections"
+        )
+    crcs = header.get("crcs")
+    if crcs is not None:  # pre-CRC files load on lengths alone
+        for name, blob in (("model", model_bytes), ("opt", opt_bytes)):
+            if zlib.crc32(blob) != crcs.get(name):
+                raise CheckpointCorruptError(
+                    f"{path}: {name} section CRC mismatch (bit rot or "
+                    "partial overwrite)"
+                )
+    return header, model_bytes, opt_bytes
+
+
+def verify_checkpoint(path) -> dict:
+    """Structural verification without deserializing: header, section
+    lengths, CRCs.  Returns the header; raises
+    :class:`CheckpointCorruptError`."""
+    header, _, _ = _read_sections(path)
+    return header
 
 
 def load_checkpoint(path, params_template, opt_state_template):
     """Restore ``(params, opt_state, meta)`` from ``path``.
 
     Templates supply the pytree structure (the trainer's freshly
-    initialized params/optimizer state).
+    initialized params/optimizer state).  Raises
+    :class:`CheckpointCorruptError` for truncated/corrupt files so
+    callers (auto-resume) can fall back to an earlier checkpoint instead
+    of deserializing garbage.
     """
-    with open(path, "rb") as f:
-        header = json.loads(f.readline().decode())
-        model_bytes = f.read(header["model_len"])
-        opt_bytes = f.read(header["opt_len"])
-    params = serialization.from_bytes(params_template, model_bytes)
-    opt_state = serialization.from_bytes(opt_state_template, opt_bytes)
+    header, model_bytes, opt_bytes = _read_sections(path)
+    try:
+        params = serialization.from_bytes(params_template, model_bytes)
+        opt_state = serialization.from_bytes(opt_state_template, opt_bytes)
+    except Exception as exc:
+        # CRC-valid bytes that still do not deserialize = a checkpoint
+        # from a different model/optimizer shape; say which file
+        raise CheckpointCorruptError(
+            f"{path}: sections verified but failed to deserialize into "
+            f"the trainer's state templates ({exc})"
+        ) from exc
     return params, opt_state, {"epoch": header["epoch"], "loss": header["loss"]}
+
+
+def checkpoint_candidates(checkpoint_dir) -> list[Path]:
+    """Resume candidates under ``checkpoint_dir``, newest-first.
+
+    Epoch checkpoints ordered by their filename epoch (descending);
+    ``best-model.ckpt`` is appended LAST - it is the best-validation
+    state, not the furthest progress, so plain epoch recency wins for
+    resume and best-model remains the final fallback.
+    """
+    checkpoint_dir = Path(checkpoint_dir)
+    if not checkpoint_dir.is_dir():
+        return []
+    epochs = []
+    for entry in checkpoint_dir.iterdir():
+        m = _EPOCH_CKPT_RE.match(entry.name)
+        if m:
+            epochs.append((int(m.group(1)), entry))
+    out = [p for _, p in sorted(epochs, key=lambda t: t[0], reverse=True)]
+    best = checkpoint_dir / "best-model.ckpt"
+    if best.exists():
+        out.append(best)
+    return out
+
+
+def find_latest_checkpoint(checkpoint_dir) -> Path | None:
+    """The newest checkpoint that passes structural verification, or
+    ``None`` - corrupt/truncated files are skipped (and logged), which
+    is what makes crash-time resume safe: the file being written when
+    the process died never wins."""
+    for path in checkpoint_candidates(checkpoint_dir):
+        try:
+            verify_checkpoint(path)
+        except CheckpointCorruptError as exc:
+            log.warning(f"find_latest_checkpoint: skipping {path}: {exc}")
+            continue
+        return path
+    return None
+
+
+def rotate_checkpoints(checkpoint_dir, keep_last: int) -> list[Path]:
+    """Delete all but the newest ``keep_last`` epoch checkpoints
+    (``best-model.ckpt`` is never rotated).  Returns the deleted paths.
+    ``keep_last <= 0`` keeps everything."""
+    if keep_last <= 0:
+        return []
+    epoch_ckpts = [
+        p for p in checkpoint_candidates(checkpoint_dir)
+        if _EPOCH_CKPT_RE.match(p.name)
+    ]
+    deleted = []
+    for path in epoch_ckpts[keep_last:]:
+        try:
+            path.unlink()
+            deleted.append(path)
+        except OSError as exc:  # pragma: no cover - racing cleanup is fine
+            log.warning(f"rotate_checkpoints: could not delete {path}: {exc}")
+    return deleted

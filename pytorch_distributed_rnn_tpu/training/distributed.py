@@ -65,6 +65,7 @@ class SpmdTrainer(Trainer):
         fuse_run: bool = False,
         checkpoint_format: str = "gathered",
         checkpoint_async: bool = False,
+        **kwargs,  # resilience knobs (faults/max_bad_steps/keep_checkpoints)
     ):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.axis = axis
@@ -88,6 +89,7 @@ class SpmdTrainer(Trainer):
             fuse_run=fuse_run,
             checkpoint_format=checkpoint_format,
             checkpoint_async=checkpoint_async,
+            **kwargs,
         )
         self.world_size = world_size
         # single controller: one process reports as rank 0.  In a

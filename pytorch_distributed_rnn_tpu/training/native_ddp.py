@@ -69,6 +69,7 @@ class NativeDDPTrainer(Trainer):
         fuse_run: bool = False,
         checkpoint_format: str = "gathered",
         checkpoint_async: bool = False,
+        **kwargs,  # resilience knobs (faults/max_bad_steps/keep_checkpoints)
     ):
         if checkpoint_async:
             # base validation would also reject (async needs sharded),
@@ -108,6 +109,7 @@ class NativeDDPTrainer(Trainer):
             # DEVICE_DATA=False makes the base gate reject an explicit
             # --fuse-run loudly (the per-step host allreduce cannot fuse)
             fuse_run=fuse_run,
+            **kwargs,
         )
         self.comm = comm
         self.rank = rank
@@ -167,6 +169,14 @@ def run_rank(comm, args, model, datasets, trainer_class=None):
     ``history.json``, every rank logs its perf line).  ``trainer_class``
     lets a family mix its loss surface over :class:`NativeDDPTrainer`."""
     training_set, validation_set, test_set = datasets
+    from pytorch_distributed_rnn_tpu.resilience import FaultSchedule
+
+    # rank-bound chaos schedule (one entry point per strategy, all via
+    # FaultSchedule.resolve so no strategy can silently drop --faults).
+    # A rank-scoped NaN injection keeps replicas in sync: the allreduce
+    # propagates the NaN to every rank, so every guard skips the same
+    # step identically.
+    faults = FaultSchedule.resolve(args, rank=comm.rank)
     trainer = (trainer_class or NativeDDPTrainer)(
         comm=comm,
         model=model,
@@ -183,10 +193,25 @@ def run_rank(comm, args, model, datasets, trainer_class=None):
         fuse_run=getattr(args, "fuse_run", False),
         checkpoint_format=getattr(args, "checkpoint_format", "gathered"),
         checkpoint_async=getattr(args, "checkpoint_async", False),
+        faults=faults,
+        max_bad_steps=getattr(args, "max_bad_steps", 0),
+        keep_checkpoints=getattr(args, "keep_checkpoints", 0),
     )
-    if getattr(args, "resume", None):
-        meta = trainer.resume_from(args.resume)
-        log.info(f"Resumed from {args.resume} at epoch {meta['epoch']}")
+    resume = getattr(args, "resume", None)
+    if resume is not None and str(resume) == "auto":
+        # crash-restart contract (resilience/guard.py): newest valid
+        # checkpoint, corrupt files fall back, none = fresh start.
+        # Every rank resolves the SAME shared directory (args are
+        # identical across ranks), so all replicas restore identical
+        # state and the same start epoch.
+        from pytorch_distributed_rnn_tpu.resilience import resume_latest
+
+        meta = resume_latest(trainer, args.checkpoint_directory)
+        if meta is None:
+            log.info("--resume auto: no usable checkpoint; starting fresh")
+    elif resume:
+        meta = trainer.resume_from(resume)
+        log.info(f"Resumed from {resume} at epoch {meta['epoch']}")
     _, train_history, validation_history = trainer.train(epochs=args.epochs)
     # the rank-parity observable (reference example_ddp.py:92 prints the
     # same quantity): identical on every rank iff replicas stayed in sync

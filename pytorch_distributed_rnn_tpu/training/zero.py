@@ -146,7 +146,7 @@ class ZeroTrainer(SpmdTrainer):
         # materializing a device-side replica (ZeRO's memory point)
         return self.params, self.opt_state
 
-    def resume_from(self, checkpoint_path):
-        meta = super().resume_from(checkpoint_path)
+    def resume_from(self, checkpoint_path, advance_epoch: bool = False):
+        meta = super().resume_from(checkpoint_path, advance_epoch)
         self._apply_zero_layout()  # the loader returns host trees
         return meta

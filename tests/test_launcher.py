@@ -26,6 +26,7 @@ from pytorch_distributed_rnn_tpu.launcher import (
     run_benchmark,
     run_network_test,
 )
+from pytorch_distributed_rnn_tpu.utils import capability  # noqa: F401 - skipif probe
 
 
 def test_get_command_local():
@@ -103,6 +104,11 @@ def test_run_hosts_dry_run_cli(capsys):
     assert out[0].startswith("ssh h1 ") and out[1].startswith("ssh h2 ")
 
 
+@pytest.mark.skipif(
+    "not capability.supports_multiprocess_backend()",
+    reason="backend cannot run multiprocess computations (XLA:CPU limit; "
+    "probed, not assumed)",
+)
 def test_run_hosts_spawn_path_trains_world(tmp_path, monkeypatch, capsys):
     """The EXACT ``_run_hosts`` spawn path (launcher/__main__.py) stands up
     a real 2-process ``jax.distributed`` world and trains - with ``ssh``

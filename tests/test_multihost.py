@@ -12,6 +12,7 @@ from pytorch_distributed_rnn_tpu.parallel.multihost import (
     process_info,
     rendezvous_spec_from_env,
 )
+from pytorch_distributed_rnn_tpu.utils import capability  # noqa: F401 - skipif probe
 
 
 def test_env_parsing_pdrnn_names(monkeypatch):
@@ -64,6 +65,11 @@ def test_rendezvous_after_backend_init_raises_clearly():
                              num_processes=1, process_id=0)
 
 
+@pytest.mark.skipif(
+    "not capability.supports_multiprocess_backend()",
+    reason="backend cannot run multiprocess computations (XLA:CPU limit; "
+    "probed, not assumed)",
+)
 def test_two_process_world_spmd_sum():
     """A REAL 2-process jax.distributed CPU world: both processes
     rendezvous through the coordinator, build one global mesh spanning

@@ -16,6 +16,15 @@ from pytorch_distributed_rnn_tpu.ops.pallas_attention import (
     flash_attention,
     resolve_attention_impl,
 )
+from pytorch_distributed_rnn_tpu.utils import capability  # noqa: F401 - skipif probe
+
+# the jitted non-causal ring lowers to a PartitionId instruction XLA:CPU's
+# SPMD partitioner rejects; probe the capability instead of assuming it
+_needs_ring_spmd = pytest.mark.skipif(
+    "not capability.supports_spmd_ring_collectives()",
+    reason="backend SPMD partitioner rejects the jitted ring "
+    "(PartitionId unimplemented on XLA:CPU; probed, not assumed)",
+)
 
 
 def _qkv(t_q=128, t_k=None, b=2, h=4, d=16, dtype=jnp.float32, seed=0):
@@ -141,7 +150,9 @@ class TestRingFlash:
             check_vma=False,
         )
 
-    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize(
+        "causal", [pytest.param(False, marks=_needs_ring_spmd), True]
+    )
     def test_matches_dense(self, causal):
         q, k, v = _qkv(t_q=256, d=16)
         ref = mha_attention(q, k, v, causal=causal)
@@ -170,6 +181,7 @@ class TestRingFlash:
                 err_msg=f"d{name}",
             )
 
+    @_needs_ring_spmd
     def test_mismatched_explicit_blocks_pad_to_lcm(self):
         """block_q=384/block_k=256 at t_local=300: the padded length must
         tile by BOTH blocks or tail keys silently drop from the softmax."""
@@ -198,6 +210,7 @@ class TestRingFlash:
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                    rtol=1e-5, atol=1e-5)
 
+    @_needs_ring_spmd
     def test_bf16_ring_merges_in_f32(self):
         """bf16 ring flash stays within single-cast tolerance of the f32
         dense reference - per-round bf16 renormalization would compound."""

@@ -1,6 +1,12 @@
-"""Host-path input pipeline (data/prefetch.py): ordering, eager
-pull-ahead, and exact parity of the pipelined host epoch loop with the
+"""Host-path input pipeline (data/prefetch.py): ordering, bounded
+pull-ahead, producer-thread lifecycle (close/GC join, exception
+propagation), and exact parity of the pipelined host epoch loop with the
 device-resident path."""
+
+import gc
+import threading
+import time
+import traceback
 
 import jax
 import numpy as np
@@ -15,12 +21,28 @@ from pytorch_distributed_rnn_tpu.training import Trainer
 SEED = 123456789
 
 
+def _wait_until(predicate, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.005)
+    return predicate()
+
+
+def _no_prefetch_threads():
+    return not any(
+        t.name == "pdrnn-prefetch" and t.is_alive()
+        for t in threading.enumerate()
+    )
+
+
 class TestPrefetch:
     def test_yields_in_order_and_exhausts(self):
         assert list(prefetch(iter(range(7)), depth=2)) == list(range(7))
         assert list(prefetch(iter([]), depth=3)) == []
 
-    def test_pulls_ahead_of_consumer(self):
+    def test_pulls_ahead_of_consumer_and_bound_is_exact(self):
         pulled = []
 
         def source():
@@ -28,17 +50,103 @@ class TestPrefetch:
                 pulled.append(i)
                 yield i
 
-        stream = prefetch(source(), depth=2)
-        assert next(stream) == 0
-        # the consumer holds item 0; the prefetcher has already pulled
-        # depth more items from the source behind it
-        assert pulled == [0, 1, 2]
-        assert next(stream) == 1
-        assert pulled == [0, 1, 2, 3]
+        with prefetch(source(), depth=2) as stream:
+            assert next(stream) == 0
+            # the consumer holds item 0; the producer thread pulls depth
+            # more items behind it - eventually exactly [0, 1, 2], and
+            # the token bound guarantees NEVER more
+            assert _wait_until(lambda: len(pulled) == 3)
+            assert pulled == [0, 1, 2]
+            assert next(stream) == 1
+            assert _wait_until(lambda: len(pulled) == 4)
+            assert pulled == [0, 1, 2, 3]
 
     def test_depth_must_be_positive(self):
         with pytest.raises(ValueError, match="depth"):
             list(prefetch(iter([1]), depth=0))
+
+    def test_exhausted_stream_stays_exhausted(self):
+        """Re-iterating a drained stream is a cheap empty iteration -
+        not an IndexError or a deadlock on the dead producer."""
+        stream = prefetch(iter(range(3)), depth=2)
+        assert list(stream) == [0, 1, 2]
+        assert list(stream) == []
+        assert list(stream) == []
+        with pytest.raises(StopIteration):
+            next(stream)
+
+
+class TestProducerLifecycle:
+    """The chaos-robustness contract: early-exiting consumers must not
+    leak the producer thread; producer failures must surface in the
+    consumer with the original traceback."""
+
+    def test_close_joins_producer_thread(self):
+        stream = prefetch(iter(range(1000)), depth=2)
+        assert next(stream) == 0
+        stream.close()
+        assert _wait_until(_no_prefetch_threads)
+        # closed stream behaves as exhausted, not crashed
+        assert list(stream) == []
+
+    def test_abandoning_consumer_joins_thread_via_gc(self):
+        stream = prefetch(iter(range(1000)), depth=2)
+        assert next(stream) == 0
+        del stream  # the chaos early-exit shape: nobody calls close()
+        gc.collect()
+        assert _wait_until(_no_prefetch_threads)
+
+    def test_break_out_of_for_loop_then_gc_joins_thread(self):
+        for item in prefetch(iter(range(1000)), depth=2):
+            if item == 3:
+                break
+        gc.collect()
+        assert _wait_until(_no_prefetch_threads)
+
+    def test_producer_exception_propagates_with_original_traceback(self):
+        def source():
+            yield 1
+            raise KeyError("boom in the loader")
+
+        stream = prefetch(source(), depth=2)
+        assert next(stream) == 1
+        with pytest.raises(KeyError, match="boom in the loader") as excinfo:
+            next(stream)
+        # the traceback must include the PRODUCER frame (the real
+        # failure site), not just the consumer-side re-raise
+        frames = "".join(traceback.format_tb(excinfo.value.__traceback__))
+        assert "source" in frames
+        assert _wait_until(_no_prefetch_threads)
+        # the stream is dead after the error, like a plain generator
+        assert list(stream) == []
+
+    def test_exception_position_in_stream_is_preserved(self):
+        def source():
+            yield from range(3)
+            raise RuntimeError("after three")
+
+        stream = prefetch(source(), depth=2)
+        seen = []
+        with pytest.raises(RuntimeError, match="after three"):
+            for item in stream:
+                seen.append(item)
+        assert seen == [0, 1, 2]
+
+    def test_stalled_source_does_not_hang_close(self):
+        release = threading.Event()
+
+        def source():
+            yield 0
+            release.wait(timeout=30)  # a stalled loader
+            yield 1
+
+        stream = prefetch(source(), depth=1)
+        assert next(stream) == 0
+        t0 = time.monotonic()
+        stream.close()  # must return promptly despite the stuck producer
+        assert time.monotonic() - t0 < 10
+        release.set()
+        assert _wait_until(_no_prefetch_threads)
 
 
 class _HostPathTrainer(Trainer):
