@@ -118,9 +118,17 @@ def trace_collective_stats(fn, *args) -> dict:
     per-step traffic the scaling model needs.
     """
     import jax
+
+    return closed_jaxpr_collective_stats(jax.make_jaxpr(fn)(*args))
+
+
+def closed_jaxpr_collective_stats(closed) -> dict:
+    """:func:`trace_collective_stats` on an already-made ClosedJaxpr -
+    shared with the lint deep pass (``lint/jaxpr_pass.py``), which has
+    the traced step in hand and reports per-entry collective traffic in
+    its CI artifact."""
     import numpy as np
 
-    closed = jax.make_jaxpr(fn)(*args)
     jaxpr_cls = type(closed.jaxpr)
     closed_cls = type(closed)
     stats: dict = {}

@@ -32,9 +32,33 @@ Rules
   ``...``/``raise NotImplementedError`` (abstract methods, overloads
   and Protocol members excluded).
 
-Run ``python -m pytorch_distributed_rnn_tpu.lint --help`` for the CLI;
-``lint_baseline.json`` at the repo root carries the accepted
-pre-existing findings so CI gates on *new* ones only.
+Deep (jaxpr) layer - ``--deep``
+-------------------------------
+The AST rules stop where tracing starts: unreduced gradients, silent
+dtype promotion, and mesh/collective mismatches only exist in the
+traced program.  ``--deep`` traces every trainer entry point declared
+in the trace registry (``lint/trace_registry.py`` - each family in
+``training/`` and ``parallel/`` registers its step with abstract
+shape/dtype specs, no real data, CPU-only) and runs the jaxpr rules
+(``lint/jaxpr_pass.py``):
+
+- **PD200 trace-failure** - a registered entry no longer traces.
+- **PD201 unreduced-gradient** - no psum/pmean over the declared data
+  axis on the updated-params path (GSPMD entries: no sharding
+  annotation mentioning the axis).
+- **PD202 collective-axis-mismatch** - collective over an axis absent
+  from the traced mesh (ground truth for PD101).
+- **PD203 dtype-promotion-leak** - bf16/f16 upcast to f32 outside an
+  allowlisted accumulation (``# noqa: PD203`` + contract comment).
+- **PD204 dead-computation** - large DCE-removable clusters.
+- **PD205 donation-mismatch** - donated buffers XLA cannot alias to
+  any output (the donation silently drops).
+
+Both layers share the CLI, ``# noqa`` directives, the baseline file and
+the JSON report.  Run ``python -m pytorch_distributed_rnn_tpu.lint
+--help`` for the CLI; ``lint_baseline.json`` at the repo root carries
+the accepted pre-existing findings so CI gates on *new* ones only
+(``--prune-baseline`` drops entries that stopped matching).
 """
 
 from pytorch_distributed_rnn_tpu.lint.core import (
@@ -46,6 +70,7 @@ from pytorch_distributed_rnn_tpu.lint.core import (
 )
 from pytorch_distributed_rnn_tpu.lint.baseline import (
     load_baseline,
+    prune_baseline,
     write_baseline,
 )
 
@@ -56,5 +81,6 @@ __all__ = [
     "all_rules",
     "run_lint",
     "load_baseline",
+    "prune_baseline",
     "write_baseline",
 ]

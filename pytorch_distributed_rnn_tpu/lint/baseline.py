@@ -58,8 +58,19 @@ def load_baseline(path: str | Path) -> dict[str, int]:
 
 
 def write_baseline(path: str | Path,
-                   findings: Iterable["Finding"]) -> dict:
-    """Serialize ``findings`` as the new accepted baseline."""
+                   findings: Iterable["Finding"],
+                   keep_rules: Iterable[str] = (),
+                   scanned: Iterable[str] | None = None) -> dict:
+    """Serialize ``findings`` as the new accepted baseline.
+
+    Existing entries matching a preservation guard are carried over
+    instead of dropped: ``keep_rules`` (the CLI passes the PD2xx codes
+    when writing WITHOUT ``--deep`` - the deep layer produced no
+    findings, so a plain rewrite would silently delete every accepted
+    deep entry) and ``scanned`` (repo-relative files this run actually
+    linted; a narrowed path list must not wipe the rest of the repo's
+    accepted entries).  Current findings win on fingerprint collision.
+    """
     by_fp: dict[str, dict] = {}
     for f in findings:
         fp = fingerprint(f)
@@ -74,6 +85,15 @@ def write_baseline(path: str | Path,
                 "symbol": f.symbol,
                 "snippet": f.snippet,
             }
+    keep_rules = set(keep_rules)
+    scanned = set(scanned) if scanned is not None else None
+    path = Path(path)
+    if path.exists() and (keep_rules or scanned is not None):
+        for entry in json.loads(path.read_text()).get("findings", []):
+            preserved = entry.get("rule") in keep_rules or (
+                scanned is not None and entry.get("path") not in scanned)
+            if preserved and entry["fingerprint"] not in by_fp:
+                by_fp[entry["fingerprint"]] = entry
     data = {
         "version": _VERSION,
         "tool": "pdrnn-lint",
@@ -82,8 +102,62 @@ def write_baseline(path: str | Path,
             key=lambda e: (e["path"], e["rule"], e["symbol"], e["snippet"]),
         ),
     }
-    Path(path).write_text(json.dumps(data, indent=2) + "\n")
+    path.write_text(json.dumps(data, indent=2) + "\n")
     return data
+
+
+def prune_baseline(path: str | Path,
+                   findings: Iterable["Finding"],
+                   keep_rules: Iterable[str] = (),
+                   scanned: Iterable[str] | None = None) -> tuple[dict, int]:
+    """Drop (or shrink) baseline entries whose fingerprint no longer
+    matches any current finding - stale entries otherwise accumulate
+    silently and could mask a future regression at the same location.
+
+    ``findings`` must be the non-baselined current findings (run with
+    ``baseline=None`` and no select/ignore).  Each entry's count is
+    clamped to the current occurrence count; zero-match entries are
+    removed.  Two preservation guards keep an entry untouched instead:
+    ``keep_rules`` (the CLI passes the PD2xx codes when pruning WITHOUT
+    ``--deep``, where deep entries would all look stale simply because
+    their layer never ran) and ``scanned`` (the repo-relative files the
+    run actually linted - entries for files OUTSIDE a narrowed path
+    list would otherwise all look stale too).  Returns ``(new_data,
+    dropped_count)`` and rewrites the file.
+    """
+    path = Path(path)
+    data = json.loads(path.read_text()) if path.exists() else {
+        "version": _VERSION, "tool": "pdrnn-lint", "findings": [],
+    }
+    if data.get("version") != _VERSION:
+        raise ValueError(
+            f"unsupported baseline version {data.get('version')!r} "
+            f"in {path} (expected {_VERSION})"
+        )
+    current: dict[str, int] = {}
+    for f in findings:
+        fp = fingerprint(f)
+        current[fp] = current.get(fp, 0) + 1
+
+    keep_rules = set(keep_rules)
+    scanned = set(scanned) if scanned is not None else None
+    kept: list[dict] = []
+    dropped = 0
+    for entry in data.get("findings", []):
+        if entry.get("rule") in keep_rules or (
+                scanned is not None and entry.get("path") not in scanned):
+            kept.append(entry)
+            continue
+        count = int(entry.get("count", 1))
+        have = current.get(entry["fingerprint"], 0)
+        keep = min(count, have)
+        current[entry["fingerprint"]] = have - keep
+        dropped += count - keep
+        if keep:
+            kept.append({**entry, "count": keep})
+    data["findings"] = kept
+    path.write_text(json.dumps(data, indent=2) + "\n")
+    return data, dropped
 
 
 def apply_baseline(findings: list["Finding"],

@@ -3,9 +3,16 @@
 ::
 
     python -m pytorch_distributed_rnn_tpu.lint [paths...]
-        [--format text|json] [--select PD101,PD105] [--ignore PD103]
-        [--baseline lint_baseline.json | --no-baseline]
-        [--write-baseline] [--known-axes dp,tp] [--list-rules]
+        [--deep] [--format text|json] [--select PD101,PD201]
+        [--ignore PD103] [--baseline lint_baseline.json | --no-baseline]
+        [--write-baseline | --prune-baseline] [--known-axes dp,tp]
+        [--list-rules]
+
+Two layers share one reporting path: the AST rules (PD1xx) always run;
+``--deep`` adds the jaxpr-level rules (PD2xx) by tracing every
+registered trainer entry point on CPU (abstract inputs, no compile, no
+TPU - see ``lint/trace_registry.py``).  Baseline, ``# noqa``,
+select/ignore and the JSON schema apply identically to both layers.
 
 Exit status: 0 = clean (all findings baselined or none), 1 = new
 findings, 2 = usage error.
@@ -20,9 +27,11 @@ from pathlib import Path
 
 from pytorch_distributed_rnn_tpu.lint.baseline import (
     load_baseline,
+    prune_baseline,
     write_baseline,
 )
 from pytorch_distributed_rnn_tpu.lint.core import all_rules, run_lint
+from pytorch_distributed_rnn_tpu.lint.jaxpr_pass import deep_rules
 
 _DEFAULT_BASELINE = "lint_baseline.json"
 
@@ -31,17 +40,38 @@ def _csv(value: str) -> list[str]:
     return [v.strip() for v in value.split(",") if v.strip()]
 
 
+def _scanned_paths(paths, baseline_path: Path) -> set[str]:
+    """Repo-relative posix paths of the files a run actually lints -
+    the same path convention findings carry (relative to the
+    baseline's directory)."""
+    from pytorch_distributed_rnn_tpu.lint.core import collect_files
+
+    root = baseline_path.resolve().parent
+    out = set()
+    for f in collect_files(paths):
+        try:
+            out.add(f.resolve().relative_to(root).as_posix())
+        except ValueError:
+            out.add(f.as_posix())
+    return out
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="pdrnn-lint",
         description="JAX-aware static analysis for "
-                    "pytorch_distributed_rnn_tpu (rules PD101-PD105)",
+                    "pytorch_distributed_rnn_tpu (AST rules PD101-PD105; "
+                    "jaxpr rules PD200-PD205 with --deep)",
     )
     parser.add_argument(
         "paths", nargs="*", default=["pytorch_distributed_rnn_tpu"],
         help="files or directories to lint "
              "(default: the package directory)",
     )
+    parser.add_argument(
+        "--deep", action="store_true",
+        help="also trace every registered trainer entry point and run "
+             "the jaxpr-level PD2xx rules (CPU-only, no compile)")
     parser.add_argument("--format", choices=("text", "json"),
                         default="text", dest="fmt")
     parser.add_argument("--select", type=_csv, default=None, metavar="RULES",
@@ -59,6 +89,10 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--write-baseline", action="store_true",
                         help="accept all current findings into the "
                              "baseline file and exit 0")
+    parser.add_argument("--prune-baseline", action="store_true",
+                        help="drop baseline entries matching no current "
+                             "finding and exit 0 (PD2xx entries are "
+                             "only pruned when --deep runs)")
     parser.add_argument("--list-rules", action="store_true")
     return parser
 
@@ -67,12 +101,13 @@ def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
 
     if args.list_rules:
-        for code, rule in sorted(all_rules().items()):
-            print(f"{code} {rule.name}: {rule.description}")
+        for code, rule in sorted({**all_rules(), **deep_rules()}.items()):
+            layer = "jaxpr" if code.startswith("PD2") else "ast"
+            print(f"{code} [{layer}] {rule.name}: {rule.description}")
         return 0
 
     # a typo'd rule code must not turn the gate vacuously green
-    known_codes = set(all_rules())
+    known_codes = set(all_rules()) | set(deep_rules())
     unknown = set(args.select or ()) | set(args.ignore or ())
     unknown -= known_codes
     if unknown:
@@ -82,16 +117,31 @@ def main(argv: list[str] | None = None) -> int:
               file=sys.stderr)
         return 2
 
-    # a filtered run sees only a subset of findings; writing it out
-    # would silently drop every other rule's accepted entries
-    if args.write_baseline and (args.select or args.ignore):
-        print("pdrnn-lint: --write-baseline must run unfiltered "
-              "(drop --select/--ignore)", file=sys.stderr)
+    # selecting a jaxpr rule without the jaxpr layer would report
+    # nothing and exit 0 - the same vacuously-green hazard as a typo
+    deep_selected = set(args.select or ()) & set(deep_rules())
+    if deep_selected and not args.deep:
+        print(f"pdrnn-lint: --select {', '.join(sorted(deep_selected))} "
+              "needs --deep (jaxpr rules only run when the deep pass "
+              "traces the registry)", file=sys.stderr)
+        return 2
+
+    # a filtered run sees only a subset of findings; rewriting the
+    # baseline from it would silently drop every other rule's entries
+    if (args.write_baseline or args.prune_baseline) and (
+            args.select or args.ignore):
+        print("pdrnn-lint: --write-baseline/--prune-baseline must run "
+              "unfiltered (drop --select/--ignore)", file=sys.stderr)
+        return 2
+    if args.write_baseline and args.prune_baseline:
+        print("pdrnn-lint: --write-baseline and --prune-baseline are "
+              "mutually exclusive", file=sys.stderr)
         return 2
 
     baseline_path = Path(args.baseline or _DEFAULT_BASELINE)
     baseline: dict[str, int] = {}
-    if not args.no_baseline and not args.write_baseline:
+    if not args.no_baseline and not (args.write_baseline
+                                     or args.prune_baseline):
         try:
             baseline = load_baseline(baseline_path)
         except ValueError as e:
@@ -108,27 +158,58 @@ def main(argv: list[str] | None = None) -> int:
             # report paths relative to the baseline's directory (the
             # repo root), so fingerprints match no matter the cwd
             root=baseline_path.resolve().parent,
+            deep=args.deep,
         )
     except FileNotFoundError as e:
         print(f"pdrnn-lint: {e}", file=sys.stderr)
         return 2
 
+    if result.deep:
+        for skip in result.deep.get("skipped", ()):
+            print(f"pdrnn-lint: deep: skipped {skip['entry']} "
+                  f"({skip['reason']})", file=sys.stderr)
+
+    if args.write_baseline or args.prune_baseline:
+        # two preservation guards keep a narrowed run from deleting
+        # accepted entries it could not have re-observed: entries for
+        # files outside the linted paths, and PD2xx entries when the
+        # jaxpr layer never ran (no --deep)
+        keep_rules = () if args.deep else tuple(deep_rules())
+        scanned = _scanned_paths(args.paths, baseline_path)
+
     if args.write_baseline:
-        data = write_baseline(baseline_path, result.findings)
+        data = write_baseline(baseline_path, result.findings,
+                              keep_rules=keep_rules, scanned=scanned)
         print(f"pdrnn-lint: wrote {len(data['findings'])} baseline "
               f"entries ({len(result.findings)} findings) to "
               f"{baseline_path}")
         return 0
 
+    if args.prune_baseline:
+        try:
+            data, dropped = prune_baseline(baseline_path, result.findings,
+                                           keep_rules=keep_rules,
+                                           scanned=scanned)
+        except ValueError as e:
+            print(f"pdrnn-lint: {e}", file=sys.stderr)
+            return 2
+        print(f"pdrnn-lint: pruned {dropped} stale baseline "
+              f"occurrence(s); {len(data['findings'])} entries remain "
+              f"in {baseline_path}")
+        return 0
+
     if args.fmt == "json":
-        print(json.dumps({
+        report = {
             "version": 1,
             "files": result.files,
             "known_axes": sorted(result.known_axes),
             "counts": result.counts(),
             "baseline_suppressed": result.suppressed,
             "findings": [f.to_dict() for f in result.findings],
-        }, indent=2))
+        }
+        if result.deep is not None:
+            report["deep"] = result.deep
+        print(json.dumps(report, indent=2))
     else:
         for f in result.findings:
             print(f.render())
@@ -136,6 +217,10 @@ def main(argv: list[str] | None = None) -> int:
             f"pdrnn-lint: {len(result.findings)} finding(s) in "
             f"{result.files} file(s)"
         )
+        if result.deep is not None:
+            summary += (
+                f" (+{result.deep['traced']} entry points traced)"
+            )
         if result.suppressed:
             summary += f" ({result.suppressed} baselined)"
         print(summary)

@@ -29,6 +29,11 @@ class Finding:
     message: str
     symbol: str = ""  # enclosing function qualname, "" at module scope
     snippet: str = ""  # stripped source line (line-number-stable key)
+    # extra lines a `# noqa:` directive may sit on for this finding: the
+    # line a multi-line call/statement STARTS on, and the first decorator
+    # line of a decorated def.  Not serialized; not part of the
+    # fingerprint.
+    anchors: tuple = ()
 
     def to_dict(self) -> dict:
         from pytorch_distributed_rnn_tpu.lint.baseline import fingerprint
@@ -56,6 +61,14 @@ class Finding:
 _NOQA_RE = re.compile(
     r"#\s*(?:noqa:|pdrnn-lint:\s*ignore\[)\s*([A-Z]{2}\d{3}(?:[,\s]+[A-Z]{2}\d{3})*)"
 )
+
+
+def noqa_codes(line_text: str) -> set[str]:
+    """Rule codes suppressed by an inline directive on this source line."""
+    m = _NOQA_RE.search(line_text)
+    if not m:
+        return set()
+    return set(re.findall(r"[A-Z]{2}\d{3}", m.group(1)))
 
 
 @dataclass
@@ -114,10 +127,7 @@ class ModuleInfo:
         return ""
 
     def noqa_rules(self, lineno: int) -> set[str]:
-        m = _NOQA_RE.search(self.line_text(lineno))
-        if not m:
-            return set()
-        return set(re.findall(r"[A-Z]{2}\d{3}", m.group(1)))
+        return noqa_codes(self.line_text(lineno))
 
     def enclosing_function(self, node: ast.AST) -> str:
         names: list[str] = []
@@ -129,6 +139,26 @@ class ModuleInfo:
             cur = self.parents.get(cur)
         return ".".join(reversed(names))
 
+    def noqa_anchors(self, node: ast.AST) -> tuple:
+        """Lines (besides the node's own) where a suppressing ``noqa``
+        directive is honored: the start line of the enclosing statement
+        (a finding inside a parenthesized multi-line call anchors to a
+        continuation line the directive cannot legally live on) and the
+        first decorator line of a decorated def (PD103's decorator-form
+        findings anchor to the ``def`` line, the directive belongs on
+        the ``@jit`` span)."""
+        lineno = getattr(node, "lineno", 1)
+        anchors = []
+        cur: ast.AST | None = node
+        while cur is not None and not isinstance(cur, ast.stmt):
+            cur = self.parents.get(cur)
+        if cur is not None and getattr(cur, "lineno", lineno) != lineno:
+            anchors.append(cur.lineno)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.decorator_list:
+            anchors.append(node.decorator_list[0].lineno)
+        return tuple(anchors)
+
     def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
         lineno = getattr(node, "lineno", 1)
         return Finding(
@@ -139,6 +169,7 @@ class ModuleInfo:
             message=message,
             symbol=self.enclosing_function(node),
             snippet=self.line_text(lineno),
+            anchors=self.noqa_anchors(node),
         )
 
 
@@ -220,6 +251,7 @@ class LintResult:
     suppressed: int  # baselined findings matched this run
     known_axes: set[str]
     files: int
+    deep: dict | None = None  # jaxpr-pass stats when run with deep=True
 
     def counts(self) -> dict[str, int]:
         out: dict[str, int] = {}
@@ -243,13 +275,17 @@ def run_lint(
     known_axes: Iterable[str] = (),
     baseline: dict[str, int] | None = None,
     root: str | Path | None = None,
+    deep: bool = False,
 ) -> LintResult:
     """Lint ``paths`` (files or directories) and return the result.
 
     ``baseline`` maps finding fingerprints to accepted occurrence
     counts (see :mod:`.baseline`); matched findings are suppressed.
     ``known_axes`` extends the mesh-axis registry scanned from the
-    files themselves.
+    files themselves.  ``deep=True`` additionally traces every
+    registered trainer entry point and runs the jaxpr-level PD2xx rules
+    (:mod:`.jaxpr_pass`); deep findings ride the same noqa/baseline/
+    select machinery.
     """
     from pytorch_distributed_rnn_tpu.lint.axes import collect_known_axes
     from pytorch_distributed_rnn_tpu.lint.baseline import apply_baseline
@@ -284,11 +320,44 @@ def run_lint(
     for mod in modules:
         for code in sorted(active):
             for finding in rules[code].check(mod, index):
-                if finding.rule in mod.noqa_rules(finding.line):
+                lines = (finding.line,) + finding.anchors
+                if any(finding.rule in mod.noqa_rules(ln)
+                       for ln in lines):
                     continue
                 findings.append(finding)
+
+    deep_stats = None
+    if deep:
+        from pytorch_distributed_rnn_tpu.lint.jaxpr_pass import run_deep
+
+        # the deep pass traces the WHOLE registry regardless of which
+        # paths were linted, so its noqa lookup must resolve from the
+        # finding's file - not from the happened-to-be-linted set
+        by_path = {m.path: m for m in modules}
+        line_cache: dict[str, list[str]] = {}
+
+        def noqa(path: str, line: int) -> set[str]:
+            mod = by_path.get(path)
+            if mod is not None:
+                return mod.noqa_rules(line)
+            lines = line_cache.get(path)
+            if lines is None:
+                try:
+                    lines = (Path(root) / path).read_text().splitlines()
+                except OSError:
+                    lines = []
+                line_cache[path] = lines
+            if 1 <= line <= len(lines):
+                return noqa_codes(lines[line - 1])
+            return set()
+
+        deep_findings, deep_stats = run_deep(
+            select=select, ignore=ignore, root=root, noqa=noqa,
+        )
+        findings.extend(deep_findings)
 
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     new, suppressed = apply_baseline(findings, baseline or {})
     return LintResult(findings=new, suppressed=suppressed,
-                      known_axes=index.known_axes, files=len(files))
+                      known_axes=index.known_axes, files=len(files),
+                      deep=deep_stats)

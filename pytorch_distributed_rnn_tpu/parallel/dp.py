@@ -315,3 +315,71 @@ def make_spmd_run_fn(
         )
 
     return jax.jit(_run, donate_argnums=(0, 1) if donate else ())
+
+
+# ---------------------------------------------------------------------------
+# pdrnn-lint --deep trace registry (lint/trace_registry.py)
+
+
+def _lint_motion_program():
+    """Tiny motion-model pieces shared by the dp trace entries: abstract
+    params/opt-state specs only (jax.eval_shape), no real data."""
+    import optax
+
+    from pytorch_distributed_rnn_tpu.lint.trace_registry import (
+        abstract_init,
+        lint_mesh,
+        prng_spec,
+        sds,
+    )
+    from pytorch_distributed_rnn_tpu.models import MotionModel
+    from pytorch_distributed_rnn_tpu.ops import cross_entropy_loss
+
+    mesh = lint_mesh({"dp": 2})
+    model = MotionModel(input_dim=9, hidden_dim=8, layer_dim=1,
+                        output_dim=6, impl="scan")
+    params = abstract_init(model.init, prng_spec())
+    optimizer = optax.adam(1e-3)
+    opt_state = abstract_init(optimizer.init, params)
+
+    def loss_and_metrics(p, batch):
+        x, y = batch
+        logits = model.apply(p, x)
+        return cross_entropy_loss(logits, y), {
+            "correct": jnp.sum(jnp.argmax(logits, axis=1) == y)
+        }
+
+    return mesh, optimizer, loss_and_metrics, params, opt_state, sds
+
+
+def declare_trace_entries(register):
+    """Register the SPMD data-parallel step programs for the jaxpr-level
+    lint pass: the per-batch step (the DDP/Horovod strategies' core) and
+    the whole-epoch scan program (collectives inside lax.scan)."""
+    path = "pytorch_distributed_rnn_tpu/parallel/dp.py"
+
+    def build_step():
+        mesh, opt, loss, params, opt_state, sds = _lint_motion_program()
+        step = make_spmd_train_step(loss, opt, mesh)
+        batch = (sds((4, 16, 9), jnp.float32), sds((4,), jnp.int32))
+        return step, (params, opt_state, batch)
+
+    register(
+        name="dp.spmd_train_step", family="ddp", path=path,
+        build=build_step, mesh_axes={"dp": 2}, data_axis="dp",
+        donate=(0, 1),
+    )
+
+    def build_epoch():
+        mesh, opt, loss, params, opt_state, sds = _lint_motion_program()
+        epoch = make_spmd_epoch_fn(loss, opt, mesh)
+        features = sds((8, 16, 9), jnp.float32)
+        labels = sds((8,), jnp.int32)
+        idx_mat = sds((3, 4), jnp.int32)
+        return epoch, (params, opt_state, features, labels, idx_mat)
+
+    register(
+        name="dp.spmd_epoch_fn", family="ddp", path=path,
+        build=build_epoch, mesh_axes={"dp": 2}, data_axis="dp",
+        donate=(0, 1),
+    )

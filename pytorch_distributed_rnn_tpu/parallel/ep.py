@@ -208,3 +208,41 @@ def make_ep_moe_forward(mesh, axis: str = "ep", *,
                           group_size=group_size)
 
     return jax.jit(forward)
+
+
+# ---------------------------------------------------------------------------
+# pdrnn-lint --deep trace registry (lint/trace_registry.py)
+
+
+def declare_trace_entries(register):
+    """Register the expert-parallel regression step (all_to_all
+    dispatch/combine; grads over the ep axis)."""
+
+    def build():
+        import optax
+
+        from pytorch_distributed_rnn_tpu.lint.trace_registry import (
+            abstract_init,
+            lint_mesh,
+            prng_spec,
+            sds,
+        )
+        from pytorch_distributed_rnn_tpu.ops.moe import init_moe_ffn
+
+        mesh = lint_mesh({"ep": 2})
+        params = abstract_init(
+            lambda key: init_moe_ffn(key, 8, 2, 16), prng_spec()
+        )
+        optimizer = optax.adam(1e-3)
+        opt_state = abstract_init(optimizer.init, params)
+        step = make_ep_train_step(optimizer, mesh)
+        x = sds((4, 8), jnp.float32)
+        y = sds((4, 8), jnp.float32)
+        return step, (params, opt_state, x, y)
+
+    register(
+        name="ep.moe_train_step", family="ep",
+        path="pytorch_distributed_rnn_tpu/parallel/ep.py",
+        build=build, mesh_axes={"ep": 2}, data_axis="ep",
+        donate=(0, 1),
+    )

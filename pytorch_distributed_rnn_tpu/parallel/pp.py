@@ -987,3 +987,47 @@ def make_pp_forward(mesh, axis: str = "pp", *, num_microbatches: int = 4,
         return last @ params["fc"]["weight"].T + params["fc"]["bias"]
 
     return jax.jit(forward)
+
+
+# ---------------------------------------------------------------------------
+# pdrnn-lint --deep trace registry (lint/trace_registry.py)
+
+
+def declare_trace_entries(register):
+    """Register the GPipe motion step (stage-hop ppermutes riding the
+    microbatch scan)."""
+
+    def build():
+        import optax
+
+        from pytorch_distributed_rnn_tpu.lint.trace_registry import (
+            abstract_init,
+            lint_mesh,
+            prng_spec,
+            sds,
+        )
+        from pytorch_distributed_rnn_tpu.models import MotionModel
+        from pytorch_distributed_rnn_tpu.parallel.strategy import (
+            make_mesh_grad_step,
+            make_motion_mesh_loss_fn,
+        )
+
+        axes = {"dp": 2, "pp": 2}
+        mesh = lint_mesh(axes)
+        model = MotionModel(input_dim=9, hidden_dim=8, layer_dim=2,
+                            output_dim=6, impl="scan")
+        params = abstract_init(model.init, prng_spec())
+        optimizer = optax.adam(1e-3)
+        opt_state = abstract_init(optimizer.init, params)
+        loss_fn = make_motion_mesh_loss_fn(mesh, axes, num_microbatches=2)
+        step = make_mesh_grad_step(loss_fn, optimizer)
+        batch = (sds((8, 16, 9), jnp.float32), sds((8,), jnp.int32))
+        jitted = jax.jit(step, donate_argnums=(0, 1))
+        return jitted, (params, opt_state, batch)
+
+    register(
+        name="pp.motion_gpipe_step", family="pp",
+        path="pytorch_distributed_rnn_tpu/parallel/pp.py",
+        build=build, mesh_axes={"dp": 2, "pp": 2}, data_axis="dp",
+        donate=(0, 1),
+    )

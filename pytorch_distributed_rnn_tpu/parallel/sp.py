@@ -483,3 +483,48 @@ def make_sp_attention_forward(model, mesh, axis: str = "sp", *,
         return _linear(params["head"], sp_mean_pool(h, axis))
 
     return jax.jit(forward)
+
+
+# ---------------------------------------------------------------------------
+# pdrnn-lint --deep trace registry (lint/trace_registry.py)
+
+
+def declare_trace_entries(register):
+    """Register the sequence-parallel char-LM step (the relay/wavefront
+    family: per-turn ppermute inside lax.scan - the collective pattern
+    HLO text parsing undercounts and the jaxpr pass sees exactly)."""
+
+    def build():
+        import optax
+
+        from pytorch_distributed_rnn_tpu.lint.trace_registry import (
+            abstract_init,
+            lint_mesh,
+            prng_spec,
+            sds,
+        )
+        from pytorch_distributed_rnn_tpu.models import CharRNN
+        from pytorch_distributed_rnn_tpu.parallel.strategy import (
+            make_char_mesh_loss_fn,
+            make_mesh_grad_step,
+        )
+
+        axes = {"dp": 2, "sp": 2}
+        mesh = lint_mesh(axes)
+        model = CharRNN(vocab_size=16, embed_dim=8, hidden_dim=8,
+                        layer_dim=2, impl="scan")
+        params = abstract_init(model.init, prng_spec())
+        optimizer = optax.adam(1e-3)
+        opt_state = abstract_init(optimizer.init, params)
+        loss_fn = make_char_mesh_loss_fn(mesh, axes)
+        step = make_mesh_grad_step(loss_fn, optimizer)
+        batch = (sds((4, 16), jnp.int32), sds((4,), jnp.int32))
+        jitted = jax.jit(step, donate_argnums=(0, 1))
+        return jitted, (params, opt_state, batch)
+
+    register(
+        name="sp.char_mesh_step", family="sp",
+        path="pytorch_distributed_rnn_tpu/parallel/sp.py",
+        build=build, mesh_axes={"dp": 2, "sp": 2}, data_axis="dp",
+        donate=(0, 1),
+    )

@@ -270,8 +270,11 @@ def _char_local_logits(params, tokens, *, sp=None, tp=None, pp=None,
             raise ValueError(f"hidden {hidden} not divisible by tp={ntp}")
         per = hidden // ntp
         w_local = lax.dynamic_slice_in_dim(head_w, ktp * per, per, axis=1)
+        # contract: the head accumulates logits in f32 regardless of the
+        # backbone compute dtype (intentional upcast)
         return lax.psum(
-            jnp.einsum("bth,vh->btv", h_local.astype(jnp.float32),
+            jnp.einsum("bth,vh->btv",
+                       h_local.astype(jnp.float32),  # noqa: PD203
                        w_local), tp
         ) + head_b
 

@@ -64,8 +64,11 @@ def _cast_local(local, x, compute_dtype):
     at full MXU rate, half the collective bytes); None = stay as-is."""
     if compute_dtype is None:
         return local, x
-    local = {k: v.astype(compute_dtype) for k, v in local.items()}
-    return local, x.astype(compute_dtype)
+    # contract: params stay f32, so these downcasts transpose to f32
+    # cotangent accumulation in backward - intentional
+    local = {k: v.astype(compute_dtype)  # noqa: PD203
+             for k, v in local.items()}
+    return local, x.astype(compute_dtype)  # noqa: PD203 (same contract)
 
 
 def sharded_gate_params(params, n, k, x, *, num_gates: int = 4,
@@ -89,15 +92,19 @@ def tp_lstm_step(w_hh_l_t, axis: str, carry, xp_t):
     ICI bytes under bf16); gate math runs f32 per the lstm_step
     mixed-precision contract."""
     h_local, c_local = carry
-    h_full = lax.all_gather(h_local.astype(xp_t.dtype), axis,
+    # contract: carry is f32, the gather wire dtype is the compute
+    # dtype; the downcast transposes to f32 accumulation in backward
+    h_full = lax.all_gather(h_local.astype(xp_t.dtype), axis,  # noqa: PD203
                             axis=1, tiled=True)
-    gates = (xp_t + h_full @ w_hh_l_t).astype(jnp.float32)
+    # contract: gate nonlinearities accumulate in f32 (the lstm_step
+    # mixed-precision contract) - this upcast is the accumulation
+    gates = (xp_t + h_full @ w_hh_l_t).astype(jnp.float32)  # noqa: PD203
     i, f, g, o = jnp.split(gates, 4, axis=-1)
     c_local = jax.nn.sigmoid(f) * c_local + (
         jax.nn.sigmoid(i) * jnp.tanh(g)
     )
     h_local = jax.nn.sigmoid(o) * jnp.tanh(c_local)
-    return (h_local, c_local), h_local.astype(xp_t.dtype)
+    return (h_local, c_local), h_local.astype(xp_t.dtype)  # noqa: PD203
 
 
 def tp_gru_step(w_hh_l_t, b_hh_l, axis: str, h_local, xp_t):
@@ -257,3 +264,48 @@ def make_tp_forward(mesh, axis: str = "tp", *, unroll: int = 1):
         return row_parallel_head(params["fc"], out[:, -1, :], axis)
 
     return jax.jit(forward)
+
+
+# ---------------------------------------------------------------------------
+# pdrnn-lint --deep trace registry (lint/trace_registry.py)
+
+
+def declare_trace_entries(register):
+    """Register the tensor-parallel char-LM step (bf16 compute: the tp
+    family is where the dtype-flow rule PD203 earns its keep - params f32,
+    gate matmuls bf16, head accumulation f32)."""
+
+    def build():
+        import optax
+
+        from pytorch_distributed_rnn_tpu.lint.trace_registry import (
+            abstract_init,
+            lint_mesh,
+            prng_spec,
+            sds,
+        )
+        from pytorch_distributed_rnn_tpu.models import CharRNN
+        from pytorch_distributed_rnn_tpu.parallel.strategy import (
+            make_char_mesh_loss_fn,
+            make_mesh_grad_step,
+        )
+
+        axes = {"dp": 2, "tp": 2}
+        mesh = lint_mesh(axes)
+        model = CharRNN(vocab_size=16, embed_dim=8, hidden_dim=8,
+                        layer_dim=1, impl="scan")
+        params = abstract_init(model.init, prng_spec())
+        optimizer = optax.adam(1e-3)
+        opt_state = abstract_init(optimizer.init, params)
+        loss_fn = make_char_mesh_loss_fn(mesh, axes, precision="bf16")
+        step = make_mesh_grad_step(loss_fn, optimizer)
+        batch = (sds((4, 16), jnp.int32), sds((4,), jnp.int32))
+        jitted = jax.jit(step, donate_argnums=(0, 1))
+        return jitted, (params, opt_state, batch)
+
+    register(
+        name="tp.char_mesh_step", family="tp",
+        path="pytorch_distributed_rnn_tpu/parallel/tp.py",
+        build=build, mesh_axes={"dp": 2, "tp": 2}, data_axis="dp",
+        donate=(0, 1),
+    )

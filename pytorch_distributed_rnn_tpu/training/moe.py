@@ -62,3 +62,50 @@ def wrap_moe_trainer(trainer_class):
         )
         _WRAPPED[trainer_class] = cls
     return cls
+
+
+# ---------------------------------------------------------------------------
+# pdrnn-lint --deep trace registry (lint/trace_registry.py)
+
+
+def declare_trace_entries(register):
+    """Register the MoE mesh step (dp x ep: batch over both axes, experts
+    over ep, router f32 by contract even under bf16 compute)."""
+
+    def build():
+        import jax
+        import optax
+
+        from pytorch_distributed_rnn_tpu.lint.trace_registry import (
+            abstract_init,
+            lint_mesh,
+            prng_spec,
+            sds,
+        )
+        from pytorch_distributed_rnn_tpu.models import MoEClassifier
+        from pytorch_distributed_rnn_tpu.parallel.strategy import (
+            make_mesh_grad_step,
+            make_moe_mesh_loss_fn,
+        )
+
+        mesh = lint_mesh({"dp": 2, "ep": 2})
+        model = MoEClassifier(input_dim=9, hidden_dim=8, layer_dim=1,
+                              output_dim=6, num_experts=4,
+                              expert_hidden=16)
+        params = abstract_init(model.init, prng_spec())
+        optimizer = optax.adam(1e-3)
+        opt_state = abstract_init(optimizer.init, params)
+        step = make_mesh_grad_step(
+            make_moe_mesh_loss_fn(model, mesh), optimizer
+        )
+        batch = (sds((8, 12, 9), jax.numpy.float32),
+                 sds((8,), jax.numpy.int32))
+        jitted = jax.jit(step, donate_argnums=(0, 1))
+        return jitted, (params, opt_state, batch)
+
+    register(
+        name="moe.mesh_train_step", family="moe",
+        path="pytorch_distributed_rnn_tpu/training/moe.py",
+        build=build, mesh_axes={"dp": 2, "ep": 2}, data_axis="dp",
+        donate=(0, 1),
+    )

@@ -164,6 +164,45 @@ class NativeDDPTrainer(Trainer):
         return step
 
 
+# ---------------------------------------------------------------------------
+# pdrnn-lint --deep trace registry (lint/trace_registry.py)
+
+
+def declare_trace_entries(register):
+    """Register the per-rank device programs of the TCP-transport DDP
+    step.  The host allreduce between them cannot trace, so the donated
+    update program is registered on its own - exactly the surface the
+    donation rule (PD205) guards: params/opt_state are dead after the
+    update reassigns both."""
+
+    def build():
+        from pytorch_distributed_rnn_tpu.lint.trace_registry import (
+            abstract_init,
+            prng_spec,
+        )
+        from pytorch_distributed_rnn_tpu.models import MotionModel
+
+        model = MotionModel(input_dim=9, hidden_dim=8, layer_dim=1,
+                            output_dim=6, impl="scan")
+        params = abstract_init(model.init, prng_spec())
+        optimizer = optax.adam(1e-3)
+        opt_state = abstract_init(optimizer.init, params)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def apply_update(p, state, grads):
+            updates, state = optimizer.update(grads, state, p)
+            return optax.apply_updates(p, updates), state
+
+        return apply_update, (params, opt_state, params)
+
+    register(
+        name="native_ddp.apply_update", family="ddp",
+        path="pytorch_distributed_rnn_tpu/training/native_ddp.py",
+        build=build, mesh_axes={}, data_axis=None, donate=(0, 1),
+        kind="update",
+    )
+
+
 def run_rank(comm, args, model, datasets, trainer_class=None):
     """Train this rank's replica; returns the trainer (rank 0 writes
     ``history.json``, every rank logs its perf line).  ``trainer_class``

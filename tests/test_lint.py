@@ -300,6 +300,39 @@ def grads2(g):
         assert codes(result) == ["PD101"]
         assert result.findings[0].symbol == "grads2"
 
+    def test_noqa_on_multiline_call_start_line_suppresses(self, tmp_path):
+        """The finding anchors to the axis literal's CONTINUATION line;
+        the directive on the line the call starts on must still count
+        (a directive cannot legally live on a bare string argument
+        line)."""
+        result = lint_src(tmp_path, """
+def grads(g):
+    return lax.psum(  # noqa: PD101
+        g,
+        "dq",
+    )
+""")
+        assert codes(result) == []
+        # ...while a directive for a DIFFERENT rule does not suppress
+        result = lint_src(tmp_path, """
+def grads(g):
+    return lax.psum(  # noqa: PD105
+        g,
+        "dq",
+    )
+""")
+        assert codes(result) == ["PD101"]
+
+    def test_noqa_on_decorator_line_suppresses_def_finding(self, tmp_path):
+        """PD103's decorator-form finding anchors to the ``def`` line;
+        the directive belongs on the ``@jit`` span it suppresses."""
+        result = lint_src(tmp_path, """
+@jax.jit  # noqa: PD103
+def update(opt_state, grads):
+    return opt_state
+""")
+        assert codes(result) == []
+
 
 class TestCLI:
     def _write_bad(self, tmp_path):
@@ -382,10 +415,123 @@ def grads_new(g):
         again = run_lint([f], root=tmp_path, baseline=loaded)
         assert again.findings == [] and again.suppressed == 2
 
+    def test_exit_codes_explicit(self, tmp_path, capsys):
+        """The CLI exit-code contract, asserted directly: findings ->
+        1 (text AND json), clean -> 0, --write-baseline -> 0 even with
+        findings."""
+        bad = self._write_bad(tmp_path)
+        clean = tmp_path / "clean.py"
+        clean.write_text(MESH_PREAMBLE + "\n\ndef ok():\n    return 1\n")
+
+        assert lint_main([str(bad), "--no-baseline"]) == 1
+        capsys.readouterr()
+        assert lint_main([str(bad), "--no-baseline",
+                          "--format", "json"]) == 1
+        assert json.loads(capsys.readouterr().out)["counts"]
+        assert lint_main([str(clean), "--no-baseline"]) == 0
+        capsys.readouterr()
+        assert lint_main([str(clean), "--no-baseline",
+                          "--format", "json"]) == 0
+        assert json.loads(capsys.readouterr().out)["findings"] == []
+        # --write-baseline accepts the findings and exits clean
+        baseline = tmp_path / "b.json"
+        assert lint_main([str(bad), "--baseline", str(baseline),
+                          "--write-baseline"]) == 0
+        assert baseline.exists()
+
+    def test_prune_baseline_drops_stale_entries(self, tmp_path, capsys):
+        """Entries whose fingerprint no longer matches any current
+        finding are dropped instead of accumulating silently."""
+        f = self._write_bad(tmp_path)
+        baseline = tmp_path / "baseline.json"
+        assert lint_main([str(f), "--baseline", str(baseline),
+                          "--write-baseline"]) == 0
+        assert sum(load_baseline(baseline).values()) == 2
+
+        # fix the PD105 stub; its baseline entry is now stale
+        f.write_text(MESH_PREAMBLE + """
+def grads(g):
+    return lax.psum(g, "dq")
+""")
+        capsys.readouterr()
+        rc = lint_main([str(f), "--baseline", str(baseline),
+                        "--prune-baseline"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "pruned 1 stale" in out
+        assert sum(load_baseline(baseline).values()) == 1
+        # the remaining entry still suppresses the remaining finding
+        assert lint_main([str(f), "--baseline", str(baseline)]) == 0
+        # pruning again is a no-op
+        capsys.readouterr()
+        assert lint_main([str(f), "--baseline", str(baseline),
+                          "--prune-baseline"]) == 0
+        assert "pruned 0 stale" in capsys.readouterr().out
+
+    def test_prune_baseline_preserves_entries_outside_linted_paths(
+            self, tmp_path, capsys):
+        """Pruning while linting a path SUBSET must not wipe accepted
+        entries for files outside that subset - they look stale only
+        because they were never re-scanned."""
+        a = self._write_bad(tmp_path)
+        b = tmp_path / "other.py"
+        b.write_text(MESH_PREAMBLE + "\n\ndef todo2():\n    pass\n")
+        baseline = tmp_path / "baseline.json"
+        assert lint_main([str(a), str(b), "--baseline", str(baseline),
+                          "--write-baseline"]) == 0
+        assert sum(load_baseline(baseline).values()) == 3
+        capsys.readouterr()
+        # prune linting ONLY bad.py: other.py's entry must survive
+        rc = lint_main([str(a), "--baseline", str(baseline),
+                        "--prune-baseline"])
+        assert rc == 0
+        assert "pruned 0 stale" in capsys.readouterr().out
+        assert sum(load_baseline(baseline).values()) == 3
+        # the full-path run still exits clean against it
+        assert lint_main([str(a), str(b), "--baseline",
+                          str(baseline)]) == 0
+
+    def test_write_baseline_preserves_entries_outside_linted_paths(
+            self, tmp_path, capsys):
+        """--write-baseline on a path subset merges: current findings
+        for the scanned files, untouched entries for the rest."""
+        a = self._write_bad(tmp_path)
+        b = tmp_path / "other.py"
+        b.write_text(MESH_PREAMBLE + "\n\ndef todo2():\n    pass\n")
+        baseline = tmp_path / "baseline.json"
+        assert lint_main([str(a), str(b), "--baseline", str(baseline),
+                          "--write-baseline"]) == 0
+        # fix ONE of bad.py's findings, rewrite from bad.py alone
+        a.write_text(MESH_PREAMBLE + """
+def grads(g):
+    return lax.psum(g, "dq")
+""")
+        assert lint_main([str(a), "--baseline", str(baseline),
+                          "--write-baseline"]) == 0
+        entries = load_baseline(baseline)
+        assert sum(entries.values()) == 2  # bad.py's 1 + other.py's 1
+        assert lint_main([str(a), str(b), "--baseline",
+                          str(baseline)]) == 0
+
+    def test_prune_baseline_refuses_filters_and_write_combo(
+            self, tmp_path, capsys):
+        f = self._write_bad(tmp_path)
+        baseline = tmp_path / "baseline.json"
+        rc = lint_main([str(f), "--baseline", str(baseline),
+                        "--select", "PD105", "--prune-baseline"])
+        assert rc == 2
+        assert "unfiltered" in capsys.readouterr().err
+        rc = lint_main([str(f), "--baseline", str(baseline),
+                        "--write-baseline", "--prune-baseline"])
+        assert rc == 2
+        assert "mutually exclusive" in capsys.readouterr().err
+
     def test_list_rules(self, capsys):
         assert lint_main(["--list-rules"]) == 0
         out = capsys.readouterr().out
-        for code in ("PD101", "PD102", "PD103", "PD104", "PD105"):
+        for code in ("PD101", "PD102", "PD103", "PD104", "PD105",
+                     "PD200", "PD201", "PD202", "PD203", "PD204",
+                     "PD205"):
             assert code in out
 
     def test_missing_path_is_usage_error(self, tmp_path):
