@@ -15,8 +15,14 @@ import pandas as pd
 
 # The machine-readable telemetry contract (formatter.py:27).  Rank is part
 # of the line; the notebooks anchored on rank 0 ('0: Memory Usage: ...').
+# The value pattern is wider than the notebooks' \d+\.\d+ on purpose:
+# performance_message formats RAW floats, so a sub-millisecond duration
+# renders as '5e-05' and an integer-valued memory as '700' - the original
+# regex silently dropped both (the formatter<->parser round-trip test in
+# tests/test_evaluation.py pins the contract).
+_FLOAT = r"\d+(?:\.\d+)?(?:[eE][+-]?\d+)?"
 PERF_LINE_RE = re.compile(
-    r"(\d+): Memory Usage: (\d+\.\d+), Training Duration: (\d+\.\d+)"
+    rf"(\d+): Memory Usage: ({_FLOAT}), Training Duration: ({_FLOAT})"
 )
 
 TRAIN_SIZE_RE = re.compile(r"Training set of size (\d+)")
@@ -34,13 +40,60 @@ def parse_perf_lines(text: str):
     ]
 
 
+def _structured_measurements(run):
+    """``[(rank, memory_mb, duration_s, extras), ...]`` from the run's
+    metrics JSONL sidecar (``obs/``), or ``None`` when the run carries no
+    usable sidecar - the caller then falls back to the perf-line regex.
+
+    The sidecar is the structured-first path: unlike the regex it
+    survives crashed runs' partial telemetry, and it carries the numbers
+    the perf line never had (step times, data-wait fraction, collective
+    traffic, HBM peaks), surfaced as extra dataframe columns.
+    """
+    path = run.get("metrics_path") or (
+        (run.get("parameters") or {}).get("metrics")
+    )
+    if not path:
+        return None
+    from pytorch_distributed_rnn_tpu.obs.summary import (
+        MalformedMetricsError,
+        summarize_run,
+    )
+
+    try:
+        summaries = summarize_run(path)
+    except MalformedMetricsError:
+        return None
+    measurements = []
+    for s in summaries:
+        if s.get("duration_s") is None or s.get("memory_mb") is None:
+            continue  # run died before its run_summary event
+        measurements.append((
+            s["rank"], s["memory_mb"], s["duration_s"],
+            {
+                "step_s_mean": s.get("step_s_mean"),
+                "data_wait_frac": s.get("data_wait_frac"),
+                "collective_bytes_per_step": s.get(
+                    "collective_bytes_per_step"
+                ),
+                "device_peak_mb": s.get("device_peak_mb"),
+                "telemetry": True,
+            },
+        ))
+    return measurements or None
+
+
 def create_measurement_df(results) -> pd.DataFrame:
     """Measurement dataframe from launcher results (the ``create_measurement_df``
     analogue, one row per (run, rank)).
 
     ``results`` is the list the launcher appends to ``results_*.json`` — or a
-    path to such a file.  Runs whose stderr carries no perf line (crashes)
-    are dropped, exactly as the notebooks' regex silently skipped them.
+    path to such a file.  Structured-first: a run whose entry names a
+    metrics sidecar (``metrics_path`` / the ``--metrics`` parameter) is
+    measured from the sidecar, no regex involved; legacy stderr-only
+    entries fall back to the perf-line regex.  Runs with neither (crashes
+    predating telemetry) are dropped, exactly as the notebooks' regex
+    silently skipped them.
     """
     if isinstance(results, (str, Path)):
         with open(results) as f:
@@ -49,14 +102,20 @@ def create_measurement_df(results) -> pd.DataFrame:
     rows = []
     for run_id, run in enumerate(results):
         text = (run.get("stderr") or "") + "\n" + (run.get("stdout") or "")
-        perf = parse_perf_lines(text)
+        structured = _structured_measurements(run)
+        if structured is not None:
+            perf = [(r, m, d) for r, m, d, _ in structured]
+            extras = [e for _, _, _, e in structured]
+        else:
+            perf = parse_perf_lines(text)
+            extras = [{} for _ in perf]
         size_match = TRAIN_SIZE_RE.search(text)
         num_sequences = (
             int(size_match.group(1)) if size_match else DEFAULT_NUM_SEQUENCES
         )
         params = run.get("parameters", {})
         epochs = int(params.get("epochs", 1))
-        for rank, memory, duration in perf:
+        for (rank, memory, duration), extra in zip(perf, extras):
             rows.append(
                 {
                     "run": run_id,  # position in the results file: repeated
@@ -78,6 +137,7 @@ def create_measurement_df(results) -> pd.DataFrame:
                     "seq_per_sec": num_sequences * epochs / duration
                     if duration > 0
                     else float("nan"),
+                    **extra,
                 }
             )
     return pd.DataFrame(rows)

@@ -59,6 +59,14 @@ def _add_common(parser):
         "--backend", choices=["cpu", "native"], default="cpu",
         help="cpu: virtual-device fake cluster; native: attached accelerator",
     )
+    parser.add_argument(
+        "--metrics-dir", default=None, metavar="DIR",
+        help="per-run structured telemetry: each run writes a JSONL "
+        "sidecar under DIR (--metrics plumbed into the run's CLI) and "
+        "the results JSON archives its path as metrics_path - the "
+        "structured channel evaluation/analysis.py prefers over the "
+        "stderr perf-line regex",
+    )
 
 
 def _dataset_parameters(args):
@@ -219,6 +227,7 @@ def main(argv=None):
             backend=args.backend,
             timeout=args.timeout,
             native_ranks=args.native_ranks,
+            metrics_dir=args.metrics_dir,
         )
         return _report(executed, args.results)
 
@@ -236,7 +245,8 @@ def main(argv=None):
             )
         ]
     executed = bench.run_benchmark(
-        configs, args.results, timeout=args.timeout
+        configs, args.results, timeout=args.timeout,
+        metrics_dir=args.metrics_dir,
     )
     return _report(executed, args.results)
 

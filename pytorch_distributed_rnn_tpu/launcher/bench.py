@@ -289,11 +289,46 @@ def _append_result(path, results: list, entry: dict):
     os.replace(tmp, path)
 
 
+def metrics_sidecar_path(metrics_dir, config: RunConfig,
+                         salt: str = "") -> Path:
+    """The per-run metrics sidecar path under ``metrics_dir``: keyed by
+    the hash of (``salt``, command string).  ``salt`` is the sweep's
+    results path, so re-running the SAME config into a different results
+    file (a baseline-vs-candidate diff sharing one --metrics-dir) gets
+    its own sidecar instead of truncating the earlier sweep's - while
+    repeats over the same results file still overwrite only their own."""
+    import hashlib
+
+    digest = hashlib.sha1(
+        f"{salt}\n{command_string(config)}".encode()
+    ).hexdigest()[:16]
+    return Path(metrics_dir) / f"run-{digest}.jsonl"
+
+
 def execute_run(config: RunConfig, timeout: float | None = None,
-                cwd=None) -> dict:
+                cwd=None, metrics_dir=None, metrics_salt: str = "") -> dict:
     """Run one config as a subprocess; capture everything the notebooks and
-    resume logic need (the per-run dict shape follows fabfile.py:280-290)."""
-    argv, extra_env = get_command(config)
+    resume logic need (the per-run dict shape follows fabfile.py:280-290).
+
+    With ``metrics_dir`` set, the run gets a ``--metrics`` sidecar under
+    it and the entry archives the path as ``metrics_path`` - the
+    structured measurement channel ``evaluation/analysis.py`` prefers
+    over the stderr perf-line regex.  The archived ``command`` stays the
+    UNinstrumented one so resume-by-skip matches runs across sweeps with
+    and without telemetry.
+    """
+    metrics_path = None
+    run_config = config
+    if metrics_dir is not None:
+        sidecar = metrics_sidecar_path(metrics_dir, config, metrics_salt)
+        sidecar.parent.mkdir(parents=True, exist_ok=True)
+        metrics_path = str(sidecar)
+        run_config = make_config(
+            config.trainer, config.devices, config.slots,
+            {**config.parameters_dict(), "metrics": metrics_path},
+            config.backend, config.fault_type, config.fault_value,
+        )
+    argv, extra_env = get_command(run_config)
     env = dict(os.environ)
     env.update(extra_env)
     # make the framework importable regardless of the run's cwd (the
@@ -318,7 +353,7 @@ def execute_run(config: RunConfig, timeout: float | None = None,
         stderr = (exc.stderr.decode() if isinstance(exc.stderr, bytes) else (
             exc.stderr or "")) + f"\n[launcher] timed out after {timeout}s"
     duration = time.perf_counter() - start
-    return {
+    entry = {
         "trainer": config.trainer,
         "devices": config.devices,
         "slots": config.slots,
@@ -331,6 +366,9 @@ def execute_run(config: RunConfig, timeout: float | None = None,
         "stderr": stderr,
         "wall_seconds": duration,
     }
+    if metrics_path is not None:
+        entry["metrics_path"] = metrics_path
+    return entry
 
 
 def run_benchmark(
@@ -340,6 +378,7 @@ def run_benchmark(
     timeout: float | None = None,
     executor=execute_run,
     log=print,
+    metrics_dir=None,
 ):
     """Execute ``configs`` (shuffled), appending to ``results_path``.
 
@@ -347,6 +386,8 @@ def run_benchmark(
     skipped — re-running after a crash continues where it left off.
     Returns the list of result entries actually executed (callers can
     check ``returncode`` to distinguish a clean sweep from failures).
+    ``metrics_dir`` turns on per-run telemetry sidecars (see
+    :func:`execute_run`).
     """
     results = load_results(results_path)
     executed_commands = {r.get("command") for r in results}
@@ -359,10 +400,19 @@ def run_benchmark(
     if shuffle_seed is not None:
         random.Random(shuffle_seed).shuffle(pending)
 
+    # only forwarded when set, so custom executors (tests inject stubs
+    # with the historical signature) keep working untouched
+    extra_kwargs = {} if metrics_dir is None else {
+        "metrics_dir": metrics_dir,
+        # salt the sidecar names with the results path so two sweeps
+        # sharing a --metrics-dir (baseline vs candidate) never
+        # truncate each other's telemetry
+        "metrics_salt": str(results_path),
+    }
     executed = []
     for i, config in enumerate(pending):
         log(f"[{i + 1}/{len(pending)}] {command_string(config)}")
-        entry = executor(config, timeout=timeout)
+        entry = executor(config, timeout=timeout, **extra_kwargs)
         _append_result(results_path, results, entry)
         executed.append(entry)
         status = "ok" if entry.get("returncode") == 0 else "FAILED"
@@ -381,6 +431,7 @@ def run_network_test(
     executor=execute_run,
     log=print,
     native_ranks: int = 4,
+    metrics_dir=None,
 ):
     """Network-perturbation sweep (``fab run_network_test`` analogue).
 
@@ -417,7 +468,7 @@ def run_network_test(
         )
     return run_benchmark(
         configs, results_path, shuffle_seed=None, timeout=timeout,
-        executor=executor, log=log,
+        executor=executor, log=log, metrics_dir=metrics_dir,
     )
 
 

@@ -17,6 +17,14 @@ A new trainer family plugs in by adding its module to
 :data:`PROVIDER_MODULES` and defining ``declare_trace_entries``; see the
 README "Static analysis" section for the contract.
 
+Telemetry note: the observability subsystem (``obs/``) instruments the
+step LOOPS, never the step PROGRAMS - timing and fencing happen around
+the jitted call, and the traced-collectives event re-traces the live
+step with ``jax.make_jaxpr`` without wrapping it.  The registered
+entries here therefore keep covering instrumented trainers as-is;
+``tests/test_obs.py::test_recorder_is_trace_transparent`` pins that a
+recorder-enabled trainer builds a byte-identical step jaxpr.
+
 This module imports jax only inside functions, so listing rule codes
 and building the CLI stays jax-free.
 """

@@ -193,6 +193,28 @@ def build_parser() -> argparse.ArgumentParser:
         "DIR (viewable in TensorBoard/Perfetto); the reference had only "
         "whole-run wall-clock + RSS",
     )
+    parser.add_argument(
+        "--profile-steps", default=None, metavar="A:B",
+        help="bound the --profile capture to optimizer steps [A, B) "
+        "instead of tracing the whole run (steady-state steps without "
+        "the compile/warm-up noise); skipped gracefully on backends "
+        "without profiler support",
+    )
+    parser.add_argument(
+        "--metrics", default=None, type=Path, metavar="PATH",
+        help="structured run telemetry (obs/): write rank-tagged JSONL "
+        "events (per-step loss/timing/data-wait, collective traffic, "
+        "memory peaks, checkpoint/chaos/guard events) to PATH, buffered "
+        "off the hot path; summarize with pdrnn-metrics.  Also read "
+        "from the PDRNN_METRICS env when the flag is absent.  The "
+        "legacy perf line is emitted either way",
+    )
+    parser.add_argument(
+        "--metrics-sample-every", default=None, type=int, metavar="N",
+        help="telemetry fence cadence: every N-th step blocks on the "
+        "step's outputs to measure true step wall time (default 16); "
+        "the other steps stay fully async",
+    )
 
     sub_parser = parser.add_subparsers(
         title="Available commands", metavar="command [options ...]"

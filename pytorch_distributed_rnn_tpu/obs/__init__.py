@@ -1,0 +1,52 @@
+"""Structured run telemetry (the observability spine).
+
+- :mod:`.recorder`: rank-tagged JSONL event stream, buffered off the
+  training hot path (:class:`MetricsRecorder` / :data:`NULL_RECORDER`).
+- :mod:`.profile`: step-bounded ``jax.profiler`` capture
+  (``--profile-steps A:B``).
+- :mod:`.summary`: sidecar loading, summaries, diffs, stragglers.
+- :mod:`.cli`: the ``pdrnn-metrics`` CLI over those summaries.
+
+This package imports neither jax nor the training stack at module
+import time, so CLI startup and jax-free tooling stay cheap.
+"""
+
+from pytorch_distributed_rnn_tpu.obs.profile import StepTraceCapture
+from pytorch_distributed_rnn_tpu.obs.recorder import (
+    METRICS_ENV,
+    METRICS_SAMPLE_ENV,
+    NULL_RECORDER,
+    SCHEMA_VERSION,
+    MetricsRecorder,
+    NullRecorder,
+    rank_suffixed,
+)
+from pytorch_distributed_rnn_tpu.obs.summary import (
+    MalformedMetricsError,
+    detect_stragglers,
+    diff_summaries,
+    load_events,
+    rank_files,
+    summarize_events,
+    summarize_file,
+    summarize_run,
+)
+
+__all__ = [
+    "METRICS_ENV",
+    "METRICS_SAMPLE_ENV",
+    "NULL_RECORDER",
+    "SCHEMA_VERSION",
+    "MalformedMetricsError",
+    "MetricsRecorder",
+    "NullRecorder",
+    "StepTraceCapture",
+    "detect_stragglers",
+    "diff_summaries",
+    "load_events",
+    "rank_files",
+    "rank_suffixed",
+    "summarize_events",
+    "summarize_file",
+    "summarize_run",
+]

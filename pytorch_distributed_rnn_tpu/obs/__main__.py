@@ -1,0 +1,6 @@
+import sys
+
+from pytorch_distributed_rnn_tpu.obs.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

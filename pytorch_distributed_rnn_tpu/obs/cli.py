@@ -1,0 +1,169 @@
+"""``pdrnn-metrics``: summarize / diff / stragglers over metrics sidecars.
+
+Exit-code contract (pinned by tests and used as a CI gate):
+
+- ``0`` clean (summary printed; no regression; no straggler)
+- ``1`` signal found (``diff``: a regression past the threshold;
+  ``stragglers``: a rank past the spread threshold)
+- ``2`` malformed input (unreadable file, bad JSONL, schema drift)
+
+Examples::
+
+  pdrnn-metrics summarize metrics.jsonl
+  pdrnn-metrics diff baseline.jsonl candidate.jsonl --threshold 10
+  pdrnn-metrics stragglers metrics.jsonl   # picks up -r<k> siblings
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from pytorch_distributed_rnn_tpu.obs.summary import (
+    MalformedMetricsError,
+    detect_stragglers,
+    diff_summaries,
+    rank_files,
+    summarize_file,
+)
+
+_SUMMARY_FIELDS = (
+    ("steps", "{:d}"),
+    ("epochs", "{:d}"),
+    ("loss_first", "{:.6f}"),
+    ("loss_last", "{:.6f}"),
+    ("step_s_mean", "{:.6f}"),
+    ("step_s_p50", "{:.6f}"),
+    ("step_s_p95", "{:.6f}"),
+    ("data_wait_frac", "{:.4f}"),
+    ("collective_bytes_per_step", "{:,d}"),
+    ("duration_s", "{:.3f}"),
+    ("memory_mb", "{:.1f}"),
+    ("device_peak_mb", "{:.1f}"),
+    ("nan_skipped", "{:d}"),
+    ("ps_exchanges", "{:d}"),
+    ("ps_retries", "{:d}"),
+    ("ps_degraded_rounds", "{:d}"),
+    ("checkpoint_saves", "{:d}"),
+)
+
+
+def _print_summary(summary: dict, out=print):
+    out(f"{summary['path']} (rank {summary['rank']})")
+    for field, fmt in _SUMMARY_FIELDS:
+        value = summary.get(field)
+        if value is None or value == {}:
+            continue
+        try:
+            rendered = fmt.format(value)
+        except (TypeError, ValueError):
+            rendered = str(value)
+        out(f"  {field:26s} {rendered}")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="pdrnn-metrics", description=(
+        "Summarize, diff and straggler-scan pdrnn metrics JSONL sidecars"
+    ))
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("summarize", help="per-rank run summary")
+    p.add_argument("files", nargs="+", help="metrics JSONL sidecar(s)")
+    p.add_argument("--json", action="store_true", help="machine output")
+
+    p = sub.add_parser("diff", help="regression check candidate vs baseline")
+    p.add_argument("baseline")
+    p.add_argument("candidate")
+    p.add_argument("--threshold", type=float, default=10.0, metavar="PCT",
+                   help="regression tolerance in percent (default 10)")
+    p.add_argument("--json", action="store_true")
+
+    p = sub.add_parser(
+        "stragglers",
+        help="cross-rank step-time spread (rank-suffixed siblings "
+        "of each file are included automatically)",
+    )
+    p.add_argument("files", nargs="+")
+    p.add_argument("--threshold", type=float, default=0.25, metavar="FRAC",
+                   help="flag ranks this fraction above the median step "
+                   "time (default 0.25)")
+    p.add_argument("--json", action="store_true")
+
+    args = parser.parse_args(argv)
+    try:
+        return _dispatch(args)
+    except MalformedMetricsError as exc:
+        print(f"pdrnn-metrics: {exc}", file=sys.stderr)
+        return 2
+    except BrokenPipeError:  # `pdrnn-metrics ... | head` is fine
+        return 0
+
+
+def _dispatch(args) -> int:
+    if args.cmd == "summarize":
+        summaries = [summarize_file(path) for path in args.files]
+        if args.json:
+            print(json.dumps(summaries, indent=1))
+        else:
+            for summary in summaries:
+                _print_summary(summary)
+        return 0
+
+    if args.cmd == "diff":
+        base = summarize_file(args.baseline)
+        cand = summarize_file(args.candidate)
+        regressions = diff_summaries(base, cand, args.threshold)
+        if args.json:
+            print(json.dumps(regressions, indent=1))
+        else:
+            if not regressions:
+                print(
+                    f"no regression past {args.threshold:g}% "
+                    f"({args.candidate} vs {args.baseline})"
+                )
+            for r in regressions:
+                print(
+                    f"REGRESSION {r['metric']}: {r['baseline']:.6g} -> "
+                    f"{r['candidate']:.6g} (+{r['delta_pct']:.1f}%)"
+                )
+        return 1 if regressions else 0
+
+    # stragglers: expand every given path to its rank family so the
+    # common case (pass the rank-0 sidecar) sees the whole world.
+    # Dedup by resolved path: a shell glob passes the -r<k> siblings
+    # explicitly TOO, and a double-counted rank shifts the median onto
+    # the straggler, masking it.
+    summaries, seen = [], set()
+    for path in args.files:
+        family = rank_files(path)
+        if not family:
+            raise MalformedMetricsError(f"{path}: no metrics sidecar found")
+        for member in family:
+            resolved = Path(member).resolve()
+            if resolved in seen:
+                continue
+            seen.add(resolved)
+            summaries.append(summarize_file(member))
+    summaries.sort(key=lambda s: s["rank"])
+    flagged = detect_stragglers(summaries, args.threshold)
+    if args.json:
+        print(json.dumps(flagged, indent=1))
+    else:
+        if not flagged:
+            print(
+                f"no straggler past {args.threshold:g}x-over-median "
+                f"across {len(summaries)} rank(s)"
+            )
+        for f in flagged:
+            print(
+                f"STRAGGLER rank {f['rank']}: mean step "
+                f"{f['step_s_mean']:.6f}s vs median {f['median_s']:.6f}s "
+                f"(+{100 * f['excess_frac']:.0f}%)"
+            )
+    return 1 if flagged else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

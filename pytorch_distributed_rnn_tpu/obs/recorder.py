@@ -1,0 +1,245 @@
+"""Structured run telemetry: rank-tagged JSONL event stream.
+
+The reference's only machine-readable telemetry is ONE regex-parsed
+stderr line per run (``training/formatter.py`` perf line), which says
+nothing about *where* time goes and silently vanishes when a run
+crashes.  :class:`MetricsRecorder` is the structured replacement:
+every process appends per-step / per-epoch / subsystem events to a
+JSONL sidecar, buffered in memory and flushed by a background thread so
+nothing rides the training hot path.  The legacy perf line is untouched
+- the sidecar is an addition, not a replacement (``evaluation/
+analysis.py`` prefers it and falls back to the regex).
+
+Hot-path contract:
+
+- disabled telemetry is :data:`NULL_RECORDER` - a no-op object with NO
+  flush thread and ``enabled = False``, so instrumented call sites cost
+  one attribute check (the zero-overhead guard test pins this);
+- ``record()`` appends a dict to an in-memory buffer under a lock and
+  (past a threshold) *signals* the writer thread - it never touches the
+  filesystem itself;
+- device fencing (``jax.block_until_ready``) happens only on a sampled
+  cadence (``sample_every``), so steady-state dispatch stays async.
+
+Event schema (``schema = 1``; one JSON object per line, every event
+carries ``kind``, ``t`` (unix seconds) and ``rank``):
+
+=================== =======================================================
+kind                payload
+=================== =======================================================
+meta                schema, sample_every, argv? - always the FIRST line
+step                step, epoch, loss, dispatch_s, data_wait_s,
+                    fenced_s (sampled steps only)
+epoch               epoch, steps, loss, acc, wall_s, path (scan|step|host)
+eval                epoch (null = test), loss, acc
+collectives         ops {hlo-op: {count, bytes}}, bytes_per_step - traced
+                    once per run from the live step program
+checkpoint_save     epoch, best, seconds, format
+checkpoint_restore  path, epoch, seconds
+nan_skip            new, total, consecutive
+fault               action, trigger, where
+ps_exchange         what (push|pull), step, seconds, retries
+ps_round            updates, gathered, expected, degraded
+ps_worker_dead      worker, error
+ps_summary          updates, degraded_rounds, workers_lost
+profile             dir, start, stop, captured
+run_summary         memory_mb, duration_s, device_peaks_mb, steps,
+                    nan_skipped, faults_fired
+=================== =======================================================
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from pathlib import Path
+
+log = logging.getLogger(__name__)
+
+SCHEMA_VERSION = 1
+
+# env half of the CLI contract (the --metrics flag beats it), mirroring
+# PDRNN_CHAOS: spawned worker processes inherit telemetry without CLI
+# plumbing through every launcher layer
+METRICS_ENV = "PDRNN_METRICS"
+METRICS_SAMPLE_ENV = "PDRNN_METRICS_SAMPLE"
+
+_DEFAULT_SAMPLE_EVERY = 16
+_FLUSH_THRESHOLD = 256  # events buffered before the writer is signalled
+_FLUSH_INTERVAL_S = 2.0  # writer wake cadence even below the threshold
+
+
+def rank_suffixed(path, rank: int) -> Path:
+    """The per-process sidecar path: rank 0 keeps ``path`` verbatim (the
+    single-process case stays simple), other ranks insert ``-r<rank>``
+    before the suffix so a multi-process world never interleaves writers
+    in one file."""
+    path = Path(path)
+    if rank == 0:
+        return path
+    return path.with_name(f"{path.stem}-r{rank}{path.suffix}")
+
+
+class NullRecorder:
+    """Telemetry off: every hook is a no-op and ``enabled`` is False so
+    instrumented loops skip their bookkeeping entirely - no thread, no
+    fencing, no buffering."""
+
+    enabled = False
+    rank = 0
+    sample_every = 0
+    path = None
+
+    def record(self, kind: str, **fields) -> None:  # noqa: PD105 - null object
+        pass
+
+    def is_sample_step(self, step: int) -> bool:
+        return False
+
+    def flush(self) -> None:  # noqa: PD105 - null object by design
+        pass
+
+    def close(self) -> None:  # noqa: PD105 - null object by design
+        pass
+
+    def __bool__(self) -> bool:
+        return False
+
+
+NULL_RECORDER = NullRecorder()
+
+
+class MetricsRecorder:
+    """Buffered JSONL event writer with a background flush thread."""
+
+    enabled = True
+
+    def __init__(self, path, rank: int = 0,
+                 sample_every: int = _DEFAULT_SAMPLE_EVERY,
+                 flush_threshold: int = _FLUSH_THRESHOLD,
+                 meta: dict | None = None):
+        if sample_every < 1:
+            raise ValueError(
+                f"metrics sample cadence must be >= 1, got {sample_every}"
+            )
+        self.rank = int(rank)
+        self.sample_every = int(sample_every)
+        self.path = rank_suffixed(path, self.rank)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._lock = threading.Lock()  # buffer swap (record vs drain)
+        self._io_lock = threading.Lock()  # file append (drain vs drain)
+        self._buffer: list[dict] = []
+        self._flush_threshold = int(flush_threshold)
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._closed = False
+        # meta is the FIRST line, written synchronously: a sidecar that
+        # exists always declares its schema, even if the run dies before
+        # the first flush
+        head = {
+            "kind": "meta", "t": time.time(), "rank": self.rank,
+            "schema": SCHEMA_VERSION, "sample_every": self.sample_every,
+        }
+        head.update(meta or {})
+        with open(self.path, "w") as f:
+            f.write(json.dumps(head) + "\n")
+        self._thread = threading.Thread(
+            target=self._writer, name="pdrnn-metrics", daemon=True
+        )
+        self._thread.start()
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def resolve(cls, args, rank: int = 0, meta: dict | None = None):
+        """The ONE CLI resolution path (``--metrics`` flag beats the
+        ``PDRNN_METRICS`` env), shared by every strategy entry point so
+        telemetry can never be silently dropped by one of them.  Returns
+        :data:`NULL_RECORDER` when telemetry is off."""
+        spec = getattr(args, "metrics", None) or os.environ.get(METRICS_ENV)
+        if not spec:
+            return NULL_RECORDER
+        sample = getattr(args, "metrics_sample_every", None)
+        if sample is None:
+            sample = int(
+                os.environ.get(METRICS_SAMPLE_ENV, _DEFAULT_SAMPLE_EVERY)
+            )
+        return cls(spec, rank=rank, sample_every=int(sample), meta=meta)
+
+    # -- hot-path API --------------------------------------------------------
+
+    def record(self, kind: str, **fields) -> None:
+        event = {"kind": kind, "t": time.time(), "rank": self.rank}
+        event.update(fields)
+        with self._lock:
+            self._buffer.append(event)
+            signal = len(self._buffer) >= self._flush_threshold
+        if signal:
+            self._wake.set()
+
+    def is_sample_step(self, step: int) -> bool:
+        """Whether this step pays the fencing round-trip (step wall-time
+        measurement): every ``sample_every``-th step, plus step 1 - the
+        first STEADY-STATE step (step 0 carries the compile and is
+        excluded from timing summaries), so even a short run has one
+        honest fenced wall-time sample."""
+        return step == 1 or step % self.sample_every == 0
+
+    # -- writer --------------------------------------------------------------
+
+    def _writer(self):
+        while not self._stop.is_set():
+            self._wake.wait(timeout=_FLUSH_INTERVAL_S)
+            self._wake.clear()
+            self._drain()
+        self._drain()
+
+    def _drain(self):
+        # _io_lock serializes WHOLE drains: a caller-thread flush() (e.g.
+        # the pre-kill chaos flush) racing the writer thread's timed drain
+        # must not interleave its batch's buffered chunks mid-line with
+        # the other's - a single torn line fails the strict loader for
+        # the whole sidecar.  Holding it across the swap also keeps batch
+        # order = record order.
+        with self._io_lock:
+            with self._lock:
+                batch, self._buffer = self._buffer, []
+            if not batch:
+                return
+            try:
+                with open(self.path, "a") as f:
+                    for event in batch:
+                        f.write(json.dumps(event, default=_jsonable) + "\n")
+            except OSError as exc:  # telemetry must never kill the run
+                log.warning(f"metrics flush to {self.path} failed: {exc}")
+
+    def flush(self) -> None:
+        """Synchronous drain (tests and run teardown)."""
+        self._drain()
+
+    def close(self) -> None:
+        """Stop the writer thread and flush everything; idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=5.0)
+        self._drain()
+
+    def __del__(self):  # pragma: no cover - GC timing is interpreter-specific
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def _jsonable(value):
+    """Last-resort coercion for numpy/jax scalars riding in events."""
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        return str(value)

@@ -1,0 +1,251 @@
+"""Metrics-sidecar loading, per-run summaries, diffs, stragglers.
+
+Shared by the ``pdrnn-metrics`` CLI and the structured-first loader in
+``evaluation/analysis.py`` so the two can never disagree on what a
+sidecar means.  Loading is STRICT (:class:`MalformedMetricsError` on
+any unparseable line, missing ``kind``, or an incompatible schema
+declaration): the CI smoke step exists to catch schema drift, and a
+loader that shrugs off bad lines would wave it through.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import statistics
+from pathlib import Path
+
+from pytorch_distributed_rnn_tpu.obs.recorder import SCHEMA_VERSION
+
+
+class MalformedMetricsError(ValueError):
+    """The sidecar is unreadable, unparseable, or schema-incompatible."""
+
+
+def rank_files(path) -> list[Path]:
+    """All per-rank sidecars belonging to one run: the rank-0 file plus
+    any ``<stem>-r<k><suffix>`` siblings (``recorder.rank_suffixed``)."""
+    path = Path(path)
+    files = [path] if path.exists() else []
+    pattern = f"{path.stem}-r*{path.suffix}"
+    if path.parent.is_dir():
+        siblings = [
+            p for p in path.parent.glob(pattern)
+            if p.stem[len(path.stem):].lstrip("-r").isdigit()
+        ]
+        files.extend(sorted(siblings))
+    return files
+
+
+def load_events(path) -> list[dict]:
+    """One run's events off one JSONL sidecar, validated."""
+    path = Path(path)
+    try:
+        text = path.read_text()
+    except OSError as exc:
+        raise MalformedMetricsError(f"{path}: unreadable ({exc})") from exc
+    events = []
+    lines = text.splitlines()
+    # a file whose last line is cut off mid-write (no trailing newline)
+    # is a process killed mid-append - SIGKILL chaos faults, launcher
+    # timeouts - and losing ONE torn event must not forfeit the rest:
+    # partial telemetry of crashed runs is what the sidecar exists for.
+    # Anything else unparseable is schema drift and stays a hard error.
+    truncated_tail = bool(lines) and not text.endswith("\n")
+    for lineno, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            event = json.loads(line)
+        except json.JSONDecodeError as exc:
+            if truncated_tail and lineno == len(lines):
+                break
+            raise MalformedMetricsError(
+                f"{path}:{lineno}: unparseable JSONL ({exc})"
+            ) from exc
+        if not isinstance(event, dict) or "kind" not in event:
+            raise MalformedMetricsError(
+                f"{path}:{lineno}: event without a 'kind' field"
+            )
+        events.append(event)
+    if not events:
+        raise MalformedMetricsError(f"{path}: empty metrics file")
+    head = events[0]
+    if head.get("kind") != "meta":
+        raise MalformedMetricsError(
+            f"{path}: first event must be 'meta', got {head.get('kind')!r}"
+        )
+    schema = head.get("schema")
+    if not isinstance(schema, int) or schema > SCHEMA_VERSION:
+        raise MalformedMetricsError(
+            f"{path}: schema {schema!r} is newer than this reader's "
+            f"{SCHEMA_VERSION}"
+        )
+    return events
+
+
+def _percentile(sorted_values: list[float], q: float) -> float:
+    if not sorted_values:
+        return float("nan")
+    idx = min(len(sorted_values) - 1, int(math.ceil(q * len(sorted_values))) - 1)
+    return sorted_values[max(0, idx)]
+
+
+def summarize_events(events: list[dict], path=None) -> dict:
+    """One rank's summary: the numbers ``pdrnn-metrics summarize`` prints
+    and ``evaluation/analysis.py`` folds into the measurement dataframe."""
+    meta = events[0]
+    steps = [e for e in events if e["kind"] == "step"]
+    epochs = [e for e in events if e["kind"] == "epoch"]
+    run = next(
+        (e for e in reversed(events) if e["kind"] == "run_summary"), None
+    )
+    collectives = next(
+        (e for e in events if e["kind"] == "collectives"), None
+    )
+
+    # warm-up exclusion for TIMING stats: the run's first step carries
+    # the compile (orders of magnitude above steady state on a jit
+    # framework) and would dominate every mean/percentile
+    if len(steps) > 1:
+        first = min(int(e.get("step", 0)) for e in steps)
+        timed = [e for e in steps if int(e.get("step", 0)) != first]
+    else:
+        timed = steps
+    dispatch = [float(e["dispatch_s"]) for e in timed if "dispatch_s" in e]
+    fenced = sorted(
+        float(e["fenced_s"]) for e in timed if e.get("fenced_s") is not None
+    )
+    data_wait = [float(e.get("data_wait_s", 0.0)) for e in steps]
+    losses = [float(e["loss"]) for e in steps if e.get("loss") is not None]
+    if not losses:
+        losses = [float(e["loss"]) for e in epochs if e.get("loss") is not None]
+
+    epoch_wall = sum(
+        float(e["wall_s"]) for e in epochs if e.get("wall_s") is not None
+    )
+    # data-wait fraction: input-pipeline stall share of the epochs' wall
+    # time; falls back to dispatch time when no epoch event carries wall
+    denom = epoch_wall or sum(dispatch) or float("nan")
+    wait_total = sum(data_wait)
+
+    # step wall time: the fenced samples are honest wall clock (dispatch
+    # alone understates an async step by the device time)
+    step_basis = fenced or sorted(dispatch)
+
+    summary = {
+        "path": str(path) if path is not None else None,
+        "rank": int(meta.get("rank", 0)),
+        "schema": meta.get("schema"),
+        "steps": len(steps),
+        "epochs": len(epochs),
+        "fenced_samples": len(fenced),
+        "loss_first": losses[0] if losses else None,
+        "loss_last": losses[-1] if losses else None,
+        "step_s_mean": (sum(step_basis) / len(step_basis))
+        if step_basis else None,
+        "step_s_p50": _percentile(step_basis, 0.50) if step_basis else None,
+        "step_s_p95": _percentile(step_basis, 0.95) if step_basis else None,
+        "data_wait_s": wait_total,
+        "data_wait_frac": (wait_total / denom)
+        if denom == denom and denom > 0 else None,
+        "collective_bytes_per_step": (
+            collectives.get("bytes_per_step") if collectives else None
+        ),
+        "collective_ops": collectives.get("ops") if collectives else None,
+        "duration_s": float(run["duration_s"]) if run else None,
+        "memory_mb": float(run["memory_mb"]) if run else None,
+        "device_peak_mb": (
+            max(run["device_peaks_mb"].values())
+            if run and run.get("device_peaks_mb") else None
+        ),
+        "nan_skipped": (run or {}).get("nan_skipped", 0),
+        "faults_fired": (run or {}).get("faults_fired", {}),
+        "checkpoint_saves": sum(
+            1 for e in events if e["kind"] == "checkpoint_save"
+        ),
+        "ps_exchanges": sum(
+            1 for e in events if e["kind"] == "ps_exchange"
+        ),
+        "ps_retries": sum(
+            int(e.get("retries", 0)) for e in events
+            if e["kind"] == "ps_exchange"
+        ),
+        "ps_degraded_rounds": sum(
+            1 for e in events
+            if e["kind"] == "ps_round" and e.get("degraded")
+        ),
+    }
+    return summary
+
+
+def summarize_file(path) -> dict:
+    return summarize_events(load_events(path), path=path)
+
+
+def summarize_run(path) -> list[dict]:
+    """Per-rank summaries for one run's sidecar family (rank-0 path plus
+    ``-r<k>`` siblings), sorted by rank."""
+    files = rank_files(path)
+    if not files:
+        raise MalformedMetricsError(f"{path}: no metrics sidecar found")
+    return sorted(
+        (summarize_file(p) for p in files), key=lambda s: s["rank"]
+    )
+
+
+# metrics where "bigger" is a regression, diffed by pdrnn-metrics diff
+REGRESSION_METRICS = (
+    "step_s_mean", "step_s_p95", "duration_s", "memory_mb",
+    "device_peak_mb", "data_wait_frac",
+)
+
+
+def diff_summaries(baseline: dict, candidate: dict,
+                   threshold_pct: float = 10.0) -> list[dict]:
+    """Regressions of ``candidate`` vs ``baseline``: every
+    :data:`REGRESSION_METRICS` entry present in both and worse by more
+    than ``threshold_pct`` percent."""
+    regressions = []
+    for metric in REGRESSION_METRICS:
+        base, cand = baseline.get(metric), candidate.get(metric)
+        if base is None or cand is None or base <= 0:
+            continue
+        delta_pct = 100.0 * (cand - base) / base
+        if delta_pct > threshold_pct:
+            regressions.append({
+                "metric": metric,
+                "baseline": base,
+                "candidate": cand,
+                "delta_pct": delta_pct,
+            })
+    return regressions
+
+
+def detect_stragglers(summaries: list[dict],
+                      threshold: float = 0.25) -> list[dict]:
+    """Cross-rank straggler detection: ranks whose mean step time sits
+    more than ``threshold`` (fraction) above the cross-rank median.
+    Needs >= 2 ranks with step-time data; returns ``[{rank, step_s_mean,
+    median_s, excess_frac}, ...]``."""
+    timed = [
+        s for s in summaries if s.get("step_s_mean") is not None
+    ]
+    if len(timed) < 2:
+        return []
+    median = statistics.median(s["step_s_mean"] for s in timed)
+    if median <= 0:
+        return []
+    flagged = []
+    for s in timed:
+        excess = s["step_s_mean"] / median - 1.0
+        if excess > threshold:
+            flagged.append({
+                "rank": s["rank"],
+                "path": s.get("path"),
+                "step_s_mean": s["step_s_mean"],
+                "median_s": median,
+                "excess_frac": excess,
+            })
+    return sorted(flagged, key=lambda f: -f["excess_frac"])

@@ -55,13 +55,16 @@ def add_sub_command(sub_parser):
 def execute(args):
     from pytorch_distributed_rnn_tpu.param_server.runner import run
 
-    if getattr(args, "profile", None):
+    if getattr(args, "profile", None) or getattr(args, "profile_steps", None):
         # training happens in spawned worker processes; a parent-process
         # trace would be empty - fail loudly instead of silently writing
-        # nothing (the other subcommands support --profile)
+        # nothing (the other subcommands support --profile/--profile-steps.
+        # --metrics IS supported: each spawned role writes its own
+        # rank-suffixed sidecar)
         raise SystemExit(
-            "--profile is not supported by the parameter-server strategy "
-            "(training runs in spawned worker processes)"
+            "--profile/--profile-steps are not supported by the "
+            "parameter-server strategy (training runs in spawned worker "
+            "processes)"
         )
     from pytorch_distributed_rnn_tpu.training.families import require_family
 

@@ -38,7 +38,7 @@ log = logging.getLogger(__name__)
 class ParameterServerMaster:
     def __init__(self, comm, flat_params: np.ndarray, apply_update,
                  sync_mode=False, sync_timeout: float = 300.0,
-                 quorum: float = 1.0):
+                 quorum: float = 1.0, recorder=None):
         """``apply_update(flat_grads) -> flat_params`` advances the owned
         state by one optimizer step and returns the new flat params.
         ``sync_timeout`` bounds how long a sync-mode round waits for
@@ -55,6 +55,12 @@ class ParameterServerMaster:
         round as an ordinary (stale) contribution."""
         if not 0.0 < quorum <= 1.0:
             raise ValueError(f"quorum must be in (0, 1], got {quorum}")
+        # structured telemetry (obs/recorder.py): degraded rounds, dead
+        # workers and the serve() summary become events the CLI can
+        # summarize - quorum degradations were previously log-only
+        from pytorch_distributed_rnn_tpu.obs.recorder import NULL_RECORDER
+
+        self.recorder = recorder if recorder is not None else NULL_RECORDER
         self.comm = comm
         self.params = flat_params.astype(np.float32)
         self.apply_update = apply_update
@@ -124,6 +130,12 @@ class ParameterServerMaster:
                f"{len(tolerated)} worker(s) lost" if tolerated
                or self.degraded_rounds else "")
         )
+        self.recorder.record(
+            "ps_summary", updates=self.updates_applied,
+            degraded_rounds=self.degraded_rounds,
+            workers_lost=len(tolerated),
+        )
+        self.recorder.flush()
         return self.params
 
     def _mark_dead(self, worker: int, exc: BaseException):
@@ -134,6 +146,10 @@ class ParameterServerMaster:
         log.warning(
             f"worker {worker} dropped from the sync rendezvous "
             f"({type(exc).__name__}: {exc}); degrading to survivors"
+        )
+        self.recorder.record(
+            "ps_worker_dead", worker=worker,
+            error=f"{type(exc).__name__}: {str(exc)[:200]}",
         )
         with self._sync_cv:
             self._dead.add(worker)
@@ -246,6 +262,11 @@ class ParameterServerMaster:
                     f"{num_workers} gradient(s) after {self.sync_timeout}s "
                     f"({missing} straggler(s)); applying partial average "
                     f"(degraded rounds so far: {self.degraded_rounds})"
+                )
+                self.recorder.record(
+                    "ps_round", updates=self.updates_applied,
+                    gathered=len(self._pending), expected=num_workers,
+                    degraded=True,
                 )
                 self._close_round()
                 return

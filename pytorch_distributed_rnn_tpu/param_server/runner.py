@@ -75,6 +75,11 @@ def run_master(args):
         state["opt"] = new_opt
         return state["flat"]
 
+    from pytorch_distributed_rnn_tpu.obs import MetricsRecorder
+
+    # the master's sidecar is rank-0's (workers are ranks >= 1): quorum
+    # degradations and dead workers land next to the workers' step events
+    recorder = MetricsRecorder.resolve(args, rank=0, meta={"role": "master"})
     comm = Communicator(
         args.master_address, int(args.master_port), 0, args.world_size
     )
@@ -83,10 +88,12 @@ def run_master(args):
             comm, flat, apply_update, sync_mode=(args.ps_mode == "sync"),
             sync_timeout=getattr(args, "ps_sync_timeout", 300.0),
             quorum=getattr(args, "ps_quorum", 1.0),
+            recorder=recorder,
         )
         final = master.serve()
     finally:
         comm.close()
+        recorder.close()
     return final
 
 
@@ -118,9 +125,14 @@ def run_worker(args, rank: int):
     model, _, _ = _build_model_and_flat_params(
         args, training_set, args.seed
     )
+    from pytorch_distributed_rnn_tpu.obs import MetricsRecorder
     from pytorch_distributed_rnn_tpu.training import families
 
     trainer_class = families.wrap_trainer(args, ParameterServerWorkerTrainer)
+    # per-worker telemetry sidecar (rank-suffixed path): ps_exchange
+    # latency/retry events plus the base trainer's step/epoch stream
+    recorder = MetricsRecorder.resolve(args, rank=rank,
+                                       meta={"role": "worker"})
     try:
         trainer = trainer_class(
             comm,
@@ -140,11 +152,13 @@ def run_worker(args, rank: int):
             checkpoint_async=getattr(args, "checkpoint_async", False),
             transport_retries=getattr(args, "ps_transport_retries", 3),
             faults=_worker_faults(args, rank),
+            recorder=recorder,
         )
         _, train_history, _ = trainer.train(epochs=args.epochs)
         trainer.finish()
     finally:
         comm.close()
+        recorder.close()
 
     if rank == 1:
         with open("history.json", "w") as file:

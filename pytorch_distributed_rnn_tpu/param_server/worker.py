@@ -16,6 +16,7 @@ checkpointing are disabled on workers like the reference
 from __future__ import annotations
 
 import logging
+import time
 
 import jax
 import numpy as np
@@ -116,11 +117,39 @@ class ParameterServerWorkerTrainer(Trainer):
         retried WHOLE (request + reply); safe for pushes because the
         header's per-step sequence number lets the master detect a
         duplicate (original applied, reply leg lost) and resend params
-        without averaging the gradient in twice."""
-        return retry_transport(
-            fn, retries=self._transport_retries, seed=self.worker_rank,
-            what=f"{what} (worker {self.worker_rank})",
-        )
+        without averaging the gradient in twice.
+
+        Telemetry: each exchange records latency + retry count as a
+        ``ps_exchange`` event (the wire half of a PS step the in-program
+        collective counters can never see)."""
+        recording = self.recorder.enabled
+        retries = [0]
+
+        def on_retry(attempt, exc):
+            retries[0] = attempt
+
+        t0 = time.perf_counter() if recording else 0.0
+        try:
+            result = retry_transport(
+                fn, retries=self._transport_retries, seed=self.worker_rank,
+                what=f"{what} (worker {self.worker_rank})",
+                on_retry=on_retry if recording else None,
+            )
+        except Exception:
+            if recording:
+                self.recorder.record(
+                    "ps_exchange", what=what, step=self._steps_done,
+                    seconds=time.perf_counter() - t0,
+                    retries=retries[0], failed=True,
+                )
+                self.recorder.flush()  # the run is about to die with this
+            raise
+        if recording:
+            self.recorder.record(
+                "ps_exchange", what=what, step=self._steps_done,
+                seconds=time.perf_counter() - t0, retries=retries[0],
+            )
+        return result
 
     def _adopt(self, flat_params: np.ndarray):
         assert flat_params.size == self.num_params, "parameter size mismatch"

@@ -121,6 +121,11 @@ class FaultSchedule:
         self.rank = rank
         # observability: {action: count} of faults actually fired
         self.fired: dict[str, int] = {}
+        # structured telemetry (obs/recorder.py): the trainer binds its
+        # recorder here so every fired fault becomes a 'fault' event; a
+        # late attribute (not a constructor arg) so resilience stays
+        # importable without the obs package in the picture
+        self.recorder = None
 
     # -- construction --------------------------------------------------------
 
@@ -215,6 +220,7 @@ class FaultSchedule:
         worker).  Counters are fresh - each process owns its own."""
         bound = FaultSchedule(list(self.events), self.network, self.seed,
                               rank=int(rank))
+        bound.recorder = self.recorder
         return bound
 
     # -- trigger matching ----------------------------------------------------
@@ -244,6 +250,15 @@ class FaultSchedule:
     def _fire(self, event: FaultEvent, where: str):
         self.fired[event.action] = self.fired.get(event.action, 0) + 1
         log.warning(f"chaos: injecting {event} at {where}")
+        if self.recorder is not None and self.recorder.enabled:
+            self.recorder.record(
+                "fault", action=event.action, trigger=event.trigger,
+                where=where,
+            )
+            if event.action == "kill":
+                # SIGKILL joins no flush thread: drain NOW or the event
+                # (the whole point of chaos telemetry) dies with us
+                self.recorder.flush()
 
     # -- action execution ----------------------------------------------------
 

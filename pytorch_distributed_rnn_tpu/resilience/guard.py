@@ -51,6 +51,10 @@ class NonFiniteGuard:
             raise ValueError(f"bad-step limit must be >= 1, got {limit}")
         self.limit = int(limit)
         self.total_skipped = 0
+        # structured telemetry (obs/recorder.py): bound by the trainer so
+        # every newly observed skip becomes a 'nan_skip' event; a late
+        # attribute so resilience needs no obs import
+        self.recorder = None
 
     def wrap(self, optimizer):
         return optax.apply_if_finite(
@@ -67,6 +71,11 @@ class NonFiniteGuard:
                 f"non-finite gradients: skipped {total - self.total_skipped} "
                 f"step(s) (total {total}, consecutive {consecutive})"
             )
+            if self.recorder is not None and self.recorder.enabled:
+                self.recorder.record(
+                    "nan_skip", new=total - self.total_skipped,
+                    total=total, consecutive=consecutive,
+                )
             self.total_skipped = total
         if consecutive > self.limit:
             raise NonFiniteAbort(

@@ -31,11 +31,13 @@ def backoff_delays(retries: int, base_delay: float = 0.05,
 def retry_transport(fn, *, retries: int = 3, base_delay: float = 0.05,
                     max_delay: float = 2.0, seed: int = 0,
                     retryable=(RuntimeError, OSError), what: str = "exchange",
-                    sleep=time.sleep):
+                    sleep=time.sleep, on_retry=None):
     """Run ``fn()``; on a retryable transport error, back off and re-run.
 
     Raises the FIRST error (the diagnostic one, matching the trainer's
     compile-retry convention) once ``retries`` re-attempts are exhausted.
+    ``on_retry(attempt, exc)`` (if given) is called before each backoff
+    sleep - the telemetry hook counting retries per exchange.
     """
     delays = backoff_delays(retries, base_delay, max_delay, seed)
     first_exc = None
@@ -51,4 +53,6 @@ def retry_transport(fn, *, retries: int = 3, base_delay: float = 0.05,
                 f"transport {what} failed ({type(exc).__name__}: {exc}); "
                 f"retry {attempt + 1}/{retries} in {delay:.3f}s"
             )
+            if on_retry is not None:
+                on_retry(attempt + 1, exc)
             sleep(delay)

@@ -143,6 +143,12 @@ def _log_and_trim_datasets(args, training_set, validation_set, test_set):
 def _run_trainer(args, trainer_class, model, datasets):
     """The strategy-independent tail of every CLI run: construct, resume,
     (optionally trace,) train, dump rank-0 history."""
+    import jax
+
+    from pytorch_distributed_rnn_tpu.obs import (
+        MetricsRecorder,
+        StepTraceCapture,
+    )
     from pytorch_distributed_rnn_tpu.resilience import FaultSchedule
 
     # resolve() also bridges net events onto the transport's
@@ -150,6 +156,12 @@ def _run_trainer(args, trainer_class, model, datasets):
     faults = FaultSchedule.resolve(args)
     if faults is not None:
         logging.warning(f"chaos schedule active: {faults}")
+
+    # structured telemetry (obs/): --metrics flag beats the PDRNN_METRICS
+    # env; rank-tagged per controller process so multi-controller worlds
+    # never share a sidecar.  NULL recorder (zero overhead) when off.
+    recorder = MetricsRecorder.resolve(args, rank=jax.process_index())
+    profile_steps = StepTraceCapture.resolve(args)
 
     training_set, validation_set, test_set = datasets
     trainer = trainer_class(
@@ -169,6 +181,8 @@ def _run_trainer(args, trainer_class, model, datasets):
         faults=faults,
         max_bad_steps=getattr(args, "max_bad_steps", 0),
         keep_checkpoints=getattr(args, "keep_checkpoints", 0),
+        recorder=recorder,
+        profile_steps=profile_steps,
     )
 
     resume = getattr(args, "resume", None)
@@ -191,24 +205,28 @@ def _run_trainer(args, trainer_class, model, datasets):
     import contextlib
 
     profile_dir = getattr(args, "profile", None)
-    if profile_dir:
+    if profile_dir and profile_steps is None:
         # step-level device tracing (new capability - the reference only
-        # had whole-run wall-clock + RSS, SURVEY.md §5 "Tracing")
-        import jax
-
+        # had whole-run wall-clock + RSS, SURVEY.md §5 "Tracing").  With
+        # --profile-steps the capture is step-bounded and owned by the
+        # trainer's StepTraceCapture instead of a whole-run trace.
         trace_cm = jax.profiler.trace(str(profile_dir))
     else:
         trace_cm = contextlib.nullcontext()
-    with trace_cm:
-        _, train_history, validation_history = trainer.train(
-            epochs=args.epochs
-        )
+    try:
+        with trace_cm:
+            _, train_history, validation_history = trainer.train(
+                epochs=args.epochs
+            )
+    finally:
+        # the writer thread must drain even when training raises - the
+        # partial telemetry of a crashed run is exactly what the perf-line
+        # pipeline always lost
+        recorder.close()
     history = {
         "train_history": train_history,
         "validation_history": validation_history,
     }
-    import jax
-
     if jax.process_index() == 0:  # rank-0-only output in a world
         with open("history.json", "w") as file:
             json.dump(history, file)

@@ -23,6 +23,7 @@ reads its own checkpoints, SURVEY §5).
 from __future__ import annotations
 
 import logging
+import time
 from pathlib import Path
 
 import jax
@@ -31,6 +32,7 @@ import numpy as np
 import optax
 
 from pytorch_distributed_rnn_tpu.data.loader import DataLoader
+from pytorch_distributed_rnn_tpu.obs.recorder import NULL_RECORDER
 from pytorch_distributed_rnn_tpu.data.prefetch import prefetch
 from pytorch_distributed_rnn_tpu.data.sampler import DistributedSampler
 from pytorch_distributed_rnn_tpu.ops.losses import cross_entropy_loss
@@ -42,6 +44,13 @@ from pytorch_distributed_rnn_tpu.training.checkpoint import (
 )
 from pytorch_distributed_rnn_tpu.training.formatter import TrainingMessageFormatter
 from pytorch_distributed_rnn_tpu.utils.profiling import measure_memory_and_time
+
+
+def _fence(value):
+    """The telemetry/profiler device fence - a module-level seam so the
+    zero-overhead guard test can count fences (disabled telemetry must
+    never add a per-step host sync)."""
+    jax.block_until_ready(value)
 
 
 def _correct_count(value) -> int:
@@ -95,8 +104,20 @@ class Trainer:
         faults=None,
         max_bad_steps: int = 0,
         keep_checkpoints: int = 0,
+        recorder=None,
+        profile_steps=None,
     ):
         self.model = model
+        # structured telemetry (obs/recorder.py): NULL_RECORDER when off -
+        # instrumented call sites then cost one attribute check and the
+        # step loops keep their uninstrumented shape (no fencing, no
+        # per-step bookkeeping)
+        self.recorder = recorder if recorder is not None else NULL_RECORDER
+        # step-bounded jax.profiler capture (obs/profile.py); forces the
+        # per-batch dispatch path so steps are addressable
+        self._profile = profile_steps
+        # traced collective traffic is recorded once per run
+        self._collectives_recorded = False
         # gathered: the reference-parity single file (training/
         # checkpoint.py) - state is gathered to the writing host.
         # sharded: orbax/tensorstore per-shard writes - no gather, no
@@ -139,6 +160,12 @@ class Trainer:
         # optimizer is wrapped so NaN/Inf-gradient steps are skipped inside
         # the compiled program and the host aborts past K consecutive
         self.guard = NonFiniteGuard(max_bad_steps) if max_bad_steps else None
+        # the resilience subsystems emit their own telemetry (nan_skip /
+        # fault events) through the same recorder
+        if self.guard is not None:
+            self.guard.recorder = self.recorder
+        if self._faults is not None:
+            self._faults.recorder = self.recorder
         self.rank = 0
         self.world_size = 1
 
@@ -615,6 +642,14 @@ class Trainer:
                 self._run_fn = None
 
         logging.info(formatter.performance_message(memory, duration))
+        device_peaks = getattr(self, "_last_device_peaks", {}) or {}
+        if device_peaks:
+            # a SEPARATE line: the perf line above stays byte-compatible
+            # with the reference notebooks' regex
+            rendered = ", ".join(
+                f"{d}={mb:.1f}" for d, mb in sorted(device_peaks.items())
+            )
+            logging.info(f"Device HBM peaks (MiB): {rendered}")
         if self.guard is not None and self.guard.total_skipped:
             logging.info(
                 f"non-finite guard: skipped {self.guard.total_skipped} "
@@ -622,6 +657,23 @@ class Trainer:
             )
         if self._faults is not None and self._faults.fired:
             logging.info(f"chaos: faults fired {self._faults.fired}")
+        if self._profile is not None:
+            self.recorder.record("profile", **self._profile.close())
+        self.recorder.record(
+            "run_summary",
+            memory_mb=memory,
+            duration_s=duration,
+            device_peaks_mb=device_peaks,
+            steps=self._steps_done,
+            epochs=epochs,
+            nan_skipped=(
+                self.guard.total_skipped if self.guard is not None else 0
+            ),
+            faults_fired=(
+                dict(self._faults.fired) if self._faults is not None else {}
+            ),
+        )
+        self.recorder.flush()
 
         if self.test_set is not None:
             self._evaluate(self.test_set, formatter)
@@ -664,6 +716,11 @@ class Trainer:
             # at epoch (or step) boundaries
             and self._faults is None
             and self._start_epoch == 0
+            # step-bounded profiling addresses individual steps
+            and self._profile is None
+            # per-step telemetry needs the host per epoch at least; an
+            # EXPLICIT --fuse-run still wins (epoch-level events only)
+            and (self._fuse_run or not self.recorder.enabled)
         )
         if self._fuse_run and not fusable:
             # the user explicitly asked for one-program training; falling
@@ -725,7 +782,10 @@ class Trainer:
                 # checkpoints exist for)
                 self._drain_checkpoint()
 
-        _, memory, duration = measure_memory_and_time(train_inner)
+        _, memory, duration, device_peaks = measure_memory_and_time(
+            train_inner, include_device_memory=True
+        )
+        self._last_device_peaks = device_peaks
         return memory, duration
 
     def _train_run_fused(self, epochs: int):
@@ -765,7 +825,47 @@ class Trainer:
             self.guard.check(self.opt_state)
         losses = np.asarray(losses).reshape(epochs, num_batches)
         n = len(self.training_set)
-        return [float(losses[e].sum()) / n for e in range(epochs)]
+        history = [float(losses[e].sum()) / n for e in range(epochs)]
+        if self.recorder.enabled:
+            # the fused run's telemetry is post-hoc by design (its whole
+            # point is zero host visits): per-epoch losses only
+            for e, loss in enumerate(history):
+                self.recorder.record(
+                    "epoch", epoch=e, steps=num_batches, loss=loss,
+                    acc=None, wall_s=None, path="fused",
+                )
+        return history
+
+    def _maybe_record_collectives(self, step_fn, *args):
+        """Trace the LIVE step program once and record its per-step
+        collective traffic (``evaluation/collectives.
+        closed_jaxpr_collective_stats`` - scan trip counts multiplied in).
+        Tracing is abstract (no execution, no compile) and happens once
+        per run, before the first dispatch.  Steps that are host
+        functions (native-TCP DDP, the PS worker's push/pull) abort the
+        trace on their first host conversion - telemetry then records
+        the absence instead of failing the run."""
+        if self._collectives_recorded or not self.recorder.enabled:
+            return
+        self._collectives_recorded = True
+        from pytorch_distributed_rnn_tpu.evaluation.collectives import (
+            closed_jaxpr_collective_stats,
+        )
+
+        try:
+            stats = closed_jaxpr_collective_stats(
+                jax.make_jaxpr(step_fn)(*args)
+            )
+        except Exception as exc:  # host-loop steps are untraceable
+            self.recorder.record(
+                "collectives", ops=None, bytes_per_step=None,
+                error=f"{type(exc).__name__}: {str(exc)[:200]}",
+            )
+            return
+        self.recorder.record(
+            "collectives", ops=stats,
+            bytes_per_step=sum(s["bytes"] for s in stats.values()),
+        )
 
     def _chaos_host_loop(self) -> bool:
         """Whether an attached fault schedule forces the per-batch host
@@ -783,6 +883,11 @@ class Trainer:
         # device round-trip per batch; at INFO the epoch runs as one
         # scanned program and only epoch-level messages are emitted
         log_progress = logging.getLogger().isEnabledFor(logging.DEBUG)
+        # telemetry and step-bounded profiling also need per-step dispatch
+        # (to address/time individual steps), but NOT per-step host values:
+        # losses stay device scalars until epoch end, and only the sampled
+        # fence cadence pays a device round-trip
+        recording = self.recorder.enabled
         features, labels = self._device_train_data()
         batches = self._epoch_index_batches()
         keys = (
@@ -799,26 +904,70 @@ class Trainer:
         # step), values the host needs for history/logging anyway.
         total_loss = 0.0
         total_correct = 0.0
+        t_epoch = time.perf_counter()
+        epoch_path = "scan"
 
-        if log_progress:
-            # per-batch progress needs values on host each step: dispatch
-            # batch-by-batch (still device-gathered, only indices transfer)
+        if log_progress or recording or self._profile is not None:
+            epoch_path = "step"
+            # run-relative step addresses, matching the host loop's
+            # convention (and _steps_done's documented contract): a
+            # resumed run's telemetry and --profile-steps ranges count
+            # steps EXECUTED THIS RUN on every strategy
+            step_base = self._steps_done
+            losses, corrects, raw = [], [], []
             for batch_idx, idx in enumerate(batches):
+                step = step_base + batch_idx
                 extra = (keys[batch_idx],) if keys is not None else ()
+                if recording:
+                    self._maybe_record_collectives(
+                        self._idx_step_fn, self.params,
+                        self.opt_state, features, labels, idx, *extra,
+                    )
+                if self._profile is not None:
+                    self._profile.on_step_start(step)
+                t0 = time.perf_counter()
                 self.params, self.opt_state, loss, metrics = self._idx_step_fn(
                     self.params, self.opt_state, features, labels, idx, *extra
                 )
-                total_loss += float(loss)
-                total_correct += float(metrics["correct"])
-                logging.debug(
-                    formatter.train_progress_message(
-                        batch_idx=batch_idx,
-                        batches=len(batches),
-                        training_examples=len(idx),
-                        correct=_correct_count(metrics["correct"]),
-                        loss=float(loss),
+                dispatch_s = time.perf_counter() - t0
+                fenced_s = None
+                if recording and self.recorder.is_sample_step(step):
+                    _fence(loss)
+                    fenced_s = time.perf_counter() - t0
+                if self._profile is not None:
+                    self._profile.on_step_end(step, fence_value=loss)
+                self._steps_done = step + 1
+                if log_progress:
+                    # the progress message needs values NOW - this path
+                    # keeps the documented fetch-per-batch cost of -v
+                    losses.append(float(loss))
+                    corrects.append(float(metrics["correct"]))
+                    logging.debug(
+                        formatter.train_progress_message(
+                            batch_idx=batch_idx,
+                            batches=len(batches),
+                            training_examples=len(idx),
+                            correct=_correct_count(corrects[-1]),
+                            loss=losses[-1],
+                        )
                     )
-                )
+                else:
+                    losses.append(loss)
+                    corrects.append(metrics["correct"])
+                if recording:
+                    raw.append((step, dispatch_s, fenced_s))
+            total_loss = sum(float(l) for l in losses)
+            total_correct = sum(float(c) for c in corrects)
+            if recording:
+                # step events are emitted AFTER the loop: the deferred
+                # float() fetches here are the same epoch-end fetch the
+                # uninstrumented path already pays, not per-step syncs
+                for (step, dispatch_s, fenced_s), loss_v in zip(raw, losses):
+                    self.recorder.record(
+                        "step", step=step, epoch=self._epoch,
+                        loss=float(loss_v), dispatch_s=dispatch_s,
+                        data_wait_s=0.0, fenced_s=fenced_s,
+                    )
         else:
             # fast path: all equal-size batches as ONE scanned program,
             # the final partial batch (if any) as one extra step
@@ -856,6 +1005,11 @@ class Trainer:
         # guard decides here (updates were already skipped in-program)
         if self.guard is not None:
             self.guard.check(self.opt_state)
+        self.recorder.record(
+            "epoch", epoch=self._epoch, steps=len(batches),
+            loss=train_loss, acc=train_acc,
+            wall_s=time.perf_counter() - t_epoch, path=epoch_path,
+        )
         return train_loss, train_acc
 
     # host-path input pipeline: how many prepared batches ride ahead of
@@ -895,6 +1049,8 @@ class Trainer:
                     faults.on_producer_item(epoch_base + i)
                 yield self._prepare_batch(f, l)
 
+        recording = self.recorder.enabled
+        t_epoch = time.perf_counter()
         stream = prefetch(source(), depth=self.PREFETCH_DEPTH)
         # device-scalar accumulators, fetched after the loop: the
         # programs' loss/metrics outputs are replicated over the
@@ -902,17 +1058,43 @@ class Trainer:
         # every rank - while accumulating into a process-LOCAL device
         # zero could land the sum on a device other controllers cannot
         # address
-        losses, corrects = [], []
+        losses, corrects, raw = [], [], []
         try:
-            for batch_idx, batch in enumerate(stream):
+            batch_iter = iter(stream)
+            batch_idx = 0
+            while True:
+                # the wait for the prefetch producer IS the input-bound
+                # signal: with the pipeline keeping up it is ~0, and any
+                # stall here is time the device sat idle for data
+                t_wait = time.perf_counter()
+                try:
+                    batch = next(batch_iter)
+                except StopIteration:
+                    break
+                data_wait_s = time.perf_counter() - t_wait
                 step = epoch_base + batch_idx
                 if faults is not None:
                     faults.maybe_kill(step=step)
                     batch = faults.corrupt_batch(step, batch)
                 extra = (keys[batch_idx],) if keys is not None else ()
+                if recording:
+                    self._maybe_record_collectives(
+                        self._train_step_fn, self.params, self.opt_state,
+                        batch, *extra,
+                    )
+                if self._profile is not None:
+                    self._profile.on_step_start(step)
+                t0 = time.perf_counter()
                 self.params, self.opt_state, loss, metrics = self._train_step_fn(
                     self.params, self.opt_state, batch, *extra
                 )
+                dispatch_s = time.perf_counter() - t0
+                fenced_s = None
+                if recording and self.recorder.is_sample_step(step):
+                    _fence(loss)
+                    fenced_s = time.perf_counter() - t0
+                if self._profile is not None:
+                    self._profile.on_step_end(step, fence_value=loss)
                 self._steps_done = step + 1
                 if self.guard is not None and faults is not None:
                     # chaos runs are per-batch already; deciding per step
@@ -937,6 +1119,9 @@ class Trainer:
                 else:
                     losses.append(loss)
                     corrects.append(metrics["correct"])
+                if recording:
+                    raw.append((step, dispatch_s, fenced_s, data_wait_s))
+                batch_idx += 1
         finally:
             # an early exit (injected exception, guard abort) must not
             # leave the prefetch producer thread running behind us
@@ -944,11 +1129,27 @@ class Trainer:
 
         total_loss = sum(float(l) for l in losses)
         total_correct = sum(float(c) for c in corrects)
+        if recording:
+            # step events emitted after the loop: the float() fetches are
+            # the epoch-end fetch the uninstrumented path already pays
+            for (step, dispatch_s, fenced_s, data_wait_s), loss_v in zip(
+                raw, losses
+            ):
+                self.recorder.record(
+                    "step", step=step, epoch=self._epoch,
+                    loss=float(loss_v), dispatch_s=dispatch_s,
+                    data_wait_s=data_wait_s, fenced_s=fenced_s,
+                )
         # parity quirk kept: sum of batch-mean losses / dataset size
         train_loss = total_loss / len(self.training_set)
         train_acc = total_correct / len(self.training_set)
         if self.guard is not None:
             self.guard.check(self.opt_state)
+        self.recorder.record(
+            "epoch", epoch=self._epoch, steps=len(losses),
+            loss=train_loss, acc=train_acc,
+            wall_s=time.perf_counter() - t_epoch, path="host",
+        )
         return train_loss, train_acc
 
     def _evaluate(self, dataset, formatter, epoch=None):
@@ -968,6 +1169,9 @@ class Trainer:
         total_correct = float(metrics["correct"])
         num_examples = len(dataset)
         accuracy = total_correct / num_examples
+        self.recorder.record(
+            "eval", epoch=epoch, loss=eval_loss, acc=accuracy
+        )
         logging.info(
             formatter.evaluation_message(
                 accuracy, num_examples, epoch, eval_loss,
@@ -994,6 +1198,19 @@ class Trainer:
     def _save_checkpoint(self, epoch, loss, best=False):
         if self.checkpoint_dir is None:
             return
+        t0 = time.perf_counter()
+        self._write_checkpoint(epoch, loss, best)
+        self.recorder.record(
+            "checkpoint_save", epoch=epoch, best=bool(best),
+            seconds=time.perf_counter() - t0,
+            format=self.checkpoint_format,
+            # an async sharded save only DISPATCHES here; the drain at
+            # the next save / train end is where the rest of the cost
+            # lands (inside the timed region either way)
+            asynchronous=self.checkpoint_async,
+        )
+
+    def _write_checkpoint(self, epoch, loss, best=False):
         if self.checkpoint_format == "sharded":
             from pytorch_distributed_rnn_tpu.training.sharded_checkpoint import (  # noqa: E501 - lazy: orbax import is heavy
                 save_sharded,
@@ -1048,6 +1265,7 @@ class Trainer:
             restore_sharded,
         )
 
+        t0 = time.perf_counter()
         if is_sharded_checkpoint(checkpoint_path):
             self.params, self.opt_state, meta = restore_sharded(
                 checkpoint_path, self.params, self.opt_state
@@ -1067,4 +1285,9 @@ class Trainer:
         self._resume_best_loss = meta["loss"]
         if advance_epoch:
             self._start_epoch = int(meta["epoch"])
+        self.recorder.record(
+            "checkpoint_restore", path=str(checkpoint_path),
+            epoch=int(meta["epoch"]),
+            seconds=time.perf_counter() - t0,
+        )
         return meta

@@ -54,6 +54,9 @@ class TrainingMessageFormatter:
         return prefix + metrics
 
     def performance_message(self, memory, duration) -> str:
-        # Parsed downstream as r'(\d+): Memory Usage: (\d+\.\d+), Training
-        # Duration: (\d+\.\d+)' - keep byte-compatible.
+        # Parsed downstream by evaluation/analysis.py PERF_LINE_RE - keep
+        # byte-compatible.  The values are RAW floats (str() formatting),
+        # so the parser accepts scientific ('5e-05') and integer-valued
+        # ('700') renderings too; the round-trip is property-tested in
+        # tests/test_evaluation.py.
         return f"{self.rank}: Memory Usage: {memory}, Training Duration: {duration}"

@@ -208,6 +208,7 @@ def run_rank(comm, args, model, datasets, trainer_class=None):
     ``history.json``, every rank logs its perf line).  ``trainer_class``
     lets a family mix its loss surface over :class:`NativeDDPTrainer`."""
     training_set, validation_set, test_set = datasets
+    from pytorch_distributed_rnn_tpu.obs import MetricsRecorder
     from pytorch_distributed_rnn_tpu.resilience import FaultSchedule
 
     # rank-bound chaos schedule (one entry point per strategy, all via
@@ -216,6 +217,15 @@ def run_rank(comm, args, model, datasets, trainer_class=None):
     # propagates the NaN to every rank, so every guard skips the same
     # step identically.
     faults = FaultSchedule.resolve(args, rank=comm.rank)
+    # per-rank telemetry sidecar (rank-suffixed path; resolve mirrors the
+    # FaultSchedule one-entry-point convention)
+    from pytorch_distributed_rnn_tpu.obs import StepTraceCapture
+
+    recorder = MetricsRecorder.resolve(args, rank=comm.rank)
+    # --profile-steps: rank 0 only (the history.json convention) - the
+    # per-process profilers would otherwise race one hostname-keyed
+    # xplane file in the shared trace dir
+    profile_steps = StepTraceCapture.resolve(args) if comm.rank == 0 else None
     trainer = (trainer_class or NativeDDPTrainer)(
         comm=comm,
         model=model,
@@ -235,6 +245,8 @@ def run_rank(comm, args, model, datasets, trainer_class=None):
         faults=faults,
         max_bad_steps=getattr(args, "max_bad_steps", 0),
         keep_checkpoints=getattr(args, "keep_checkpoints", 0),
+        recorder=recorder,
+        profile_steps=profile_steps,
     )
     resume = getattr(args, "resume", None)
     if resume is not None and str(resume) == "auto":
@@ -251,7 +263,12 @@ def run_rank(comm, args, model, datasets, trainer_class=None):
     elif resume:
         meta = trainer.resume_from(resume)
         log.info(f"Resumed from {resume} at epoch {meta['epoch']}")
-    _, train_history, validation_history = trainer.train(epochs=args.epochs)
+    try:
+        _, train_history, validation_history = trainer.train(
+            epochs=args.epochs
+        )
+    finally:
+        recorder.close()
     # the rank-parity observable (reference example_ddp.py:92 prints the
     # same quantity): identical on every rank iff replicas stayed in sync
     flat, _ = ravel_pytree(trainer.params)

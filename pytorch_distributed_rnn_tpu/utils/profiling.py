@@ -43,8 +43,15 @@ def device_memory_peaks_mb() -> dict:
     return peaks
 
 
-def measure_memory_and_time(fn, interval: float = 0.1):
-    """Run ``fn()``; return ``(result, peak_rss_mb, duration_seconds)``."""
+def measure_memory_and_time(fn, interval: float = 0.1,
+                            include_device_memory: bool = False):
+    """Run ``fn()``; return ``(result, peak_rss_mb, duration_seconds)``.
+
+    With ``include_device_memory=True`` a fourth element is appended:
+    the per-device HBM peak dict from :func:`device_memory_peaks_mb`,
+    read AFTER ``fn`` completes (PJRT peaks are cumulative, so the
+    post-run read covers the run).  Opt-in keyword so the historical
+    3-tuple contract - and every existing caller - is untouched."""
     peak = [_rss_mb()]
     stop = threading.Event()
 
@@ -63,4 +70,10 @@ def measure_memory_and_time(fn, interval: float = 0.1):
         sampler.join(timeout=2.0)
     duration = time.perf_counter() - start
     peak[0] = max(peak[0], _rss_mb())
+    if include_device_memory:
+        try:
+            device_peaks = device_memory_peaks_mb()
+        except Exception:  # backend without memory_stats: peaks are a bonus
+            device_peaks = {}
+        return result, peak[0], duration, device_peaks
     return result, peak[0], duration

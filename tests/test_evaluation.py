@@ -51,6 +51,57 @@ def test_perf_line_regex_matches_reference_contract():
     assert match == ("0", "727.90625", "145.123456")
 
 
+def test_perf_line_regex_accepts_scientific_and_integer_floats():
+    """The formatter prints RAW floats: a sub-millisecond duration
+    renders as '5e-05' and an integer-valued memory as '700' - the
+    notebooks' \\d+\\.\\d+ regex silently dropped both (ISSUE 4
+    satellite: the perf-line contract hole)."""
+    assert parse_perf_lines(
+        "0: Memory Usage: 700, Training Duration: 5e-05"
+    ) == [(0, 700.0, 5e-05)]
+    assert parse_perf_lines(
+        "3: Memory Usage: 1.5e+3, Training Duration: 2E-3"
+    ) == [(3, 1500.0, 0.002)]
+
+
+def test_formatter_parser_round_trip_property():
+    """Property test over the formatter<->parser pair: EVERY
+    (memory, duration) the formatter can emit must survive the parse
+    with value equality - including the scientific/integer renderings
+    the original regex dropped."""
+    import random
+
+    from pytorch_distributed_rnn_tpu.training.formatter import (
+        TrainingMessageFormatter,
+    )
+
+    rng = random.Random(123456789)
+    cases = [
+        (727.90625, 145.123456),  # the reference's own shape
+        (700, 5e-05),  # integer memory, scientific duration
+        (1e-12, 1e12),
+        (0.0, 0.0),
+    ]
+    for _ in range(200):
+        # log-uniform over the magnitudes float formatting renders
+        # differently (fixed-point vs scientific, either side of 1e16)
+        mem = 10 ** rng.uniform(-12, 12)
+        dur = 10 ** rng.uniform(-12, 12)
+        if rng.random() < 0.2:
+            mem = float(int(mem))  # integer-VALUED float ('700.0')
+        if rng.random() < 0.1:
+            mem = int(mem)  # true int ('700')
+        cases.append((mem, dur))
+    for rank in (0, 7):
+        formatter = TrainingMessageFormatter(num_epochs=1, rank=rank)
+        for mem, dur in cases:
+            line = formatter.performance_message(mem, dur)
+            parsed = parse_perf_lines(line)
+            assert parsed == [(rank, float(mem), float(dur))], (
+                f"round-trip lost {line!r} -> {parsed}"
+            )
+
+
 def test_parse_perf_lines_multi_rank():
     text = (
         "noise\n0: Memory Usage: 100.5, Training Duration: 10.0\n"

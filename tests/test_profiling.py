@@ -51,3 +51,13 @@ def test_device_memory_peaks_shape():
     peaks = device_memory_peaks_mb()
     # CPU backends may report nothing; where reported, values are sane
     assert all(v >= 0.0 for v in peaks.values())
+
+
+def test_measure_with_device_memory_returns_4_tuple():
+    """ISSUE 4 satellite: device HBM peaks plumbed into the perf path -
+    opt-in keyword, the historical 3-tuple contract untouched above."""
+    out = measure_memory_and_time(lambda: 41 + 1, include_device_memory=True)
+    result, peak_mb, seconds, device_peaks = out
+    assert result == 42 and peak_mb > 0 and seconds >= 0
+    assert isinstance(device_peaks, dict)
+    assert all(v >= 0.0 for v in device_peaks.values())
