@@ -57,17 +57,38 @@ def _structured_measurements(run):
         return None
     from pytorch_distributed_rnn_tpu.obs.summary import (
         MalformedMetricsError,
-        summarize_run,
+        summarize_events,
+    )
+    from pytorch_distributed_rnn_tpu.obs.timeline import (
+        attribute_rank,
+        load_run,
     )
 
+    # one parse per rank file: summary and phase attribution both fold
+    # off the same in-memory event lists (per-step sidecars get large)
     try:
-        summaries = summarize_run(path)
+        by_rank = load_run(path)
     except MalformedMetricsError:
         return None
+    summaries = []
+    attributions = {}
+    for rank in sorted(by_rank):
+        summaries.append(summarize_events(by_rank[rank], path=path))
+        # per-rank phase attribution (obs/timeline.py): where the
+        # sampled step time went - surfaced as phase_* fraction columns
+        # so sweep dataframes can separate input-bound from
+        # exchange-bound rows
+        attr = attribute_rank(by_rank[rank])
+        if attr is not None:
+            attributions[rank] = attr["fractions"]
     measurements = []
     for s in summaries:
         if s.get("duration_s") is None or s.get("memory_mb") is None:
             continue  # run died before its run_summary event
+        phases = {
+            f"phase_{name}_frac": frac
+            for name, frac in attributions.get(s["rank"], {}).items()
+        }
         measurements.append((
             s["rank"], s["memory_mb"], s["duration_s"],
             {
@@ -78,6 +99,7 @@ def _structured_measurements(run):
                 ),
                 "device_peak_mb": s.get("device_peak_mb"),
                 "telemetry": True,
+                **phases,
             },
         ))
     return measurements or None

@@ -329,6 +329,7 @@ def execute_run(config: RunConfig, timeout: float | None = None,
             config.backend, config.fault_type, config.fault_value,
         )
     argv, extra_env = get_command(run_config)
+    start_wall = time.time()
     env = dict(os.environ)
     env.update(extra_env)
     # make the framework importable regardless of the run's cwd (the
@@ -368,7 +369,46 @@ def execute_run(config: RunConfig, timeout: float | None = None,
     }
     if metrics_path is not None:
         entry["metrics_path"] = metrics_path
+        _append_run_span(metrics_path, config, start_wall, duration,
+                         returncode)
     return entry
+
+
+def _append_run_span(metrics_path, config: RunConfig, start_wall: float,
+                     duration: float, returncode: int) -> None:
+    """Append the run's ROOT span to the rank-0 sidecar: the launcher
+    is the only process that saw the whole subprocess lifetime (spawn,
+    backend probe, compile, train, teardown), so the trace timeline
+    gets its enclosing bar from here.  Wall-clock only (``t``; no
+    ``tm``): the child's monotonic epoch is not ours - the timeline
+    exporter maps wall-only events directly onto the aligned timeline.
+
+    Skipped when the sidecar is missing (run died before its recorder)
+    or ends mid-line (killed mid-append): appending after a torn tail
+    would glue the span onto the partial line and turn the loader's
+    tolerated-torn-tail case into a hard error."""
+    path = Path(metrics_path)
+    try:
+        if not path.exists():
+            return
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            if f.tell() == 0:
+                return
+            f.seek(-1, os.SEEK_END)
+            if f.read(1) != b"\n":
+                return
+        span = {
+            "kind": "span", "name": "run", "cat": "run", "rank": 0,
+            "t": start_wall, "dur_s": duration,
+            "clock": "launcher",
+            "trainer": config.trainer, "devices": config.devices,
+            "slots": config.slots, "returncode": returncode,
+        }
+        with open(path, "a") as f:
+            f.write(json.dumps(span) + "\n")
+    except OSError:
+        pass  # telemetry must never fail the sweep
 
 
 def run_benchmark(

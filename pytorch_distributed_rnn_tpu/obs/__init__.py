@@ -2,10 +2,15 @@
 
 - :mod:`.recorder`: rank-tagged JSONL event stream, buffered off the
   training hot path (:class:`MetricsRecorder` / :data:`NULL_RECORDER`).
+- :mod:`.spans`: the span primitives (``recorder.span`` / ``emit_span``
+  context-manager and deferred duration events).
 - :mod:`.profile`: step-bounded ``jax.profiler`` capture
   (``--profile-steps A:B``).
-- :mod:`.summary`: sidecar loading, summaries, diffs, stragglers.
-- :mod:`.cli`: the ``pdrnn-metrics`` CLI over those summaries.
+- :mod:`.summary`: sidecar loading, summaries, diffs, stragglers,
+  per-rank liveness (``rank_health``).
+- :mod:`.timeline`: cross-rank clock alignment, Chrome-trace/Perfetto
+  export + validator, phase attribution.
+- :mod:`.cli`: the ``pdrnn-metrics`` CLI over all of the above.
 
 This package imports neither jax nor the training stack at module
 import time, so CLI startup and jax-free tooling stay cheap.
@@ -14,6 +19,7 @@ import time, so CLI startup and jax-free tooling stay cheap.
 from pytorch_distributed_rnn_tpu.obs.profile import StepTraceCapture
 from pytorch_distributed_rnn_tpu.obs.recorder import (
     METRICS_ENV,
+    METRICS_HEARTBEAT_ENV,
     METRICS_SAMPLE_ENV,
     NULL_RECORDER,
     SCHEMA_VERSION,
@@ -27,13 +33,25 @@ from pytorch_distributed_rnn_tpu.obs.summary import (
     diff_summaries,
     load_events,
     rank_files,
+    rank_health,
     summarize_events,
     summarize_file,
     summarize_run,
 )
+from pytorch_distributed_rnn_tpu.obs.timeline import (
+    attribute_rank,
+    attribute_run,
+    attribute_stragglers,
+    build_chrome_trace,
+    estimate_clock_offsets,
+    load_run,
+    validate_chrome_trace,
+    write_chrome_trace,
+)
 
 __all__ = [
     "METRICS_ENV",
+    "METRICS_HEARTBEAT_ENV",
     "METRICS_SAMPLE_ENV",
     "NULL_RECORDER",
     "SCHEMA_VERSION",
@@ -41,12 +59,21 @@ __all__ = [
     "MetricsRecorder",
     "NullRecorder",
     "StepTraceCapture",
+    "attribute_rank",
+    "attribute_run",
+    "attribute_stragglers",
+    "build_chrome_trace",
     "detect_stragglers",
     "diff_summaries",
+    "estimate_clock_offsets",
     "load_events",
+    "load_run",
     "rank_files",
+    "rank_health",
     "rank_suffixed",
     "summarize_events",
     "summarize_file",
     "summarize_run",
+    "validate_chrome_trace",
+    "write_chrome_trace",
 ]

@@ -1,17 +1,24 @@
-"""``pdrnn-metrics``: summarize / diff / stragglers over metrics sidecars.
+"""``pdrnn-metrics``: summarize / diff / stragglers / timeline /
+attribute / health over metrics sidecars.
 
 Exit-code contract (pinned by tests and used as a CI gate):
 
-- ``0`` clean (summary printed; no regression; no straggler)
+- ``0`` clean (summary/trace/table printed; no regression; no
+  straggler; every rank healthy)
 - ``1`` signal found (``diff``: a regression past the threshold;
-  ``stragglers``: a rank past the spread threshold)
-- ``2`` malformed input (unreadable file, bad JSONL, schema drift)
+  ``stragglers``/``attribute``: a rank past the spread threshold;
+  ``health``: a stalled or dead rank)
+- ``2`` malformed input (unreadable file, bad JSONL, schema drift,
+  or a sidecar too old for the requested view)
 
 Examples::
 
   pdrnn-metrics summarize metrics.jsonl
   pdrnn-metrics diff baseline.jsonl candidate.jsonl --threshold 10
   pdrnn-metrics stragglers metrics.jsonl   # picks up -r<k> siblings
+  pdrnn-metrics timeline metrics.jsonl -o run.trace.json  # -> Perfetto
+  pdrnn-metrics attribute metrics.jsonl    # phase fractions + blame
+  pdrnn-metrics health metrics.jsonl --stale-after 30
 """
 
 from __future__ import annotations
@@ -25,7 +32,9 @@ from pytorch_distributed_rnn_tpu.obs.summary import (
     MalformedMetricsError,
     detect_stragglers,
     diff_summaries,
+    load_events,
     rank_files,
+    rank_health,
     summarize_file,
 )
 
@@ -91,6 +100,43 @@ def main(argv=None) -> int:
                    "time (default 0.25)")
     p.add_argument("--json", action="store_true")
 
+    p = sub.add_parser(
+        "timeline",
+        help="export the run (rank-0 sidecar + -r<k> siblings) as a "
+        "clock-aligned Chrome trace-event JSON for Perfetto",
+    )
+    p.add_argument("file", help="the run's rank-0 metrics sidecar")
+    p.add_argument("-o", "--output", default=None, metavar="PATH",
+                   help="trace output path (default: <file>.trace.json)")
+    p.add_argument("--json", action="store_true",
+                   help="print a machine summary of the export")
+
+    p = sub.add_parser(
+        "attribute",
+        help="per-rank phase attribution: sampled step time decomposed "
+        "into data-wait / dispatch / device / exchange fractions, plus "
+        "phase-blamed straggler detection",
+    )
+    p.add_argument("files", nargs="+")
+    p.add_argument("--threshold", type=float, default=0.25, metavar="FRAC",
+                   help="flag ranks this fraction above the median step "
+                   "time (default 0.25)")
+    p.add_argument("--json", action="store_true")
+
+    p = sub.add_parser(
+        "health",
+        help="liveness check: flag ranks whose telemetry went stale "
+        "(dead) or whose heartbeats continue without progress (stalled)",
+    )
+    p.add_argument("files", nargs="+")
+    p.add_argument("--stale-after", type=float, default=30.0, metavar="S",
+                   help="seconds without progress/events before a rank "
+                   "is flagged (default 30)")
+    p.add_argument("--now", type=float, default=None, metavar="EPOCH",
+                   help="reference wall time (default: the current time; "
+                   "pass a run-contemporary stamp for post-hoc checks)")
+    p.add_argument("--json", action="store_true")
+
     args = parser.parse_args(argv)
     try:
         return _dispatch(args)
@@ -130,22 +176,15 @@ def _dispatch(args) -> int:
                 )
         return 1 if regressions else 0
 
-    # stragglers: expand every given path to its rank family so the
-    # common case (pass the rank-0 sidecar) sees the whole world.
-    # Dedup by resolved path: a shell glob passes the -r<k> siblings
-    # explicitly TOO, and a double-counted rank shifts the median onto
-    # the straggler, masking it.
-    summaries, seen = [], set()
-    for path in args.files:
-        family = rank_files(path)
-        if not family:
-            raise MalformedMetricsError(f"{path}: no metrics sidecar found")
-        for member in family:
-            resolved = Path(member).resolve()
-            if resolved in seen:
-                continue
-            seen.add(resolved)
-            summaries.append(summarize_file(member))
+    if args.cmd == "timeline":
+        return _timeline(args)
+    if args.cmd == "attribute":
+        return _attribute(args)
+    if args.cmd == "health":
+        return _health(args)
+
+    # stragglers
+    summaries = [summarize_file(p) for p in _expand_families(args.files)]
     summaries.sort(key=lambda s: s["rank"])
     flagged = detect_stragglers(summaries, args.threshold)
     if args.json:
@@ -162,6 +201,124 @@ def _dispatch(args) -> int:
                 f"{f['step_s_mean']:.6f}s vs median {f['median_s']:.6f}s "
                 f"(+{100 * f['excess_frac']:.0f}%)"
             )
+    return 1 if flagged else 0
+
+
+def _expand_families(paths) -> list[Path]:
+    """Every given path expanded to its rank family so the common case
+    (pass the rank-0 sidecar) sees the whole world.  Dedup by resolved
+    path: a shell glob passes the -r<k> siblings explicitly TOO, and a
+    double-counted rank shifts medians onto the outlier, masking it."""
+    members, seen = [], set()
+    for path in paths:
+        family = rank_files(path)
+        if not family:
+            raise MalformedMetricsError(f"{path}: no metrics sidecar found")
+        for member in family:
+            resolved = Path(member).resolve()
+            if resolved in seen:
+                continue
+            seen.add(resolved)
+            members.append(member)
+    return members
+
+
+def _timeline(args) -> int:
+    from pytorch_distributed_rnn_tpu.obs.timeline import write_chrome_trace
+
+    out = args.output or str(
+        Path(args.file).with_suffix("")
+    ) + ".trace.json"
+    try:
+        trace = write_chrome_trace(args.file, out)
+    except ValueError as exc:
+        # a validator rejection of our own export is still bad INPUT
+        # from the caller's perspective (a sidecar the exporter cannot
+        # render consistently) - same exit as malformed JSONL
+        raise MalformedMetricsError(str(exc)) from exc
+    summary = {
+        "trace": str(out),
+        "ranks": trace["otherData"]["ranks"],
+        "events": len(trace["traceEvents"]),
+        "clock_offsets_s": trace["otherData"]["clock_offsets_s"],
+    }
+    if args.json:
+        print(json.dumps(summary, indent=1))
+    else:
+        print(
+            f"wrote {out}: {summary['events']} trace events across "
+            f"{len(summary['ranks'])} rank(s) - open in "
+            "https://ui.perfetto.dev or chrome://tracing"
+        )
+    return 0
+
+
+def _attribute(args) -> int:
+    from pytorch_distributed_rnn_tpu.obs.timeline import (
+        PHASES,
+        attribute_rank,
+        attribute_stragglers,
+    )
+
+    attributions = []
+    for member in _expand_families(args.files):
+        events = load_events(member)
+        attr = attribute_rank(events)
+        if attr is not None:
+            attr["path"] = str(member)
+            attributions.append(attr)
+    attributions.sort(key=lambda a: a["rank"])
+    flagged = attribute_stragglers(attributions, args.threshold)
+    if args.json:
+        print(json.dumps(
+            {"ranks": attributions, "stragglers": flagged}, indent=1
+        ))
+        return 1 if flagged else 0
+    if not attributions:
+        print("no attributable rank (no fenced step samples - raise the "
+              "--metrics-sample-every cadence)")
+        return 0
+    header = f"{'rank':>4} {'steps':>5} {'step_s':>10} " + " ".join(
+        f"{p:>9}" for p in PHASES
+    )
+    print(header)
+    for a in attributions:
+        fr = a["fractions"]
+        print(
+            f"{a['rank']:>4} {a['steps_sampled']:>5} "
+            f"{a['step_s_mean']:>10.6f} "
+            + " ".join(f"{100 * fr[p]:>8.1f}%" for p in PHASES)
+        )
+    for f in flagged:
+        print(
+            f"STRAGGLER rank {f['rank']}: mean step "
+            f"{f['step_s_mean']:.6f}s vs median {f['median_s']:.6f}s "
+            f"(+{100 * f['excess_frac']:.0f}%), dominated by "
+            f"{f['phase']} (+{f['phase_excess_s']:.6f}s/step vs median)"
+        )
+    return 1 if flagged else 0
+
+
+def _health(args) -> int:
+    reports = [
+        {**rank_health(load_events(m), now=args.now,
+                       stale_after=args.stale_after), "path": str(m)}
+        for m in _expand_families(args.files)
+    ]
+    reports.sort(key=lambda r: r["rank"])
+    flagged = [r for r in reports if r["status"] in ("stalled", "dead")]
+    if args.json:
+        print(json.dumps(reports, indent=1))
+        return 1 if flagged else 0
+    for r in reports:
+        line = (
+            f"rank {r['rank']}: {r['status']} (last event "
+            f"{r['last_event_age_s']:.1f}s ago, last progress "
+            f"{r['last_progress_age_s']:.1f}s ago)"
+        )
+        if r["status"] in ("stalled", "dead"):
+            line = line.upper()
+        print(line)
     return 1 if flagged else 0
 
 

@@ -21,15 +21,22 @@ Hot-path contract:
 - device fencing (``jax.block_until_ready``) happens only on a sampled
   cadence (``sample_every``), so steady-state dispatch stays async.
 
-Event schema (``schema = 1``; one JSON object per line, every event
-carries ``kind``, ``t`` (unix seconds) and ``rank``):
+Event schema (``schema = 2``; one JSON object per line, every event
+carries ``kind``, ``t`` (unix seconds), ``tm`` (monotonic seconds,
+``time.perf_counter`` - the clock ALL in-run deltas and the timeline
+alignment use, immune to NTP steps that can reorder or negate ``t``
+deltas) and ``rank``.  Schema-1 sidecars (no ``tm``) still load for
+summaries; only the timeline exporter requires schema 2):
 
 =================== =======================================================
 kind                payload
 =================== =======================================================
-meta                schema, sample_every, argv? - always the FIRST line
+meta                schema, sample_every, argv? - always the FIRST line;
+                    its (t, tm) pair is the rank's wall<->monotonic anchor
 step                step, epoch, loss, dispatch_s, data_wait_s,
-                    fenced_s (sampled steps only)
+                    fenced_s (sampled steps only); tm is the step's
+                    dispatch START (overridden by the trainer), so the
+                    timeline can synthesize the per-step sub-spans
 epoch               epoch, steps, loss, acc, wall_s, path (scan|step|host)
 eval                epoch (null = test), loss, acc
 collectives         ops {hlo-op: {count, bytes}}, bytes_per_step - traced
@@ -38,6 +45,12 @@ checkpoint_save     epoch, best, seconds, format
 checkpoint_restore  path, epoch, seconds
 nan_skip            new, total, consecutive
 fault               action, trigger, where
+span                name, cat, dur_s (+ attrs); tm/t are the span START
+                    (obs/spans.py - the trace-timeline duration event)
+heartbeat           seq, progress (last step noted via note_progress) -
+                    emitted by the writer thread on its wake cadence, so
+                    a stalled rank keeps proving it is alive while its
+                    progress freezes (pdrnn-metrics health)
 ps_exchange         what (push|pull), step, seconds, retries
 ps_round            updates, gathered, expected, degraded
 ps_worker_dead      worker, error
@@ -57,19 +70,23 @@ import threading
 import time
 from pathlib import Path
 
+from pytorch_distributed_rnn_tpu.obs.spans import NULL_SPAN, Span
+
 log = logging.getLogger(__name__)
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 
 # env half of the CLI contract (the --metrics flag beats it), mirroring
 # PDRNN_CHAOS: spawned worker processes inherit telemetry without CLI
 # plumbing through every launcher layer
 METRICS_ENV = "PDRNN_METRICS"
 METRICS_SAMPLE_ENV = "PDRNN_METRICS_SAMPLE"
+METRICS_HEARTBEAT_ENV = "PDRNN_METRICS_HEARTBEAT"
 
 _DEFAULT_SAMPLE_EVERY = 16
 _FLUSH_THRESHOLD = 256  # events buffered before the writer is signalled
 _FLUSH_INTERVAL_S = 2.0  # writer wake cadence even below the threshold
+_DEFAULT_HEARTBEAT_S = 5.0  # heartbeat cadence (0 disables)
 
 
 def rank_suffixed(path, rank: int) -> Path:
@@ -99,6 +116,18 @@ class NullRecorder:
     def is_sample_step(self, step: int) -> bool:
         return False
 
+    def span(self, name: str, cat: str = "train", **attrs):
+        """Disabled tracing: the shared no-op context manager - no clock
+        reads, no allocation (the span half of the zero-overhead pin)."""
+        return NULL_SPAN
+
+    def emit_span(self, name, tm_start, dur_s, cat="train",  # noqa: PD105
+                  **attrs) -> None:
+        pass
+
+    def note_progress(self, step: int) -> None:  # noqa: PD105 - null object
+        pass
+
     def flush(self) -> None:  # noqa: PD105 - null object by design
         pass
 
@@ -120,7 +149,8 @@ class MetricsRecorder:
     def __init__(self, path, rank: int = 0,
                  sample_every: int = _DEFAULT_SAMPLE_EVERY,
                  flush_threshold: int = _FLUSH_THRESHOLD,
-                 meta: dict | None = None):
+                 meta: dict | None = None,
+                 heartbeat_every_s: float = _DEFAULT_HEARTBEAT_S):
         if sample_every < 1:
             raise ValueError(
                 f"metrics sample cadence must be >= 1, got {sample_every}"
@@ -136,11 +166,29 @@ class MetricsRecorder:
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._closed = False
+        # heartbeats ride the writer thread's existing wake cadence (no
+        # extra thread); 0 disables them.  The wake timeout shrinks to
+        # the heartbeat interval when that is the tighter cadence.
+        self._heartbeat_every = max(0.0, float(heartbeat_every_s))
+        self._wake_timeout = (
+            min(_FLUSH_INTERVAL_S, self._heartbeat_every)
+            if self._heartbeat_every > 0 else _FLUSH_INTERVAL_S
+        )
+        self._hb_seq = 0
+        # last step noted by the instrumented loops (note_progress): a
+        # bare int store, read by the writer thread's heartbeats so a
+        # stalled rank's heartbeats visibly stop advancing
+        self._progress = None
+        # wall<->monotonic anchor: t and tm below describe the SAME
+        # instant, so anchor + any event's tm reconstructs its wall time
+        # on THIS rank's clock (obs/timeline.py aligns across ranks)
+        t_wall, t_mono = time.time(), time.perf_counter()
+        self._anchor = t_wall - t_mono
         # meta is the FIRST line, written synchronously: a sidecar that
         # exists always declares its schema, even if the run dies before
         # the first flush
         head = {
-            "kind": "meta", "t": time.time(), "rank": self.rank,
+            "kind": "meta", "t": t_wall, "tm": t_mono, "rank": self.rank,
             "schema": SCHEMA_VERSION, "sample_every": self.sample_every,
         }
         head.update(meta or {})
@@ -167,18 +215,55 @@ class MetricsRecorder:
             sample = int(
                 os.environ.get(METRICS_SAMPLE_ENV, _DEFAULT_SAMPLE_EVERY)
             )
-        return cls(spec, rank=rank, sample_every=int(sample), meta=meta)
+        heartbeat = float(
+            os.environ.get(METRICS_HEARTBEAT_ENV, _DEFAULT_HEARTBEAT_S)
+        )
+        return cls(spec, rank=rank, sample_every=int(sample), meta=meta,
+                   heartbeat_every_s=heartbeat)
 
     # -- hot-path API --------------------------------------------------------
 
     def record(self, kind: str, **fields) -> None:
-        event = {"kind": kind, "t": time.time(), "rank": self.rank}
+        # the (t, tm) stamp pair describes the record() instant; callers
+        # emitting DEFERRED events (the trainer's post-loop step flush,
+        # emit_span) override tm to the phase's true start - t is then
+        # re-derived from the construction anchor so the two always
+        # describe the SAME instant (the invariant the timeline's
+        # cross-rank alignment and any t - tm anchor math rest on)
+        event = {
+            "kind": kind, "t": time.time(), "tm": time.perf_counter(),
+            "rank": self.rank,
+        }
+        if "tm" in fields and "t" not in fields:
+            event["t"] = self._anchor + float(fields["tm"])
         event.update(fields)
         with self._lock:
             self._buffer.append(event)
             signal = len(self._buffer) >= self._flush_threshold
         if signal:
             self._wake.set()
+
+    def span(self, name: str, cat: str = "train", **attrs) -> Span:
+        """Context manager timing a ``span`` event (obs/spans.py)."""
+        return Span(self, name, cat, attrs)
+
+    def emit_span(self, name, tm_start, dur_s, cat="train",
+                  **attrs) -> None:
+        """Deferred span emission: ``tm_start`` is a ``perf_counter``
+        value captured when the phase began; ``record`` derives the
+        wall stamp from the construction-time anchor so t and tm stay
+        one clock pair even across NTP steps."""
+        self.record(
+            "span", name=name, cat=cat, tm=float(tm_start),
+            dur_s=float(dur_s), **attrs,
+        )
+
+    def note_progress(self, step: int) -> None:
+        """Cheap per-step liveness note (one int store, no lock): the
+        writer thread's heartbeats carry the latest value, so
+        ``pdrnn-metrics health`` can tell a stalled rank (heartbeats
+        fresh, progress frozen) from a dead one (heartbeats stale)."""
+        self._progress = int(step)
 
     def is_sample_step(self, step: int) -> bool:
         """Whether this step pays the fencing round-trip (step wall-time
@@ -191,9 +276,19 @@ class MetricsRecorder:
     # -- writer --------------------------------------------------------------
 
     def _writer(self):
+        next_hb = time.perf_counter() + self._heartbeat_every
         while not self._stop.is_set():
-            self._wake.wait(timeout=_FLUSH_INTERVAL_S)
+            self._wake.wait(timeout=self._wake_timeout)
             self._wake.clear()
+            if self._heartbeat_every > 0:
+                now = time.perf_counter()
+                if now >= next_hb:
+                    self._hb_seq += 1
+                    self.record(
+                        "heartbeat", seq=self._hb_seq,
+                        progress=self._progress,
+                    )
+                    next_hb = now + self._heartbeat_every
             self._drain()
         self._drain()
 

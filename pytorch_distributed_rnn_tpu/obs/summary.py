@@ -174,7 +174,13 @@ def summarize_events(events: list[dict], path=None) -> dict:
         ),
         "ps_degraded_rounds": sum(
             1 for e in events
-            if e["kind"] == "ps_round" and e.get("degraded")
+            # schema 1 recorded degraded rounds as ps_round point
+            # events; schema 2 emits a ps_round SPAN per round with a
+            # degraded attribute
+            if e.get("degraded") and (
+                e["kind"] == "ps_round"
+                or (e["kind"] == "span" and e.get("name") == "ps_round")
+            )
         ),
     }
     return summary
@@ -221,6 +227,65 @@ def diff_summaries(baseline: dict, candidate: dict,
                 "delta_pct": delta_pct,
             })
     return regressions
+
+
+# events that witness forward progress (vs mere liveness): everything a
+# run emits except the writer thread's own heartbeats and the meta head
+_NON_PROGRESS_KINDS = ("meta", "heartbeat")
+
+
+def rank_health(events: list[dict], now: float | None = None,
+                stale_after: float = 30.0) -> dict:
+    """One rank's liveness verdict from its event stream.
+
+    Three signals: the last event of ANY kind (the writer thread's
+    heartbeats keep this fresh as long as the process lives), the last
+    *progress* (any non-heartbeat event, or a heartbeat whose noted
+    ``progress`` step advanced), and whether the run finished (a
+    ``run_summary`` landed).  Status:
+
+    - ``finished`` - run_summary present (age is irrelevant);
+    - ``dead``     - nothing at all for ``stale_after`` seconds: the
+      process stopped flushing (killed, wedged below Python);
+    - ``stalled``  - heartbeats fresh but no progress for
+      ``stale_after`` seconds: alive and stuck (the chaos harness's
+      ``stall`` fault, a hung collective, a starved loader);
+    - ``ok``       - otherwise.
+    """
+    if now is None:
+        import time
+
+        now = time.time()
+    finished = any(e["kind"] == "run_summary" for e in events)
+    last_t = max(float(e["t"]) for e in events)
+    progress_ts = [
+        float(e["t"]) for e in events
+        if e["kind"] not in _NON_PROGRESS_KINDS
+    ]
+    noted = None
+    for e in events:
+        if e["kind"] == "heartbeat" and e.get("progress") is not None \
+                and e["progress"] != noted:
+            noted = e["progress"]
+            progress_ts.append(float(e["t"]))
+    last_progress_t = max(progress_ts) if progress_ts else float(
+        events[0]["t"]
+    )
+    if finished:
+        status = "finished"
+    elif now - last_t > stale_after:
+        status = "dead"
+    elif now - last_progress_t > stale_after:
+        status = "stalled"
+    else:
+        status = "ok"
+    return {
+        "rank": int(events[0].get("rank", 0)),
+        "status": status,
+        "last_event_age_s": now - last_t,
+        "last_progress_age_s": now - last_progress_t,
+        "finished": finished,
+    }
 
 
 def detect_stragglers(summaries: list[dict],

@@ -27,6 +27,7 @@ from __future__ import annotations
 import logging
 import math
 import threading
+import time
 
 import numpy as np
 
@@ -75,6 +76,15 @@ class ParameterServerMaster:
         self._pending: dict[int, np.ndarray] = {}
         self._sync_cv = threading.Condition(self.lock)
         self._waiting: set[int] = set()
+        # trace timeline: a sync round SPANS from its first gathered
+        # gradient to the update that closes it (obs/timeline.py renders
+        # one ps_round span per round; its close edge is also a clock-
+        # alignment sync point against the workers' push-reply edges).
+        # _round_seqs records WHICH push seq each worker contributed, so
+        # the aligner can pair edges by id even when a degraded round or
+        # a retried push shifts the ordinals.
+        self._round_tm0: float | None = None
+        self._round_seqs: dict[int, int] = {}
         # workers whose transport died (quorum mode tolerates them):
         # excluded from later rounds so the world shrinks instead of
         # timing out on a corpse every round
@@ -154,6 +164,7 @@ class ParameterServerMaster:
         with self._sync_cv:
             self._dead.add(worker)
             self._pending.pop(worker, None)
+            self._round_seqs.pop(worker, None)
             self._waiting.discard(worker)
             live = self.comm.world_size - 1 - len(self._dead)
             if self._pending and len(self._pending) >= max(1, live):
@@ -194,20 +205,51 @@ class ParameterServerMaster:
                 "we assert integrity)"
             )
             if self.sync_mode:
-                self._push_sync(worker, grads)
+                self._push_sync(worker, grads, seq=seq)
             else:
                 with self.lock:
+                    # span measured INSIDE the lock: the lock serializes
+                    # updates, so per-thread spans on the shared ps
+                    # timeline row stay disjoint (lock WAIT would overlap)
+                    t0 = time.perf_counter()
                     self.params = self.apply_update(grads)
                     self.updates_applied += 1
                     protocol.send_params(self.comm, worker, self.params)
+                    applied = self.updates_applied
+                    if self.recorder.enabled:
+                        self.recorder.emit_span(
+                            "ps_round", t0, time.perf_counter() - t0,
+                            cat="ps", round=applied, worker=worker,
+                            seq=seq, mode="async",
+                        )
 
-    def _close_round(self):
+    def _close_round(self, degraded: bool = False):
         """Average the gathered gradients, apply ONE update, reply to
         every worker owed fresh params, wake the waiters.  Caller holds
         the lock."""
+        gathered = len(self._pending)
+        expected = self.comm.world_size - 1 - len(self._dead)
+        tm0 = self._round_tm0
+        self._round_tm0 = None
+        seqs = {str(w): s for w, s in self._round_seqs.items()
+                if s is not None}
+        self._round_seqs = {}
         mean_grad = np.mean(list(self._pending.values()), axis=0)
         self.params = self.apply_update(mean_grad)
         self.updates_applied += 1
+        if self.recorder.enabled:
+            now = time.perf_counter()
+            if tm0 is None:
+                tm0 = now
+            self.recorder.emit_span(
+                "ps_round", tm0, now - tm0, cat="ps",
+                round=self.updates_applied, gathered=gathered,
+                expected=expected, degraded=degraded, mode="sync",
+                # which push seq each worker contributed: the id the
+                # clock aligner pairs against worker push-reply edges
+                # (ordinal pairing breaks under degradation/retries)
+                seqs=seqs,
+            )
         for w in sorted(self._pending):
             try:
                 protocol.send_params(self.comm, w, self.params)
@@ -229,7 +271,8 @@ class ParameterServerMaster:
     def _quorum_count(self, num_workers: int) -> int:
         return max(1, math.ceil(self.quorum * num_workers))
 
-    def _push_sync(self, worker: int, grads: np.ndarray):
+    def _push_sync(self, worker: int, grads: np.ndarray,
+                   seq: int | None = None):
         """Gather one gradient per worker, average, apply once, release.
 
         On straggler timeout the round degrades to the configured quorum
@@ -237,7 +280,10 @@ class ParameterServerMaster:
         (strict mode, or not even a quorum delivered)."""
         with self._sync_cv:
             num_workers = self.comm.world_size - 1 - len(self._dead)
+            if not self._pending:
+                self._round_tm0 = time.perf_counter()  # round opens here
             self._pending[worker] = grads
+            self._round_seqs[worker] = seq
             if len(self._pending) >= num_workers:
                 self._close_round()
                 return
@@ -263,12 +309,10 @@ class ParameterServerMaster:
                     f"({missing} straggler(s)); applying partial average "
                     f"(degraded rounds so far: {self.degraded_rounds})"
                 )
-                self.recorder.record(
-                    "ps_round", updates=self.updates_applied,
-                    gathered=len(self._pending), expected=num_workers,
-                    degraded=True,
-                )
-                self._close_round()
+                # the degradation rides the round's span event (emitted
+                # by _close_round with degraded=True), so the timeline
+                # and the summary read one record, not two
+                self._close_round(degraded=True)
                 return
             # a straggler never delivered and no quorum covers it: fail
             # loudly instead of silently proceeding with stale parameters

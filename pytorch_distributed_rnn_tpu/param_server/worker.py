@@ -112,7 +112,7 @@ class ParameterServerWorkerTrainer(Trainer):
         protocol.send_request(self.comm, protocol.OP_PULL)
         return protocol.recv_params(self.comm, self.num_params)
 
-    def _exchange(self, fn, what: str):
+    def _exchange(self, fn, what: str, seq: int | None = None):
         """One protocol exchange under the retry policy.  An exchange is
         retried WHOLE (request + reply); safe for pushes because the
         header's per-step sequence number lets the master detect a
@@ -121,7 +121,10 @@ class ParameterServerWorkerTrainer(Trainer):
 
         Telemetry: each exchange records latency + retry count as a
         ``ps_exchange`` event (the wire half of a PS step the in-program
-        collective counters can never see)."""
+        collective counters can never see).  ``seq`` - the wire push
+        sequence - rides the event so a push correlates with the
+        master's round of the same ordinal (the step+round correlation
+        the trace timeline and its clock alignment key off)."""
         recording = self.recorder.enabled
         retries = [0]
 
@@ -139,14 +142,14 @@ class ParameterServerWorkerTrainer(Trainer):
             if recording:
                 self.recorder.record(
                     "ps_exchange", what=what, step=self._steps_done,
-                    seconds=time.perf_counter() - t0,
+                    seq=seq, seconds=time.perf_counter() - t0,
                     retries=retries[0], failed=True,
                 )
                 self.recorder.flush()  # the run is about to die with this
             raise
         if recording:
             self.recorder.record(
-                "ps_exchange", what=what, step=self._steps_done,
+                "ps_exchange", what=what, step=self._steps_done, seq=seq,
                 seconds=time.perf_counter() - t0, retries=retries[0],
             )
         return result
@@ -181,7 +184,8 @@ class ParameterServerWorkerTrainer(Trainer):
             self._push_seq += 1  # once per STEP; retries re-send the same
             seq = self._push_seq
             new_flat = self._exchange(
-                lambda: push_pull(flat_grads, seq), what="gradient push"
+                lambda: push_pull(flat_grads, seq), what="gradient push",
+                seq=seq,
             )
             self._adopt(new_flat)
             return self.params, opt_state, loss, metrics

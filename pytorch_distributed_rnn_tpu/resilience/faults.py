@@ -262,6 +262,20 @@ class FaultSchedule:
 
     # -- action execution ----------------------------------------------------
 
+    def _timed_stall(self, event: FaultEvent, **where):
+        """Sleep out a stall fault; with a recorder bound, the stall's
+        extent lands as a ``fault_stall`` span on the trace timeline's
+        resilience row (the fault mark says WHEN, the span says HOW
+        LONG the pipeline was held)."""
+        t0 = time.perf_counter()
+        time.sleep(event.arg or _DEFAULT_STALL_S)
+        rec = self.recorder
+        if rec is not None and rec.enabled:
+            rec.emit_span(
+                "fault_stall", t0, time.perf_counter() - t0,
+                cat="resilience", **where,
+            )
+
     def on_producer_item(self, step: int):
         """Data-pipeline faults for the batch feeding step ``step`` -
         called in the loader/prefetch PRODUCER so stalls and exceptions
@@ -270,7 +284,7 @@ class FaultSchedule:
         for e in self._matches(("step", "prob"), step):
             if e.action == "stall":
                 self._fire(e, f"loader step {step}")
-                time.sleep(e.arg or _DEFAULT_STALL_S)
+                self._timed_stall(e, step=step)
             elif e.action == "exc":
                 self._fire(e, f"loader step {step}")
                 raise ChaosError(
@@ -315,7 +329,7 @@ class FaultSchedule:
         for e in self._matches(("epoch",), epoch):
             if e.action == "stall":
                 self._fire(e, f"epoch {epoch}")
-                time.sleep(e.arg or _DEFAULT_STALL_S)
+                self._timed_stall(e, epoch=epoch)
             elif e.action == "exc":
                 self._fire(e, f"epoch {epoch}")
                 raise ChaosError(f"injected failure at epoch {epoch} ({e})")

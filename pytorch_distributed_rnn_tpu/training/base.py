@@ -937,6 +937,7 @@ class Trainer:
                 if self._profile is not None:
                     self._profile.on_step_end(step, fence_value=loss)
                 self._steps_done = step + 1
+                self.recorder.note_progress(step)
                 if log_progress:
                     # the progress message needs values NOW - this path
                     # keeps the documented fetch-per-batch cost of -v
@@ -955,18 +956,23 @@ class Trainer:
                     losses.append(loss)
                     corrects.append(metrics["correct"])
                 if recording:
-                    raw.append((step, dispatch_s, fenced_s))
+                    raw.append((step, t0, dispatch_s, fenced_s))
             total_loss = sum(float(l) for l in losses)
             total_correct = sum(float(c) for c in corrects)
             if recording:
                 # step events are emitted AFTER the loop: the deferred
                 # float() fetches here are the same epoch-end fetch the
-                # uninstrumented path already pays, not per-step syncs
-                for (step, dispatch_s, fenced_s), loss_v in zip(raw, losses):
+                # uninstrumented path already pays, not per-step syncs.
+                # tm is overridden to the step's dispatch START so the
+                # timeline exporter can synthesize the dispatch/device
+                # sub-spans from the durations (obs/spans.py).
+                for (step, t0, dispatch_s, fenced_s), loss_v in zip(
+                    raw, losses
+                ):
                     self.recorder.record(
                         "step", step=step, epoch=self._epoch,
                         loss=float(loss_v), dispatch_s=dispatch_s,
-                        data_wait_s=0.0, fenced_s=fenced_s,
+                        data_wait_s=0.0, fenced_s=fenced_s, tm=t0,
                     )
         else:
             # fast path: all equal-size batches as ONE scanned program,
@@ -1009,6 +1015,7 @@ class Trainer:
             "epoch", epoch=self._epoch, steps=len(batches),
             loss=train_loss, acc=train_acc,
             wall_s=time.perf_counter() - t_epoch, path=epoch_path,
+            tm=t_epoch,  # epoch START: the event doubles as a span
         )
         return train_loss, train_acc
 
@@ -1096,6 +1103,7 @@ class Trainer:
                 if self._profile is not None:
                     self._profile.on_step_end(step, fence_value=loss)
                 self._steps_done = step + 1
+                self.recorder.note_progress(step)
                 if self.guard is not None and faults is not None:
                     # chaos runs are per-batch already; deciding per step
                     # costs one counter fetch and aborts K+1 steps after
@@ -1120,7 +1128,7 @@ class Trainer:
                     losses.append(loss)
                     corrects.append(metrics["correct"])
                 if recording:
-                    raw.append((step, dispatch_s, fenced_s, data_wait_s))
+                    raw.append((step, t0, dispatch_s, fenced_s, data_wait_s))
                 batch_idx += 1
         finally:
             # an early exit (injected exception, guard abort) must not
@@ -1131,14 +1139,15 @@ class Trainer:
         total_correct = sum(float(c) for c in corrects)
         if recording:
             # step events emitted after the loop: the float() fetches are
-            # the epoch-end fetch the uninstrumented path already pays
-            for (step, dispatch_s, fenced_s, data_wait_s), loss_v in zip(
+            # the epoch-end fetch the uninstrumented path already pays.
+            # tm = the step's dispatch start (see the device path above)
+            for (step, t0, dispatch_s, fenced_s, data_wait_s), loss_v in zip(
                 raw, losses
             ):
                 self.recorder.record(
                     "step", step=step, epoch=self._epoch,
                     loss=float(loss_v), dispatch_s=dispatch_s,
-                    data_wait_s=data_wait_s, fenced_s=fenced_s,
+                    data_wait_s=data_wait_s, fenced_s=fenced_s, tm=t0,
                 )
         # parity quirk kept: sum of batch-mean losses / dataset size
         train_loss = total_loss / len(self.training_set)
@@ -1149,6 +1158,7 @@ class Trainer:
             "epoch", epoch=self._epoch, steps=len(losses),
             loss=train_loss, acc=train_acc,
             wall_s=time.perf_counter() - t_epoch, path="host",
+            tm=t_epoch,
         )
         return train_loss, train_acc
 
@@ -1164,9 +1174,12 @@ class Trainer:
             cached = (dataset, self._prepare_batch(features, labels))
             self._eval_data_cache[key] = cached
         batch = cached[1]
-        loss, metrics = self._eval_step_fn(self.params, batch)
-        eval_loss = float(loss)  # one batch -> already the mean-of-batches
-        total_correct = float(metrics["correct"])
+        # the float() fetch below fences the eval program, so the span's
+        # extent is the honest wall time of the whole evaluation
+        with self.recorder.span("eval", cat="eval", epoch=epoch):
+            loss, metrics = self._eval_step_fn(self.params, batch)
+            eval_loss = float(loss)  # one batch -> already the mean
+            total_correct = float(metrics["correct"])
         num_examples = len(dataset)
         accuracy = total_correct / num_examples
         self.recorder.record(

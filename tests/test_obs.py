@@ -71,7 +71,9 @@ class TestRecorder:
         _write_metrics(path)
         events = load_events(path)
         assert events[0]["kind"] == "meta"
-        assert events[0]["schema"] == 1
+        assert events[0]["schema"] == 2
+        # schema 2: every event carries the dual wall+monotonic stamp
+        assert all("t" in e and "tm" in e for e in events)
         step_ids = [e["step"] for e in events if e["kind"] == "step"]
         assert step_ids == sorted(step_ids)
 
@@ -114,6 +116,82 @@ class TestRecorder:
         rec.close()
         monkeypatch.delenv("PDRNN_METRICS")
         assert MetricsRecorder.resolve(Args()) is NULL_RECORDER
+
+
+class TestSpansAndHeartbeats:
+    def test_span_context_manager_emits_dual_stamped_event(self, tmp_path):
+        rec = MetricsRecorder(tmp_path / "m.jsonl")
+        with rec.span("eval", cat="eval", epoch=3):
+            time.sleep(0.02)
+        rec.close()
+        spans = [
+            e for e in load_events(tmp_path / "m.jsonl")
+            if e["kind"] == "span"
+        ]
+        assert len(spans) == 1
+        s = spans[0]
+        assert s["name"] == "eval" and s["cat"] == "eval"
+        assert s["epoch"] == 3
+        assert s["dur_s"] >= 0.02
+        # t and tm describe the same instant: their difference is the
+        # recorder's construction anchor, shared with the meta head
+        meta = load_events(tmp_path / "m.jsonl")[0]
+        assert (s["t"] - s["tm"]) == pytest.approx(
+            meta["t"] - meta["tm"], abs=1e-6
+        )
+
+    def test_emit_span_deferred(self, tmp_path):
+        rec = MetricsRecorder(tmp_path / "m.jsonl")
+        t0 = time.perf_counter() - 5.0  # a phase that started earlier
+        rec.emit_span("dispatch", t0, 0.25, cat="step", step=4)
+        rec.close()
+        spans = [
+            e for e in load_events(tmp_path / "m.jsonl")
+            if e["kind"] == "span"
+        ]
+        assert spans[0]["tm"] == pytest.approx(t0)
+        assert spans[0]["dur_s"] == pytest.approx(0.25)
+
+    def test_null_recorder_span_is_shared_noop(self):
+        from pytorch_distributed_rnn_tpu.obs.spans import NULL_SPAN
+
+        s1 = NULL_RECORDER.span("anything", cat="ps", step=1)
+        assert s1 is NULL_SPAN and s1 is NULL_RECORDER.span("other")
+        with s1:
+            pass
+        NULL_RECORDER.emit_span("x", 0.0, 1.0)  # no-op, no file
+        NULL_RECORDER.note_progress(7)
+
+    def test_heartbeats_ride_writer_cadence_and_carry_progress(
+        self, tmp_path
+    ):
+        rec = MetricsRecorder(
+            tmp_path / "m.jsonl", heartbeat_every_s=0.05
+        )
+        rec.note_progress(3)
+        deadline = time.time() + 5.0
+        beats = []
+        while time.time() < deadline and len(beats) < 2:
+            time.sleep(0.05)
+            rec.flush()
+            beats = [
+                e for e in load_events(rec.path)
+                if e["kind"] == "heartbeat"
+            ]
+        rec.close()
+        assert len(beats) >= 2, "writer thread never heartbeat"
+        assert beats[-1]["progress"] == 3
+        assert [b["seq"] for b in beats] == sorted(
+            b["seq"] for b in beats
+        )
+
+    def test_heartbeats_disabled_at_zero(self, tmp_path):
+        rec = MetricsRecorder(tmp_path / "m.jsonl", heartbeat_every_s=0)
+        rec.record("step", step=0)
+        time.sleep(0.1)
+        rec.close()
+        kinds = [e["kind"] for e in load_events(tmp_path / "m.jsonl")]
+        assert "heartbeat" not in kinds
 
 
 class TestZeroOverhead:
@@ -203,6 +281,19 @@ class TestTrainerTelemetry:
         assert len(steps) == 8  # 96/24 = 4 batches x 2 epochs
         assert all(isinstance(e["loss"], float) for e in steps)
         assert all(e["dispatch_s"] > 0 for e in steps)
+        # the step events' tm is the dispatch START (monotonic), so the
+        # deferred post-loop emission preserves true step ordering and
+        # the timeline can synthesize sub-spans from the durations
+        tms = [e["tm"] for e in steps]
+        assert tms == sorted(tms)
+        # dual-stamp invariant even for deferred events: t is re-derived
+        # from the overridden tm, so (t - tm) is the rank anchor for
+        # EVERY event, not just the live-stamped ones
+        anchor = events[0]["t"] - events[0]["tm"]
+        assert all(
+            e["t"] - e["tm"] == pytest.approx(anchor, abs=1e-6)
+            for e in steps
+        )
         epochs = [e for e in events if e["kind"] == "epoch"]
         assert [e["epoch"] for e in epochs] == [0, 1]
         # the epoch events carry the same history train() returned
@@ -433,6 +524,22 @@ class TestStructuredAnalysis:
         assert df.iloc[0]["telemetry"] == True  # noqa: E712 - pandas bool
         assert df.iloc[0]["step_s_mean"] > 0
 
+    def test_phase_attribution_columns(self, tmp_path):
+        """Structured rows carry the timeline's phase decomposition so
+        sweep dataframes can split input-bound from exchange-bound."""
+        from pytorch_distributed_rnn_tpu.evaluation import (
+            create_measurement_df,
+        )
+
+        path = _write_metrics(tmp_path / "m.jsonl")
+        df = create_measurement_df([self._results_entry(path)])
+        row = df.iloc[0]
+        phases = [
+            row[f"phase_{p}_frac"]
+            for p in ("data_wait", "dispatch", "device", "exchange")
+        ]
+        assert sum(phases) == pytest.approx(1.0, abs=1e-6)
+
     def test_multi_rank_sidecars_one_row_per_rank(self, tmp_path):
         from pytorch_distributed_rnn_tpu.evaluation import (
             create_measurement_df,
@@ -614,9 +721,18 @@ class TestSubsystemHooks:
         assert master.degraded_rounds == 1 and sent == [1]
         rec.close()
         events = load_events(tmp_path / "m.jsonl")
-        rounds = [e for e in events if e["kind"] == "ps_round"]
+        # rounds are SPAN events now (one per round, degraded or not):
+        # the trace timeline renders them and the summary counts them
+        rounds = [
+            e for e in events
+            if e["kind"] == "span" and e.get("name") == "ps_round"
+        ]
         assert rounds and rounds[0]["degraded"] is True
         assert rounds[0]["gathered"] == 1 and rounds[0]["expected"] == 2
+        assert rounds[0]["dur_s"] >= 0
+        from pytorch_distributed_rnn_tpu.obs import summarize_events
+
+        assert summarize_events(events)["ps_degraded_rounds"] == 1
 
 
 # -- malformed-line taxonomy -------------------------------------------------
