@@ -46,6 +46,7 @@ PROVIDER_MODULES = (
     "pytorch_distributed_rnn_tpu.training.native_ddp",
     "pytorch_distributed_rnn_tpu.training.zero",
     "pytorch_distributed_rnn_tpu.training.moe",
+    "pytorch_distributed_rnn_tpu.serving.engine",
 )
 
 # virtual CPU devices the deep pass guarantees when it owns the jax

@@ -1,0 +1,240 @@
+"""Causal attention language model: the attention family's LM adapter.
+
+The attention family's existing model is a sequence CLASSIFIER
+(``models/attention.py``) - (B, T, features) windows pooled into class
+logits - which has no token head and nothing to decode.  Serving needs
+every family to honor the char-RNN ``generate(params, prompt, length,
+temperature, key)`` contract, so this module is the family's thin LM
+adapter: the SAME pre-norm encoder blocks (``init_block`` /
+``block_qkv`` / ``block_epilogue`` - one definition of the block math),
+run causally over token embeddings with a vocab head.
+
+Decode is bounded-buffer by construction: a fixed-capacity KV cache
+(``(B, depth, heads, C, head_dim)``) written in place via per-slot
+dynamic updates, never a growing concatenation.  The cache capacity is
+an argument of the math, not of the numerics: padded cache columns are
+masked to ``-inf`` before the softmax (their probabilities underflow to
+exactly 0.0), so the same request decodes identically under
+``generate``'s tight ``Tp + length`` cache and the serving engine's
+``max_len`` cache - the property the continuous-batching parity tests
+pin.
+
+Module-level :func:`attention_prefill` / :func:`attention_decode_step`
+are shared with ``serving/adapters.py`` so batched continuous decode
+reuses the reference decode math exactly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from pytorch_distributed_rnn_tpu.models.attention import (
+    _layer_norm,
+    _linear,
+    block_epilogue,
+    block_qkv,
+    init_block,
+)
+from pytorch_distributed_rnn_tpu.ops.attention import mha_attention
+from pytorch_distributed_rnn_tpu.ops.initializers import linear_init
+
+
+def _cache_write(cache, kv, pos):
+    """Write this step's K or V rows into a per-layer cache.
+
+    ``cache``: (B, H, C, D), ``kv``: (B, H, 1, D), ``pos``: (B,) int32
+    write index per batch slot (slots decode at independent depths under
+    continuous batching, so the index is per-row, not scalar).
+    """
+    return jax.vmap(
+        lambda c, k, p: lax.dynamic_update_slice_in_dim(c, k, p, axis=1)
+    )(cache, kv, pos)
+
+
+def attention_decode_step(params, k_cache, v_cache, pos, tok,
+                          num_heads: int):
+    """One cached autoregressive step: ``tok`` (B,) int32 at position
+    ``pos`` (B,) int32 -> ``(k_cache, v_cache, logits (B, vocab))``.
+
+    Caches are (B, depth, H, C, head_dim).  Attention spans cache
+    columns ``<= pos`` (the new token's K/V included - written before
+    the scores); later columns are ``-inf``-masked, reproducing
+    :func:`mha_attention`'s causal row for this position exactly.
+    """
+    h = params["embed"][tok] + jnp.take(params["pos"], pos, axis=0)
+    h = h[:, None, :]  # (B, 1, D)
+    cols = jnp.arange(k_cache.shape[3])
+    mask = (cols[None, :] <= pos[:, None])[:, None, None, :]
+    for li, blk in enumerate(params["blocks"]):
+        q, k, v = block_qkv(blk, h, num_heads)  # (B, H, 1, hd)
+        k_cache = k_cache.at[:, li].set(
+            _cache_write(k_cache[:, li], k, pos))
+        v_cache = v_cache.at[:, li].set(
+            _cache_write(v_cache[:, li], v, pos))
+        keys, values = k_cache[:, li], v_cache[:, li]
+        scale = q.shape[-1] ** -0.5
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, keys,
+                       preferred_element_type=jnp.float32) * scale
+        s = jnp.where(mask, s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum("bhqk,bhkd->bhqd", p.astype(values.dtype), values)
+        h = block_epilogue(blk, h, attn)
+    top = _layer_norm(h[:, 0], **params["ln_f"])
+    return k_cache, v_cache, _linear(params["head"], top)
+
+
+def attention_prefill(params, tokens, num_heads: int, cache_len: int):
+    """Batched prompt pass filling a fresh KV cache.
+
+    ``tokens``: (B, T) int32 with T <= cache_len.  Returns
+    ``(k_cache, v_cache, logits (B, T, vocab))`` - caches
+    (B, depth, H, cache_len, head_dim) holding the prompt's K/V in
+    columns [0, T).  Rows past a caller's true prompt length are
+    causal-garbage the caller must ignore (serving pads prompts to
+    bucket lengths; column masking at decode plus sequential overwrites
+    keep the garbage invisible - see ``serving/adapters.py``).
+    """
+    b, t = tokens.shape
+    depth = len(params["blocks"])
+    dim = params["embed"].shape[1]
+    hd = dim // num_heads
+    h = params["embed"][tokens] + params["pos"][:t]
+    k_cache = jnp.zeros((b, depth, num_heads, cache_len, hd), h.dtype)
+    v_cache = jnp.zeros((b, depth, num_heads, cache_len, hd), h.dtype)
+    for li, blk in enumerate(params["blocks"]):
+        q, k, v = block_qkv(blk, h, num_heads)  # (B, H, T, hd)
+        k_cache = k_cache.at[:, li, :, :t].set(k)
+        v_cache = v_cache.at[:, li, :, :t].set(v)
+        attn = mha_attention(q, k, v, causal=True)
+        h = block_epilogue(blk, h, attn)
+    top = _layer_norm(h, **params["ln_f"])
+    return k_cache, v_cache, _linear(params["head"], top)
+
+
+@dataclass(frozen=True)
+class AttentionLM:
+    """``params = model.init(key)``; ``logits = model.apply(params,
+    tokens)`` maps (B, T) int tokens -> (B, T, vocab) next-token logits
+    through causally-masked pre-norm encoder blocks."""
+
+    vocab_size: int = 256
+    dim: int = 64
+    depth: int = 2
+    num_heads: int = 4
+    max_len: int = 512
+
+    def __post_init__(self):
+        if self.dim % self.num_heads != 0:
+            raise ValueError(
+                f"dim {self.dim} must be divisible by num_heads "
+                f"{self.num_heads} (head splitting would silently "
+                "truncate projections)"
+            )
+
+    def init(self, key: jax.Array):
+        ks = jax.random.split(key, self.depth + 3)
+        scale = self.dim ** -0.5
+        return {
+            "embed": jax.random.normal(
+                ks[0], (self.vocab_size, self.dim)) * scale,
+            "pos": jax.random.normal(ks[1], (self.max_len, self.dim)) * 0.02,
+            "blocks": [
+                init_block(ks[2 + i], self.dim, self.num_heads)
+                for i in range(self.depth)
+            ],
+            "ln_f": {"scale": jnp.ones((self.dim,)),
+                     "bias": jnp.zeros((self.dim,))},
+            "head": linear_init(ks[-1], self.dim, self.vocab_size),
+        }
+
+    def apply(self, params, tokens: jax.Array, dropout_key=None) -> jax.Array:
+        """tokens: (B, T) int32 -> logits (B, T, vocab).  The family has
+        no train-mode dropout here; ``dropout_key`` is accepted for the
+        shared model-apply signature and ignored."""
+        t = tokens.shape[1]
+        if t > self.max_len:
+            raise ValueError(
+                f"sequence length {t} exceeds max_len {self.max_len}"
+            )
+        h = params["embed"][tokens] + params["pos"][:t]
+        for blk in params["blocks"]:
+            q, k, v = block_qkv(blk, h, self.num_heads)
+            h = block_epilogue(blk, h, mha_attention(q, k, v, causal=True))
+        h = _layer_norm(h, **params["ln_f"])
+        return _linear(params["head"], h)
+
+    def loss(self, params, tokens: jax.Array, dropout_key=None) -> jax.Array:
+        """Next-token cross entropy (``CharRNN.loss`` semantics)."""
+        from pytorch_distributed_rnn_tpu.ops.losses import cross_entropy_loss
+
+        logits = self.apply(params, tokens[:, :-1])
+        targets = tokens[:, 1:]
+        return cross_entropy_loss(
+            logits.reshape(-1, self.vocab_size), targets.reshape(-1)
+        )
+
+    def generate(self, params, prompt: jax.Array, length: int,
+                 key: jax.Array | None = None,
+                 temperature: float = 1.0) -> jax.Array:
+        """The char-RNN bounded-buffer generation contract:
+        ``prompt (B, Tp) int32 -> (B, Tp + length)``.
+
+        Prefill fills a fixed ``Tp + length`` KV cache in one batched
+        causal pass; a ``lax.scan`` of :func:`attention_decode_step`
+        single-token steps decodes (static trip count, in-place cache
+        writes, no growing buffers).  ``temperature=0`` is greedy
+        argmax; otherwise tokens draw from ``softmax(logits /
+        temperature)`` with the same key-splitting schedule as
+        ``CharRNN.generate``.
+        """
+        if temperature < 0.0:
+            raise ValueError("temperature must be >= 0")
+        if prompt.ndim != 2 or prompt.shape[1] < 1:
+            raise ValueError(
+                "prompt must be (batch, >=1 tokens); an empty prompt has "
+                "no last-step logits to seed decoding"
+            )
+        if prompt.shape[1] + length > self.max_len:
+            raise ValueError(
+                f"prompt ({prompt.shape[1]}) + length ({length}) exceeds "
+                f"max_len {self.max_len}: the bounded KV cache (and the "
+                "learned positions) end there"
+            )
+        greedy = temperature == 0.0
+        if key is None:
+            if not greedy:
+                raise ValueError("sampling (temperature > 0) needs a key")
+            key = jax.random.PRNGKey(0)  # unused by the greedy path
+
+        b, tp = prompt.shape
+        k_cache, v_cache, logits_all = attention_prefill(
+            params, prompt, self.num_heads, cache_len=tp + length
+        )
+        logits0 = logits_all[:, -1, :]
+        pos0 = jnp.full((b,), tp, jnp.int32)
+
+        def pick(k, logits):
+            if greedy:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return jax.random.categorical(
+                k, logits / temperature, axis=-1
+            ).astype(jnp.int32)
+
+        def decode(carry, _):
+            kc, vc, pos, logits, k = carry
+            k, k_samp = jax.random.split(k)
+            tok = pick(k_samp, logits)
+            kc, vc, logits = attention_decode_step(
+                params, kc, vc, pos, tok, self.num_heads
+            )
+            return (kc, vc, pos + 1, logits, k), tok
+
+        _, sampled = lax.scan(
+            decode, (k_cache, v_cache, pos0, logits0, key), None,
+            length=length,
+        )
+        return jnp.concatenate([prompt, sampled.T], axis=1)

@@ -22,7 +22,11 @@ from jax import lax
 
 from pytorch_distributed_rnn_tpu.ops.initializers import linear_init
 from pytorch_distributed_rnn_tpu.ops.losses import cross_entropy_loss
-from pytorch_distributed_rnn_tpu.ops.rnn import init_stacked_rnn, stacked_rnn
+from pytorch_distributed_rnn_tpu.ops.rnn import (
+    head_logits,
+    init_stacked_rnn,
+    stacked_rnn,
+)
 
 
 @dataclass(frozen=True)
@@ -68,10 +72,7 @@ class CharRNN:
             compute_dtype=compute_dtype, remat=self.remat,
             dropout=self.dropout, dropout_key=dropout_key,
         )
-        outputs = outputs.astype(jnp.float32)
-        return (
-            outputs @ params["head"]["weight"].T + params["head"]["bias"]
-        )
+        return head_logits(params["head"], outputs)
 
     def loss(self, params, tokens: jax.Array, dropout_key=None) -> jax.Array:
         """Next-token cross entropy: predict tokens[:, 1:] from
@@ -98,12 +99,7 @@ class CharRNN:
         regardless of ``precision`` - decode is latency-bound, not
         MXU-bound, and sampling is sensitive to logit rounding.
         """
-        from pytorch_distributed_rnn_tpu.ops.rnn import (
-            gru_input_proj,
-            gru_step,
-            lstm_input_proj,
-            lstm_step,
-        )
+        from pytorch_distributed_rnn_tpu.ops.rnn import stacked_rnn_decode_step
 
         if temperature < 0.0:
             raise ValueError("temperature must be >= 0")
@@ -122,10 +118,7 @@ class CharRNN:
         outputs, finals = stacked_rnn(
             params["rnn"], x, self.cell, unroll=self.unroll, impl=self.impl,
         )
-        logits0 = (
-            outputs[:, -1, :].astype(jnp.float32) @ params["head"]["weight"].T
-            + params["head"]["bias"]
-        )
+        logits0 = head_logits(params["head"], outputs[:, -1, :])
 
         def pick(k, logits):
             if greedy:
@@ -138,27 +131,10 @@ class CharRNN:
             carries, logits, k = carry
             k, k_samp = jax.random.split(k)
             tok = pick(k_samp, logits)
-            h_in = params["embed"][tok]
-            new_carries = []
-            for layer, state in zip(params["rnn"], carries):
-                # single-timestep slice through the shared projection
-                # helpers (the one definition of the bias-folding rules)
-                if self.cell == "lstm":
-                    xp = lstm_input_proj(layer, h_in[:, None, :])[:, 0]
-                    state = jax.tree.map(
-                        lambda s: s.astype(jnp.float32), state)
-                    (h, c), h_in = lstm_step(layer["w_hh"].T, state, xp)
-                    new_carries.append((h, c))
-                else:  # gru
-                    xp = gru_input_proj(layer, h_in[:, None, :])[:, 0]
-                    h, h_in = gru_step(
-                        layer["w_hh"].T, layer["b_hh"],
-                        state.astype(jnp.float32), xp)
-                    new_carries.append(h)
-            logits = (
-                h_in.astype(jnp.float32) @ params["head"]["weight"].T
-                + params["head"]["bias"]
+            new_carries, h_top = stacked_rnn_decode_step(
+                params["rnn"], carries, params["embed"][tok], self.cell
             )
+            logits = head_logits(params["head"], h_top)
             return (new_carries, logits, k), tok
 
         _, sampled = lax.scan(

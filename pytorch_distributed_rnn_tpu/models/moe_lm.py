@@ -1,0 +1,168 @@
+"""MoE language model: the MoE family's LM adapter.
+
+The MoE family's existing model is a sequence CLASSIFIER
+(``models/moe.py``) with no token head, so - like
+``models/attention_lm.py`` for the attention family - this module is
+the family's thin generation adapter: the char-RNN shape (embedding ->
+stacked LSTM/GRU -> per-timestep head) with the classifier's residual
+Switch-style MoE FFN (``ops/moe.py::moe_ffn_dense``, the dense-exact
+numerics reference) applied to EVERY timestep's hidden state before the
+vocab projection.
+
+Only token-choice routing is exposed: dense token-choice routes each
+token independently of every other token in the batch, which is the
+property continuous batching rests on - a request decoded inside a
+mixed batch routes exactly as it would alone.  Expert-choice selection
+is global over the token set the router sees (``models/moe.py``
+docstring), so an EC decode would change with its batch neighbours;
+the constructor rejects it loudly.
+
+Decode is bounded-buffer: RNN carries only, one
+``stacked_rnn_decode_step`` + MoE FFN + head per token (shared with
+``serving/adapters.py`` via :func:`moe_lm_decode_tail`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from pytorch_distributed_rnn_tpu.ops.initializers import linear_init
+from pytorch_distributed_rnn_tpu.ops.moe import init_moe_ffn, moe_ffn_dense
+from pytorch_distributed_rnn_tpu.ops.rnn import (
+    head_logits,
+    init_stacked_rnn,
+    stacked_rnn,
+    stacked_rnn_decode_step,
+)
+
+
+def moe_lm_decode_tail(params, h_top, num_selected: int):
+    """Residual MoE + vocab head for ONE decode step's hidden state:
+    ``h_top (B, H) -> logits (B, vocab)``.  The single definition shared
+    by :meth:`MoELM.generate` and the serving adapter - dense
+    token-choice routing is per-token, so the (B, 1, H) call routes each
+    slot exactly as the full-sequence pass routes that position."""
+    moe_out, _ = moe_ffn_dense(
+        params["moe"], h_top[:, None, :], num_selected=num_selected
+    )
+    return head_logits(params["head"], h_top + moe_out[:, 0])
+
+
+@dataclass(frozen=True)
+class MoELM:
+    """``params = model.init(key)``; ``logits = model.apply(params,
+    tokens)`` maps (B, T) int tokens -> (B, T, vocab) next-token logits
+    through an RNN backbone + residual dense-MoE FFN."""
+
+    vocab_size: int = 256
+    embed_dim: int = 64
+    hidden_dim: int = 128
+    layer_dim: int = 2
+    num_experts: int = 4
+    num_selected: int = 1
+    expert_hidden: int | None = None  # default 2 * hidden_dim
+    aux_weight: float = 0.01
+    cell: str = "lstm"
+
+    def __post_init__(self):
+        if not 1 <= self.num_selected <= self.num_experts:
+            raise ValueError(
+                f"num_selected {self.num_selected} needs at least that "
+                f"many experts (num_experts {self.num_experts})"
+            )
+
+    @property
+    def _expert_hidden(self) -> int:
+        return self.expert_hidden or 2 * self.hidden_dim
+
+    def init(self, key: jax.Array):
+        k_embed, k_rnn, k_moe, k_head = jax.random.split(key, 4)
+        scale = self.embed_dim ** -0.5
+        return {
+            "embed": jax.random.normal(
+                k_embed, (self.vocab_size, self.embed_dim)) * scale,
+            "rnn": init_stacked_rnn(
+                k_rnn, self.embed_dim, self.hidden_dim, self.layer_dim,
+                self.cell,
+            ),
+            "moe": init_moe_ffn(
+                k_moe, self.hidden_dim, self.num_experts,
+                self._expert_hidden,
+            ),
+            "head": linear_init(k_head, self.hidden_dim, self.vocab_size),
+        }
+
+    def apply_with_aux(self, params, tokens: jax.Array, dropout_key=None):
+        """(logits (B, T, vocab), aux scalar load-balancing loss)."""
+        x = params["embed"][tokens]
+        out, _ = stacked_rnn(params["rnn"], x, self.cell, impl="scan")
+        moe_out, aux = moe_ffn_dense(
+            params["moe"], out, num_selected=self.num_selected
+        )
+        return head_logits(params["head"], out + moe_out), aux
+
+    def apply(self, params, tokens: jax.Array, dropout_key=None) -> jax.Array:
+        return self.apply_with_aux(params, tokens)[0]
+
+    def loss(self, params, tokens: jax.Array, dropout_key=None) -> jax.Array:
+        """Next-token cross entropy + weighted aux loss."""
+        from pytorch_distributed_rnn_tpu.ops.losses import cross_entropy_loss
+
+        logits, aux = self.apply_with_aux(params, tokens[:, :-1])
+        targets = tokens[:, 1:]
+        ce = cross_entropy_loss(
+            logits.reshape(-1, self.vocab_size), targets.reshape(-1)
+        )
+        return ce + self.aux_weight * aux
+
+    def generate(self, params, prompt: jax.Array, length: int,
+                 key: jax.Array | None = None,
+                 temperature: float = 1.0) -> jax.Array:
+        """The char-RNN bounded-buffer generation contract:
+        ``prompt (B, Tp) int32 -> (B, Tp + length)`` - batched backbone
+        prefill, then a ``lax.scan`` of shared single-token decode steps
+        (``stacked_rnn_decode_step`` + :func:`moe_lm_decode_tail`)."""
+        if temperature < 0.0:
+            raise ValueError("temperature must be >= 0")
+        if prompt.ndim != 2 or prompt.shape[1] < 1:
+            raise ValueError(
+                "prompt must be (batch, >=1 tokens); an empty prompt has "
+                "no last-step logits to seed decoding"
+            )
+        greedy = temperature == 0.0
+        if key is None:
+            if not greedy:
+                raise ValueError("sampling (temperature > 0) needs a key")
+            key = jax.random.PRNGKey(0)  # unused by the greedy path
+
+        x = params["embed"][prompt]
+        out, finals = stacked_rnn(params["rnn"], x, self.cell, impl="scan")
+        logits0 = moe_lm_decode_tail(
+            params, out[:, -1, :], self.num_selected
+        )
+
+        def pick(k, logits):
+            if greedy:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return jax.random.categorical(
+                k, logits / temperature, axis=-1
+            ).astype(jnp.int32)
+
+        def decode(carry, _):
+            carries, logits, k = carry
+            k, k_samp = jax.random.split(k)
+            tok = pick(k_samp, logits)
+            new_carries, h_top = stacked_rnn_decode_step(
+                params["rnn"], carries, params["embed"][tok], self.cell
+            )
+            logits = moe_lm_decode_tail(params, h_top, self.num_selected)
+            return (new_carries, logits, k), tok
+
+        _, sampled = lax.scan(
+            decode, (finals, logits0, key), None, length=length
+        )
+        return jnp.concatenate([prompt, sampled.T], axis=1)

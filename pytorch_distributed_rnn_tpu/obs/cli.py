@@ -56,6 +56,21 @@ _SUMMARY_FIELDS = (
     ("ps_retries", "{:d}"),
     ("ps_degraded_rounds", "{:d}"),
     ("checkpoint_saves", "{:d}"),
+    # serving runs (absent on training sidecars - skipped when None)
+    ("requests", "{:d}"),
+    ("requests_shed", "{:d}"),
+    ("requests_failed", "{:d}"),
+    ("tokens_out", "{:d}"),
+    ("tokens_per_s", "{:.1f}"),
+    ("latency_s_p50", "{:.6f}"),
+    ("latency_s_p95", "{:.6f}"),
+    ("ttft_s_p50", "{:.6f}"),
+    ("ttft_s_p95", "{:.6f}"),
+    ("queue_s_p50", "{:.6f}"),
+    ("queue_s_p95", "{:.6f}"),
+    ("queue_depth_p50", "{:.0f}"),
+    ("queue_depth_p95", "{:.0f}"),
+    ("queue_depth_max", "{:.0f}"),
 )
 
 

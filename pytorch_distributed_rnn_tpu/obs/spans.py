@@ -46,6 +46,7 @@ SUBSYSTEM_TIDS = {
     "eval": 6,
     "resilience": 7,
     "sys": 8,
+    "serving": 9,  # inference-server spans (prefill, serve-loop phases)
 }
 
 

@@ -85,11 +85,31 @@ def load_events(path) -> list[dict]:
     return events
 
 
-def _percentile(sorted_values: list[float], q: float) -> float:
+def percentile(sorted_values: list[float], q: float) -> float:
+    """Nearest-rank percentile over an ascending-sorted list (NaN when
+    empty).  THE percentile convention - shared by the summaries here,
+    the serving engine's request-latency stats and the load generator's
+    SLO report, so the three can never disagree on what a p95 means."""
     if not sorted_values:
         return float("nan")
     idx = min(len(sorted_values) - 1, int(math.ceil(q * len(sorted_values))) - 1)
     return sorted_values[max(0, idx)]
+
+
+_percentile = percentile
+
+
+# serving-run metrics the engine folds into its run_summary event
+# (serving/engine.py): request-latency/TTFT percentiles, queue-depth
+# percentiles, throughput and shedding.  Passed through verbatim when
+# present so `pdrnn-metrics summarize` reads inference sidecars with
+# the training analysis unchanged; absent (None) on training runs.
+SERVING_SUMMARY_KEYS = (
+    "requests", "requests_shed", "requests_failed", "tokens_out",
+    "tokens_per_s", "latency_s_p50", "latency_s_p95", "ttft_s_p50",
+    "ttft_s_p95", "queue_s_p50", "queue_s_p95", "queue_depth_p50",
+    "queue_depth_p95", "queue_depth_max",
+)
 
 
 def summarize_events(events: list[dict], path=None) -> dict:
@@ -154,8 +174,17 @@ def summarize_events(events: list[dict], path=None) -> dict:
             collectives.get("bytes_per_step") if collectives else None
         ),
         "collective_ops": collectives.get("ops") if collectives else None,
-        "duration_s": float(run["duration_s"]) if run else None,
-        "memory_mb": float(run["memory_mb"]) if run else None,
+        # .get: a run_summary is not obliged to carry every field (the
+        # serving engine has no memory_profiler wrap, for one); absent
+        # optional metrics are None, never a loader error
+        "duration_s": (
+            float(run["duration_s"])
+            if run and run.get("duration_s") is not None else None
+        ),
+        "memory_mb": (
+            float(run["memory_mb"])
+            if run and run.get("memory_mb") is not None else None
+        ),
         "device_peak_mb": (
             max(run["device_peaks_mb"].values())
             if run and run.get("device_peaks_mb") else None
@@ -183,6 +212,10 @@ def summarize_events(events: list[dict], path=None) -> dict:
             )
         ),
     }
+    if run:
+        for key in SERVING_SUMMARY_KEYS:
+            if key in run:
+                summary[key] = run[key]
     return summary
 
 

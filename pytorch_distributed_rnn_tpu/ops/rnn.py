@@ -306,6 +306,53 @@ def stacked_rnn(
     return out, finals
 
 
+def stacked_rnn_decode_step(layers, carries, x, cell: str = "lstm"):
+    """One autoregressive token step through a stacked RNN.
+
+    ``x``: (B, in) - the current token's embedding; ``carries``: per-layer
+    final states as returned by :func:`stacked_rnn` (LSTM ``(h, c)`` pairs
+    or GRU ``h``).  Returns ``(new_carries, h_top (B, H))``.
+
+    This is the ONE definition of single-token decode shared by
+    ``CharRNN.generate``, ``MoELM.generate`` and the serving adapters
+    (``serving/adapters.py``) - batched continuous-decode steps reuse the
+    exact math of the per-request reference decode, so a request served
+    inside a batch reproduces its single-request decode bit for bit.
+    Decode runs in f32 (the generation contract: latency-bound, not
+    MXU-bound, and sampling is sensitive to logit rounding); carries are
+    cast on entry so callers may hand over the ``stacked_rnn`` finals of
+    a reduced-precision prefill unchanged.
+    """
+    h_in = x
+    new_carries = []
+    for layer, state in zip(layers, carries):
+        # single-timestep slice through the shared projection helpers
+        # (the one definition of the bias-folding rules)
+        if cell == "lstm":
+            xp = lstm_input_proj(layer, h_in[:, None, :])[:, 0]
+            state = jax.tree.map(lambda s: s.astype(jnp.float32), state)
+            (h, c), h_in = lstm_step(layer["w_hh"].T, state, xp)
+            new_carries.append((h, c))
+        elif cell == "gru":
+            xp = gru_input_proj(layer, h_in[:, None, :])[:, 0]
+            h, h_in = gru_step(
+                layer["w_hh"].T, layer["b_hh"],
+                state.astype(jnp.float32), xp)
+            new_carries.append(h)
+        else:
+            raise ValueError(f"unknown cell {cell!r}")
+    return new_carries, h_in
+
+
+def head_logits(head, h):
+    """The ONE LM vocab-head projection (f32 compute regardless of the
+    backbone's dtype - sampling is sensitive to logit rounding), shared
+    by the char/MoE model families and the serving adapters so batched
+    serving can never drift from single-request ``generate`` numerics.
+    ``head``: ``{"weight", "bias"}``; ``h``: (..., H) -> (..., vocab)."""
+    return h.astype(jnp.float32) @ head["weight"].T + head["bias"]
+
+
 def interlayer_dropout(out, dropout_key, dropout: float):
     """The ONE between-layer dropout block (split/bernoulli/scale) shared
     by the unsharded stack above and the sp relay stacks

@@ -1,0 +1,32 @@
+"""Continuous-batching inference serving.
+
+The repo's first non-training workload (ROADMAP open item 1): load a
+crash-safe checkpoint (``training/checkpoint.py``), accept generation
+requests over a JSON-lines TCP protocol, and decode them in
+continuously-batched jitted steps - new requests join the in-flight
+batch at step boundaries, finished sequences leave, and freed slots
+refill without restarting decode.  Padded bucket shapes (batch slots +
+prompt-length buckets) keep steady-state serving retrace-free; the
+decode entries are registered in ``lint/trace_registry.py`` so the
+jaxpr deep pass covers them like every trainer step.
+
+Layering (each importable without the ones above it):
+
+- :mod:`.buckets`    - prompt-length bucket policy (pure, no jax)
+- :mod:`.scheduler`  - the continuous-batching core (pure, no jax):
+  admission / shedding, FIFO slot assignment at step boundaries
+- :mod:`.adapters`   - per-family prefill / decode-step programs
+  sharing the reference ``generate`` math bit for bit
+- :mod:`.engine`     - jitted execution + sampling + telemetry
+- :mod:`.server`     - the TCP JSON-lines server (``pdrnn-serve``)
+- :mod:`.loadgen`    - Poisson load generator + SLO report
+  (``pdrnn-loadgen``), chaos SLO drill via ``--spawn-server``
+"""
+
+from pytorch_distributed_rnn_tpu.serving.buckets import BucketSpec
+from pytorch_distributed_rnn_tpu.serving.scheduler import (
+    ContinuousBatcher,
+    ServeRequest,
+)
+
+__all__ = ["BucketSpec", "ContinuousBatcher", "ServeRequest"]

@@ -1,0 +1,28 @@
+"""``python -m pytorch_distributed_rnn_tpu.serving {serve,loadgen} ...``
+- the module form of the ``pdrnn-serve`` / ``pdrnn-loadgen`` console
+scripts (the drill spawns servers through this form so it works from a
+source checkout without an installed entry point)."""
+
+from __future__ import annotations
+
+import sys
+
+from pytorch_distributed_rnn_tpu.serving.cli import loadgen_main, serve_main
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if not argv or argv[0] not in ("serve", "loadgen"):
+        print(
+            "usage: python -m pytorch_distributed_rnn_tpu.serving "
+            "{serve,loadgen} [options]",
+            file=sys.stderr,
+        )
+        return 2
+    if argv[0] == "serve":
+        return serve_main(argv[1:])
+    return loadgen_main(argv[1:])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,221 @@
+"""Per-family prefill / decode-step programs for the serving engine.
+
+An adapter binds one model family to the engine's two jitted programs:
+
+- ``prefill(model_params, prompt (1, L), length (1,))`` consumes one
+  request's BUCKET-PADDED prompt and returns ``(seq_state, logits)``
+  with leading dim 1 - the per-sequence decode state the engine splices
+  into a batch slot;
+- ``step(model_params, state, tok (B,), pos (B,))`` advances every slot
+  one token and returns ``(state, logits (B, vocab))``.
+
+Every adapter reuses the family's reference-decode math (the module
+functions its ``generate`` is built from), so a request decoded inside
+a continuous batch reproduces its single-request ``generate`` output
+exactly - the parity contract ``tests/test_serving.py`` pins per
+family.
+
+Prompt padding never leaks into decode state: the RNN families run a
+MASKED prefill scan (carries update only while ``t < length``), and the
+attention family's padded KV-cache columns are ``-inf``-masked until
+each is overwritten by a real decoded token.  Masking - not exact-length
+tracing - is what lets one jitted prefill per bucket serve every prompt
+length, the zero-retrace property the engine asserts.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from pytorch_distributed_rnn_tpu.models.attention_lm import (
+    AttentionLM,
+    attention_decode_step,
+    attention_prefill,
+)
+from pytorch_distributed_rnn_tpu.models.char_rnn import CharRNN
+from pytorch_distributed_rnn_tpu.models.moe_lm import MoELM, moe_lm_decode_tail
+from pytorch_distributed_rnn_tpu.ops.rnn import (
+    head_logits,
+    stacked_rnn_decode_step,
+)
+
+
+def masked_rnn_prefill(layers, embeds, length, cell: str):
+    """Stacked-RNN prefill over a padded prompt.
+
+    ``embeds``: (B, L, in) token embeddings, ``length``: (B,) int32 true
+    prompt lengths.  Scans single-token decode steps over the padded
+    extent; carries merge only while ``t < length``, and the top-layer
+    hidden at ``t == length - 1`` is captured as the last-step features.
+    Numerically identical to ``stacked_rnn`` over the exact-length
+    prompt (the per-timestep projection slices are the same matmul
+    rows), which the parity tests pin.
+    Returns ``(carries, last_h (B, H))``.
+    """
+    batch = embeds.shape[0]
+    hidden = layers[0]["w_hh"].shape[1]
+
+    def zero_carry(_layer):
+        h = jnp.zeros((batch, hidden), jnp.float32)
+        return (h, h) if cell == "lstm" else h
+
+    carries0 = [zero_carry(layer) for layer in layers]
+    last_h0 = jnp.zeros((batch, hidden), jnp.float32)
+
+    def step(carry, x_t):
+        carries, last_h, t = carry
+        new_carries, h_top = stacked_rnn_decode_step(
+            layers, carries, x_t, cell
+        )
+        keep = (t < length)[:, None]  # (B, 1) broadcasts over hidden
+        carries = jax.tree.map(
+            lambda new, old: jnp.where(keep, new, old), new_carries, carries
+        )
+        last_h = jnp.where((t == length - 1)[:, None], h_top, last_h)
+        return (carries, last_h, t + 1), None
+
+    (carries, last_h, _), _ = lax.scan(
+        step, (carries0, last_h0, jnp.int32(0)),
+        jnp.swapaxes(embeds, 0, 1),
+    )
+    return carries, last_h
+
+
+def _rnn_state_template(layers, batch: int, hidden: int, cell: str):
+    """Blank stacked-RNN decode state.  Every leaf is a DISTINCT zeros
+    array: the engine donates the state tree into its jitted step, and
+    aliased leaves would be the same buffer donated twice."""
+
+    def carry():
+        if cell == "lstm":
+            return (jnp.zeros((batch, hidden), jnp.float32),
+                    jnp.zeros((batch, hidden), jnp.float32))
+        return jnp.zeros((batch, hidden), jnp.float32)
+
+    return {"carries": [carry() for _ in layers]}
+
+
+class CharRNNAdapter:
+    """CharRNN: decode state = the stacked cells' carries."""
+
+    family = "char"
+
+    def __init__(self, model: CharRNN):
+        self.model = model
+        self.vocab_size = model.vocab_size
+        self.max_context = None  # recurrent state: no positional bound
+
+    def state_template(self, model_params, batch: int):
+        return _rnn_state_template(
+            model_params["rnn"], batch, self.model.hidden_dim,
+            self.model.cell,
+        )
+
+    def prefill(self, model_params, prompt, length):
+        embeds = model_params["embed"][prompt]
+        carries, last_h = masked_rnn_prefill(
+            model_params["rnn"], embeds, length, self.model.cell
+        )
+        return {"carries": carries}, head_logits(
+            model_params["head"], last_h)
+
+    def step(self, model_params, state, tok, pos):
+        carries, h_top = stacked_rnn_decode_step(
+            model_params["rnn"], state["carries"],
+            model_params["embed"][tok], self.model.cell,
+        )
+        return {"carries": carries}, head_logits(
+            model_params["head"], h_top)
+
+
+class MoELMAdapter:
+    """MoELM: CharRNN-shaped carries, MoE-FFN + head decode tail."""
+
+    family = "moe"
+
+    def __init__(self, model: MoELM):
+        self.model = model
+        self.vocab_size = model.vocab_size
+        self.max_context = None
+
+    def state_template(self, model_params, batch: int):
+        return _rnn_state_template(
+            model_params["rnn"], batch, self.model.hidden_dim,
+            self.model.cell,
+        )
+
+    def prefill(self, model_params, prompt, length):
+        embeds = model_params["embed"][prompt]
+        carries, last_h = masked_rnn_prefill(
+            model_params["rnn"], embeds, length, self.model.cell
+        )
+        logits = moe_lm_decode_tail(
+            model_params, last_h, self.model.num_selected
+        )
+        return {"carries": carries}, logits
+
+    def step(self, model_params, state, tok, pos):
+        carries, h_top = stacked_rnn_decode_step(
+            model_params["rnn"], state["carries"],
+            model_params["embed"][tok], self.model.cell,
+        )
+        logits = moe_lm_decode_tail(
+            model_params, h_top, self.model.num_selected
+        )
+        return {"carries": carries}, logits
+
+
+class AttentionLMAdapter:
+    """AttentionLM: decode state = fixed-capacity KV caches; the model's
+    ``max_len`` bounds prompt + generated tokens per request."""
+
+    family = "attention"
+
+    def __init__(self, model: AttentionLM):
+        self.model = model
+        self.vocab_size = model.vocab_size
+        self.max_context = model.max_len
+        self.cache_len = model.max_len
+
+    def state_template(self, model_params, batch: int):
+        depth = self.model.depth
+        heads = self.model.num_heads
+        hd = self.model.dim // heads
+        shape = (batch, depth, heads, self.cache_len, hd)
+        return {
+            "k": jnp.zeros(shape, jnp.float32),
+            "v": jnp.zeros(shape, jnp.float32),
+        }
+
+    def prefill(self, model_params, prompt, length):
+        k_cache, v_cache, logits_all = attention_prefill(
+            model_params, prompt, self.model.num_heads,
+            cache_len=self.cache_len,
+        )
+        # the true prompt's last-step logits (padded rows are causal
+        # garbage); per-row dynamic index so every bucket traces once
+        logits = jax.vmap(lambda row, i: row[i])(logits_all, length - 1)
+        return {"k": k_cache, "v": v_cache}, logits
+
+    def step(self, model_params, state, tok, pos):
+        k_cache, v_cache, logits = attention_decode_step(
+            model_params, state["k"], state["v"], pos, tok,
+            self.model.num_heads,
+        )
+        return {"k": k_cache, "v": v_cache}, logits
+
+
+def adapter_for(model):
+    """The adapter matching ``model``'s family (loud on unknowns)."""
+    if isinstance(model, CharRNN):
+        return CharRNNAdapter(model)
+    if isinstance(model, MoELM):
+        return MoELMAdapter(model)
+    if isinstance(model, AttentionLM):
+        return AttentionLMAdapter(model)
+    raise TypeError(
+        f"no serving adapter for {type(model).__name__} - servable "
+        "families: CharRNN, AttentionLM, MoELM"
+    )

@@ -1,0 +1,605 @@
+"""Continuous-batching decode engine: jitted programs + slot execution.
+
+The engine owns a fixed batch of ``num_slots`` decode slots and three
+jitted programs built ONCE at construction (the PD104 contract - no jit
+in the serve loop):
+
+- ``prefill``: one request's bucket-padded prompt -> per-sequence state
+  (traced once per prompt bucket);
+- ``join``: splice a prefilled sequence into a batch slot at a traced
+  slot index (one trace total);
+- ``step``: advance every slot one token - split per-slot PRNG keys,
+  sample (per-slot temperature, greedy at 0), run the family adapter's
+  decode step (one trace total).
+
+After :meth:`warmup` the jit caches hold exactly ``len(buckets) + 2``
+programs and the request mix can never add another -
+:meth:`retraces_since` asserts that, and the serving tests pin zero
+retraces across a mixed-length stream.
+
+Per-slot PRNG keys follow ``generate``'s split-then-sample schedule, so
+a request's sampled tokens equal its single-request
+``model.generate(..., key=PRNGKey(seed))`` decode exactly (satellite:
+per-request keys threaded end to end).
+
+Telemetry rides the existing ``obs/`` recorder: per-decode-step
+``step`` events (dispatch/fenced wall time, pre-step wait as
+``data_wait_s``, queue depth), ``prefill`` spans, a ``request`` event
+per completion, and a ``run_summary`` carrying request-latency/TTFT
+percentiles, queue-depth percentiles and tokens/sec - so
+``pdrnn-metrics summarize`` / ``timeline`` / ``health`` read serving
+runs with the training analysis code unchanged.
+
+Chaos (``resilience/faults.py``) plugs in as on a trainer: ``stall``
+faults hold the decode loop (latency grows, the queue sheds),
+``nan`` corrupts the in-flight logits - the engine detects non-finite
+logits per slot and fails those requests cleanly instead of streaming
+garbage - ``exc`` is absorbed as a logged fault, ``kill`` preempts the
+process.  The server survives all of them; the SLO drill measures the
+degradation window.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from pytorch_distributed_rnn_tpu.obs.recorder import NULL_RECORDER
+from pytorch_distributed_rnn_tpu.obs.summary import percentile
+from pytorch_distributed_rnn_tpu.resilience.faults import ChaosError
+from pytorch_distributed_rnn_tpu.serving.buckets import BucketSpec
+from pytorch_distributed_rnn_tpu.serving.scheduler import (
+    ContinuousBatcher,
+    ServeRequest,
+)
+
+log = logging.getLogger(__name__)
+
+_IDLE_WAIT_S = 0.05
+
+
+# percentile windows: a long-lived server must not grow host memory
+# with its request history, so latency/TTFT/queue stats cover the most
+# RECENT observations (ample for an SLO view; totals stay exact)
+_REQUEST_WINDOW = 4096
+_DEPTH_WINDOW = 16384
+
+
+def decode_step_program(adapter, state, model_params):
+    """The batched decode step - the program ``pdrnn-serve`` runs per
+    token, registered in ``lint/trace_registry.py`` so the jaxpr deep
+    pass covers serving like every trainer step.
+
+    Per slot: split the PRNG key, sample from the CURRENT logits
+    (``generate``'s schedule - temperature 0 is greedy argmax), run the
+    family adapter's decode step, and flag slots whose logits went
+    non-finite (chaos NaN faults / poisoned checkpoints fail their
+    request instead of streaming garbage).  Returns
+    ``(new_state, tok (B,), ok (B,))``.
+    """
+    keys, logits = state["keys"], state["logits"]
+    temps, pos = state["temps"], state["pos"]
+    ks = jax.vmap(jax.random.split)(keys)
+    k_next, k_samp = ks[:, 0], ks[:, 1]
+    safe_t = jnp.where(temps > 0, temps, 1.0)
+    sampled = jax.vmap(jax.random.categorical)(
+        k_samp, logits / safe_t[:, None]
+    )
+    tok = jnp.where(
+        temps > 0, sampled, jnp.argmax(logits, axis=-1)
+    ).astype(jnp.int32)
+    model, new_logits = adapter.step(model_params, state["model"], tok, pos)
+    ok = jnp.all(jnp.isfinite(new_logits), axis=-1) & jnp.all(
+        jnp.isfinite(logits), axis=-1
+    )
+    new_state = {
+        "model": model, "logits": new_logits, "keys": k_next,
+        "pos": pos + 1, "temps": temps,
+    }
+    return new_state, tok, ok
+
+
+class ServingEngine:
+    """Continuous-batching executor for one model family."""
+
+    def __init__(self, adapter, params, *, num_slots: int = 4,
+                 bucket_spec: BucketSpec | None = None,
+                 max_new_tokens: int = 64, max_queue: int = 64,
+                 recorder=NULL_RECORDER, faults=None):
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}"
+            )
+        self.adapter = adapter
+        self.params = params
+        self.buckets = bucket_spec or BucketSpec()
+        self.max_new_tokens = int(max_new_tokens)
+        if adapter.max_context is not None:
+            budget = self.buckets.max_prompt_len + self.max_new_tokens
+            if budget > adapter.max_context:
+                raise ValueError(
+                    f"largest prompt bucket ({self.buckets.max_prompt_len})"
+                    f" + max_new_tokens ({self.max_new_tokens}) exceeds the"
+                    f" {adapter.family} family's context bound "
+                    f"{adapter.max_context}"
+                )
+        self.batcher = ContinuousBatcher(num_slots, max_queue)
+        self.recorder = recorder
+        self.faults = faults
+        if faults is not None and getattr(recorder, "enabled", False):
+            faults.recorder = recorder
+        self._work = threading.Condition(threading.Lock())
+        self._closed = False
+
+        # jit construction happens HERE, never in the serve loop; the
+        # trace-time counters (bumped when a program body is traced, not
+        # when it runs) are the ground truth retraces_since() reads
+        self._trace_counts = {"prefill": 0, "step": 0, "join": 0}
+
+        def prefill_fn(model_params, prompt, length):
+            self._trace_counts["prefill"] += 1
+            return self.adapter.prefill(model_params, prompt, length)
+
+        def join_fn(state, seq_state, seq_logits, key, length, temp, slot):
+            self._trace_counts["join"] += 1
+            model = jax.tree.map(
+                lambda full, one: lax.dynamic_update_slice_in_dim(
+                    full, one, slot, axis=0),
+                state["model"], seq_state,
+            )
+            return {
+                "model": model,
+                "logits": lax.dynamic_update_slice_in_dim(
+                    state["logits"], seq_logits, slot, axis=0),
+                "keys": lax.dynamic_update_slice_in_dim(
+                    state["keys"], key[None], slot, axis=0),
+                "pos": state["pos"].at[slot].set(length),
+                "temps": state["temps"].at[slot].set(temp),
+            }
+
+        def step_fn(state, model_params):
+            self._trace_counts["step"] += 1
+            return decode_step_program(self.adapter, state, model_params)
+
+        self._prefill = jax.jit(prefill_fn)
+        self._join = jax.jit(join_fn, donate_argnums=(0,))
+        self._step = jax.jit(step_fn, donate_argnums=(0,))
+        self.state = self._fresh_state()
+
+        # serving statistics (windowed deques: bounded memory for a
+        # long-lived server; counters stay exact totals)
+        self._steps = 0
+        self._tokens_out = 0
+        self._requests_done = 0
+        self._started_tm = time.perf_counter()
+        # guards the stat deques: the engine thread appends while
+        # connection threads iterate them in stats() (an unguarded
+        # deque raises "mutated during iteration" mid-sort)
+        self._stats_lock = threading.Lock()
+        self._latencies: deque[float] = deque(maxlen=_REQUEST_WINDOW)
+        self._ttfts: deque[float] = deque(maxlen=_REQUEST_WINDOW)
+        self._queue_waits: deque[float] = deque(maxlen=_REQUEST_WINDOW)
+        self._queue_depths: deque[int] = deque(maxlen=_DEPTH_WINDOW)
+        self._requests_failed = 0
+        self._chaos_exceptions = 0
+
+    # -- construction helpers ------------------------------------------------
+
+    def _fresh_state(self):
+        batch = self.batcher.num_slots
+        return {
+            "model": self.adapter.state_template(self.params, batch),
+            "logits": jnp.zeros(
+                (batch, self.adapter.vocab_size), jnp.float32),
+            "keys": jnp.zeros((batch, 2), jnp.uint32),
+            "pos": jnp.zeros((batch,), jnp.int32),
+            "temps": jnp.zeros((batch,), jnp.float32),
+        }
+
+    def warmup(self):
+        """Trace every program the serve loop can need: one prefill per
+        prompt bucket, one join, one step.  Steady-state serving then
+        never compiles - the zero-retrace contract."""
+        state = self.state
+        for bucket in self.buckets.prompt_buckets:
+            prompt = jnp.zeros((1, bucket), jnp.int32)
+            seq_state, logits = self._prefill(
+                self.params, prompt, jnp.ones((1,), jnp.int32)
+            )
+            state = self._join(
+                state, seq_state, logits, jnp.zeros((2,), jnp.uint32),
+                jnp.int32(1), jnp.float32(0.0), jnp.int32(0),
+            )
+        state, tok, _ = self._step(state, self.params)
+        jax.block_until_ready(tok)
+        # warmup ran on the live state tree (donated through each call);
+        # reset to blank slots for serving
+        self.state = self._fresh_state()
+
+    # -- retrace accounting --------------------------------------------------
+
+    def retrace_snapshot(self) -> dict:
+        return dict(self._trace_counts)
+
+    def retraces_since(self, snapshot: dict) -> dict:
+        """Programs traced since ``snapshot`` (empty dict = none)."""
+        return {
+            name: count - snapshot.get(name, 0)
+            for name, count in self._trace_counts.items()
+            if count != snapshot.get(name, 0)
+        }
+
+    # -- request side (any thread) -------------------------------------------
+
+    def submit(self, request: ServeRequest) -> bool:
+        """Queue ``request``; False = shed (queue full) or rejected
+        (malformed), with ``request.status``/``error`` set."""
+        try:
+            request.bucket = self.buckets.bucket_for(len(request.prompt))
+        except ValueError as exc:
+            request.status = "error"
+            request.error = str(exc)
+            return False
+        if not 1 <= request.max_new_tokens <= self.max_new_tokens:
+            request.status = "error"
+            request.error = (
+                f"max_new_tokens must be in [1, {self.max_new_tokens}], "
+                f"got {request.max_new_tokens}"
+            )
+            return False
+        if request.temperature < 0:
+            request.status = "error"
+            request.error = "temperature must be >= 0"
+            return False
+        # PRNGKey takes a C-long seed; an unchecked client bigint would
+        # raise OverflowError ON THE ENGINE THREAD at join time
+        if not -(2 ** 63) <= request.seed < 2 ** 63:
+            request.status = "error"
+            request.error = "seed must fit in a signed 64-bit integer"
+            return False
+        if request.arrival_tm is None:
+            request.arrival_tm = time.perf_counter()
+        with self._work:
+            admitted = self.batcher.admit(request)
+            if admitted:
+                self._work.notify_all()
+        return admitted
+
+    # -- serve loop (one thread) ---------------------------------------------
+
+    def run_step(self, wait_s: float = _IDLE_WAIT_S) -> bool:
+        """One scheduler iteration: join waiting requests into free
+        slots, advance the batch one decode step, deliver tokens and
+        retire finished sequences.  Blocks up to ``wait_s`` for work
+        when idle; returns whether a decode step ran."""
+        wait_t0 = time.perf_counter()
+        with self._work:
+            if not self.batcher.has_work:
+                self._work.wait(timeout=wait_s)
+            joins = self.batcher.take_joins()
+        for slot, request in joins:
+            self._do_join(slot, request)
+        with self._work:
+            active = self.batcher.active()
+        if not active:
+            return False
+
+        step_index = self._steps
+        self._steps += 1
+        if self.faults is not None:
+            self._apply_faults(step_index)
+        t0 = time.perf_counter()
+        self.state, tok, ok = self._step(self.state, self.params)
+        toks = np.asarray(tok)  # blocks: serving needs the values
+        ok = np.asarray(ok)
+        step_s = time.perf_counter() - t0
+
+        rec = self.recorder
+        if rec.enabled:
+            depth = self.batcher.queue_depth
+            with self._stats_lock:
+                self._queue_depths.append(depth)
+            rec.record(
+                "step", step=step_index, dispatch_s=step_s,
+                fenced_s=step_s if rec.is_sample_step(step_index) else None,
+                # pre-dispatch wait: idle + joins (prefill is serving's
+                # input pipeline, so it lands in the data phase)
+                data_wait_s=max(0.0, t0 - wait_t0), tm=t0,
+                queue_depth=depth, active=len(active),
+            )
+            rec.note_progress(step_index)
+        else:
+            with self._stats_lock:
+                self._queue_depths.append(self.batcher.queue_depth)
+
+        now = time.perf_counter()
+        for slot, request in active:
+            if not ok[slot]:
+                self._finish(
+                    slot, request, now,
+                    error="non-finite logits during decode (chaos fault "
+                          "or poisoned checkpoint)",
+                )
+                continue
+            token = int(toks[slot])
+            request.tokens.append(token)
+            if request.first_token_tm is None:
+                request.first_token_tm = now
+            if request.on_token is not None:
+                request.on_token(request, token)
+            if request.finished:
+                self._finish(slot, request, now)
+        return True
+
+    def _do_join(self, slot: int, request: ServeRequest):
+        t0 = time.perf_counter()
+        request.service_tm = t0
+        padded = self.buckets.pad(request.prompt)
+        seq_state, logits = self._prefill(
+            self.params, jnp.asarray(padded),
+            jnp.asarray([len(request.prompt)], jnp.int32),
+        )
+        key = jax.random.PRNGKey(request.seed)
+        self.state = self._join(
+            self.state, seq_state, logits, key,
+            jnp.int32(len(request.prompt)),
+            jnp.float32(request.temperature), jnp.int32(slot),
+        )
+        if self.recorder.enabled:
+            self.recorder.emit_span(
+                "prefill", t0, time.perf_counter() - t0, cat="serving",
+                request=request.id or request.seq, bucket=request.bucket,
+                prompt_len=len(request.prompt), slot=slot,
+            )
+
+    def _finish(self, slot: int, request: ServeRequest, now: float,
+                error: str | None = None):
+        with self._work:
+            self.batcher.release(slot)
+        request.done_tm = now
+        if error is not None:
+            request.status = "error"
+            request.error = error
+            self._requests_failed += 1
+        else:
+            request.status = "done"
+        self._requests_done += 1
+        self._tokens_out += len(request.tokens)
+        with self._stats_lock:
+            if request.latency_s is not None:
+                self._latencies.append(request.latency_s)
+            if request.ttft_s is not None:
+                self._ttfts.append(request.ttft_s)
+            if request.queue_wait_s is not None:
+                self._queue_waits.append(request.queue_wait_s)
+        if self.recorder.enabled:
+            self.recorder.record(
+                "request", request=request.id or request.seq,
+                status=request.status, tokens=len(request.tokens),
+                latency_s=request.latency_s, ttft_s=request.ttft_s,
+                queue_s=request.queue_wait_s, bucket=request.bucket,
+                error=request.error,
+            )
+        if request.on_done is not None:
+            request.on_done(request)
+
+    def _apply_faults(self, step_index: int):
+        """Trainer-style chaos hooks on the decode loop: stall holds the
+        loop, exc is absorbed (the server must survive), nan poisons the
+        in-flight logits (caught per slot next step), kill preempts."""
+        try:
+            self.faults.on_producer_item(step_index)
+        except ChaosError as exc:
+            self._chaos_exceptions += 1
+            log.warning(f"serving: absorbed injected failure: {exc}")
+        if self.faults.has_step_events:
+            logits, _ = self.faults.corrupt_batch(
+                step_index, (self.state["logits"], None)
+            )
+            if logits is not self.state["logits"]:
+                self.state = {**self.state, "logits": logits}
+        self.faults.maybe_kill(step=step_index)
+
+    def serve_forever(self, stop_event: threading.Event):
+        """The engine loop, hardened: one request's failure must fail
+        THAT request, never the serve thread - a dead engine behind a
+        live TCP front end would hang every future client."""
+        while not stop_event.is_set():
+            try:
+                self.run_step()
+            except Exception:
+                log.exception(
+                    "serving: decode loop error; failing the in-flight "
+                    "batch and continuing"
+                )
+                self._recover()
+
+    def _recover(self):
+        """Fail every active request and reset the batch state (a loop
+        exception may have left it partially updated or donated-away);
+        queued requests are untouched and decode next."""
+        now = time.perf_counter()
+        with self._work:
+            active = self.batcher.active()
+        for slot, request in active:
+            self._finish(
+                slot, request, now,
+                error="internal decode error (see server log)",
+            )
+        self.state = self._fresh_state()
+
+    def drain(self):
+        """Run until queue and slots are empty (tests, shutdown)."""
+        while self.batcher.has_work:
+            self.run_step(wait_s=0.0)
+
+    # -- shutdown / stats ----------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._stats_lock:
+            lat = sorted(self._latencies)
+            ttft = sorted(self._ttfts)
+            waits = sorted(self._queue_waits)
+            depths = sorted(self._queue_depths)
+        elapsed = time.perf_counter() - self._started_tm
+        return {
+            "steps": self._steps,
+            "requests": self._requests_done,
+            "requests_shed": self.batcher.shed,
+            # every errored completion: non-finite logits, decode-loop
+            # recovery, shutdown mid-decode
+            "requests_failed": self._requests_failed,
+            "queue_depth": self.batcher.queue_depth,
+            "active": self.batcher.active_count,
+            "tokens_out": self._tokens_out,
+            "tokens_per_s": self._tokens_out / elapsed if elapsed > 0
+            else None,
+            "latency_s_p50": percentile(lat, 0.50) if lat else None,
+            "latency_s_p95": percentile(lat, 0.95) if lat else None,
+            "ttft_s_p50": percentile(ttft, 0.50) if ttft else None,
+            "ttft_s_p95": percentile(ttft, 0.95) if ttft else None,
+            "queue_s_p50": percentile(waits, 0.50) if waits else None,
+            "queue_s_p95": percentile(waits, 0.95) if waits else None,
+            "queue_depth_p50": percentile(depths, 0.50) if depths
+            else None,
+            "queue_depth_p95": percentile(depths, 0.95) if depths
+            else None,
+            "queue_depth_max": depths[-1] if depths else None,
+            "chaos_absorbed": self._chaos_exceptions,
+            "trace_counts": dict(self._trace_counts),
+        }
+
+    def close(self):
+        """Abort queued AND in-flight requests (their clients get an
+        error event, not a dead socket), emit the run summary;
+        idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        with self._work:
+            aborted = self.batcher.abort_pending("server shutting down")
+            active = self.batcher.active()
+        for request in aborted:
+            if request.on_done is not None:
+                request.on_done(request)
+        now = time.perf_counter()
+        for slot, request in active:
+            self._finish(slot, request, now,
+                         error="server shut down mid-decode")
+        if self.recorder.enabled:
+            stats = self.stats()
+            # the repo's one RSS definition (utils/profiling.py): the
+            # trainers sample it around a bounded run; a long-lived
+            # server reports the close-time reading
+            from pytorch_distributed_rnn_tpu.utils.profiling import _rss_mb
+
+            self.recorder.record(
+                "run_summary",
+                duration_s=time.perf_counter() - self._started_tm,
+                memory_mb=_rss_mb() or None,
+                **{k: v for k, v in stats.items()
+                   if k not in ("queue_depth", "active", "trace_counts")},
+            )
+            self.recorder.flush()
+
+
+# ---------------------------------------------------------------------------
+# trace-registry provider (lint deep pass)
+
+# abstract serving shapes for the deep pass: a small batch and one
+# prompt bucket is enough - the rules are shape-generic
+_TRACE_SLOTS = 4
+_TRACE_BUCKET = 16
+
+
+def _trace_model(family: str):
+    from pytorch_distributed_rnn_tpu.models import AttentionLM, CharRNN, MoELM
+
+    if family == "char":
+        return CharRNN(vocab_size=256, embed_dim=32, hidden_dim=32,
+                       layer_dim=2, impl="scan")
+    if family == "attention":
+        return AttentionLM(vocab_size=256, dim=32, depth=2, num_heads=4,
+                           max_len=64)
+    return MoELM(vocab_size=256, embed_dim=32, hidden_dim=32, layer_dim=2)
+
+
+def declare_trace_entries(register):
+    """Serving decode/prefill entry points for ``pdrnn-lint --deep``:
+    the continuous-batching step per family plus the bucket-padded
+    prefill - abstract specs only, single-device (no mesh)."""
+    from pytorch_distributed_rnn_tpu.lint.trace_registry import (
+        abstract_init,
+        prng_spec,
+        sds,
+    )
+
+    def abstract_setup(family: str):
+        import jax
+        import jax.numpy as jnp
+
+        from pytorch_distributed_rnn_tpu.serving.adapters import adapter_for
+
+        model = _trace_model(family)
+        adapter = adapter_for(model)
+        params = abstract_init(model.init, prng_spec())
+        state = jax.eval_shape(
+            lambda p: {
+                "model": adapter.state_template(p, _TRACE_SLOTS),
+                "logits": jnp.zeros(
+                    (_TRACE_SLOTS, adapter.vocab_size), jnp.float32),
+                "keys": jnp.zeros((_TRACE_SLOTS, 2), jnp.uint32),
+                "pos": jnp.zeros((_TRACE_SLOTS,), jnp.int32),
+                "temps": jnp.zeros((_TRACE_SLOTS,), jnp.float32),
+            },
+            params,
+        )
+        return adapter, params, state
+
+    def build_step(family: str):
+        def build():
+            import functools
+
+            adapter, params, state = abstract_setup(family)
+            return functools.partial(decode_step_program, adapter), (
+                state, params,
+            )
+
+        return build
+
+    def build_prefill(family: str):
+        def build():
+            import jax.numpy as jnp
+
+            adapter, params, _ = abstract_setup(family)
+            return adapter.prefill, (
+                params,
+                sds((1, _TRACE_BUCKET), jnp.int32),
+                sds((1,), jnp.int32),
+            )
+
+        return build
+
+    for family in ("char", "attention", "moe"):
+        register(
+            name=f"serving.{family}_decode_step",
+            family="serving",
+            path="pytorch_distributed_rnn_tpu/serving/engine.py",
+            build=build_step(family),
+            kind="forward",
+            donate=(0,),
+        )
+    register(
+        name="serving.char_prefill",
+        family="serving",
+        path="pytorch_distributed_rnn_tpu/serving/adapters.py",
+        build=build_prefill("char"),
+        kind="forward",
+    )

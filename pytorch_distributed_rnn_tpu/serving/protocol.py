@@ -1,0 +1,142 @@
+"""JSON-lines wire protocol for the serving TCP endpoint.
+
+One JSON object per ``\\n``-terminated line in both directions - the
+same framing idiom as the launcher/param-server control plane, chosen
+over a binary header because serving payloads are token id lists, not
+flat gradient vectors.  Requests carry an ``op``; responses echo the
+request ``id`` and carry an ``event``:
+
+Client -> server::
+
+    {"op": "generate", "id": "r1", "prompt": [7, 12, 3],
+     "max_new_tokens": 16, "temperature": 0.8, "seed": 7,
+     "stream": true}
+    {"op": "generate", "text": "To be, or", ...}   # byte-vocab models
+    {"op": "ping"}
+    {"op": "stats"}
+
+Server -> client::
+
+    {"id": "r1", "event": "token", "index": 0, "token": 42}   # stream
+    {"id": "r1", "event": "done", "status": "done",
+     "tokens": [...], "token_count": 16, "latency_ms": ...,
+     "ttft_ms": ..., "queue_ms": ..., "seed": 7}
+    {"id": "r1", "event": "error", "error": "...", "shed": true}
+    {"event": "pong", "model": "char", "vocab_size": 256, ...}
+    {"event": "stats", ...engine stats...}
+
+:class:`ServingClient` is the blocking one-request-at-a-time client the
+load generator and the tests build on (concurrency = many clients, the
+server multiplexes slots across connections).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+
+
+def encode_line(obj: dict) -> bytes:
+    return (json.dumps(obj) + "\n").encode()
+
+
+def decode_line(line: str) -> dict:
+    obj = json.loads(line)
+    if not isinstance(obj, dict):
+        raise ValueError(f"protocol messages are JSON objects, got {obj!r}")
+    return obj
+
+
+def text_to_tokens(text: str) -> list[int]:
+    """UTF-8 bytes as token ids - the byte-vocab (>= 256) convention the
+    char family trains with (``data/text.py``)."""
+    return list(text.encode("utf-8"))
+
+
+def tokens_to_text(tokens: list[int]) -> str:
+    """Best-effort text rendering of byte tokens (lossless for ids
+    < 256 via latin-1; serving never round-trips through this)."""
+    return bytes(t & 0xFF for t in tokens).decode("latin-1")
+
+
+class ProtocolError(RuntimeError):
+    """The peer sent something outside the protocol."""
+
+
+class ServingClient:
+    """Blocking JSONL client: one in-flight request per connection."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 60.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout_s)
+        self._rfile = self.sock.makefile("r", encoding="utf-8")
+
+    def close(self):
+        try:
+            self._rfile.close()
+        finally:
+            self.sock.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _send(self, obj: dict):
+        self.sock.sendall(encode_line(obj))
+
+    def _recv(self) -> dict:
+        line = self._rfile.readline()
+        if not line:
+            raise ProtocolError("server closed the connection")
+        return decode_line(line)
+
+    def request(self, obj: dict) -> dict:
+        self._send(obj)
+        return self._recv()
+
+    # -- ops -----------------------------------------------------------------
+
+    def ping(self) -> dict:
+        reply = self.request({"op": "ping"})
+        if reply.get("event") != "pong":
+            raise ProtocolError(f"expected pong, got {reply}")
+        return reply
+
+    def stats(self) -> dict:
+        reply = self.request({"op": "stats"})
+        if reply.get("event") != "stats":
+            raise ProtocolError(f"expected stats, got {reply}")
+        return reply
+
+    def generate(self, prompt=None, *, text: str | None = None,
+                 max_new_tokens: int = 16, temperature: float = 0.0,
+                 seed: int | None = None, stream: bool = False,
+                 request_id: str = "0", on_token=None) -> dict:
+        """Run one generation; returns the final ``done``/``error``
+        payload.  With ``stream=True``, ``on_token(index, token)`` fires
+        per streamed token before the final payload arrives."""
+        req: dict = {
+            "op": "generate", "id": request_id,
+            "max_new_tokens": int(max_new_tokens),
+            "temperature": float(temperature), "stream": bool(stream),
+        }
+        if text is not None:
+            req["text"] = text
+        else:
+            req["prompt"] = [int(t) for t in (prompt or [])]
+        if seed is not None:
+            req["seed"] = int(seed)
+        self._send(req)
+        while True:
+            reply = self._recv()
+            event = reply.get("event")
+            if event == "token":
+                if on_token is not None:
+                    on_token(reply.get("index"), reply.get("token"))
+                continue
+            if event in ("done", "error"):
+                return reply
+            raise ProtocolError(f"unexpected event {reply}")

@@ -181,6 +181,29 @@ def load_checkpoint(path, params_template, opt_state_template):
     return params, opt_state, {"epoch": header["epoch"], "loss": header["loss"]}
 
 
+def load_model_params(path, params_template):
+    """Restore ``(params, meta)`` from ``path`` without touching the
+    optimizer section.
+
+    The serving path (``serving/``): an inference server has no
+    optimizer, and demanding the training-time ``opt_state`` template
+    just to skip those bytes would couple serving to every trainer's
+    optimizer choice.  Sections are still length+CRC verified as a
+    whole, so a corrupt optimizer section fails the load even though
+    its bytes are never deserialized - a checkpoint is either intact or
+    rejected, never half-trusted.
+    """
+    header, model_bytes, _ = _read_sections(path)
+    try:
+        params = serialization.from_bytes(params_template, model_bytes)
+    except Exception as exc:
+        raise CheckpointCorruptError(
+            f"{path}: model section verified but failed to deserialize "
+            f"into the given params template ({exc})"
+        ) from exc
+    return params, {"epoch": header["epoch"], "loss": header["loss"]}
+
+
 def checkpoint_candidates(checkpoint_dir) -> list[Path]:
     """Resume candidates under ``checkpoint_dir``, newest-first.
 

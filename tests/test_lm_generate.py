@@ -1,0 +1,174 @@
+"""Attention / MoE LM adapters: the family-agnostic bounded-buffer
+``generate(params, prompt, length, key, temperature)`` contract
+(serving satellite - char-RNN's contract extended to every family)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from pytorch_distributed_rnn_tpu.data.synthetic import generate_char_tokens
+from pytorch_distributed_rnn_tpu.models import AttentionLM, MoELM
+
+VOCAB = 48
+
+
+def models():
+    return [
+        AttentionLM(vocab_size=VOCAB, dim=32, depth=2, num_heads=4,
+                    max_len=64),
+        MoELM(vocab_size=VOCAB, embed_dim=16, hidden_dim=24, layer_dim=2,
+              num_experts=4, num_selected=2),
+        MoELM(vocab_size=VOCAB, embed_dim=16, hidden_dim=24, layer_dim=1,
+              cell="gru"),
+    ]
+
+
+@pytest.mark.parametrize("model", models(),
+                         ids=["attention", "moe-top2", "moe-gru"])
+def test_greedy_generate_matches_stepwise_apply(model):
+    """Cached/carry-threaded decode must agree with naive full
+    re-application exactly - the same ground truth the char-RNN pins."""
+    params = model.init(jax.random.PRNGKey(1))
+    prompt = jnp.asarray(
+        np.random.RandomState(0).randint(0, VOCAB, size=(3, 7)), jnp.int32)
+
+    out = model.generate(params, prompt, length=6, temperature=0.0)
+    assert out.shape == (3, 13)
+    assert bool(jnp.all(out[:, :7] == prompt))
+
+    ref = prompt
+    for _ in range(6):
+        logits = model.apply(params, ref)[:, -1, :]
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        ref = jnp.concatenate([ref, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+@pytest.mark.parametrize("model", models()[:2], ids=["attention", "moe"])
+def test_sampled_generate_is_seeded_and_in_vocab(model):
+    params = model.init(jax.random.PRNGKey(2))
+    prompt = jnp.zeros((2, 4), jnp.int32)
+    a = model.generate(params, prompt, length=8,
+                       key=jax.random.PRNGKey(7), temperature=1.0)
+    b = model.generate(params, prompt, length=8,
+                       key=jax.random.PRNGKey(7), temperature=1.0)
+    c = model.generate(params, prompt, length=8,
+                       key=jax.random.PRNGKey(8), temperature=1.0)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+    assert int(a.min()) >= 0 and int(a.max()) < VOCAB
+
+
+@pytest.mark.parametrize("model", models()[:2], ids=["attention", "moe"])
+def test_generate_rejects_bad_args(model):
+    params = model.init(jax.random.PRNGKey(0))
+    prompt = jnp.zeros((1, 2), jnp.int32)
+    with pytest.raises(ValueError):
+        model.generate(params, prompt, length=2, temperature=-1.0)
+    with pytest.raises(ValueError):
+        model.generate(params, prompt, length=2, temperature=1.0)  # no key
+    with pytest.raises(ValueError):
+        model.generate(params, jnp.zeros((1, 0), jnp.int32), length=2,
+                       temperature=0.0)
+
+
+def test_attention_generate_is_bounded_by_max_len():
+    model = AttentionLM(vocab_size=VOCAB, dim=16, depth=1, num_heads=2,
+                        max_len=16)
+    params = model.init(jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="max_len"):
+        model.generate(params, jnp.zeros((1, 10), jnp.int32), length=7,
+                       temperature=0.0)
+    # exactly at the bound is fine (the KV cache is Tp + length wide)
+    out = model.generate(params, jnp.zeros((1, 10), jnp.int32), length=6,
+                         temperature=0.0)
+    assert out.shape == (1, 16)
+
+
+def test_attention_cache_capacity_is_numerics_invariant():
+    """Decoding under a LARGER KV cache (the serving engine's max_len
+    capacity) reproduces generate()'s tight-cache tokens: padded cache
+    columns are masked to exact zeros in the softmax."""
+    from pytorch_distributed_rnn_tpu.models.attention_lm import (
+        attention_decode_step,
+        attention_prefill,
+    )
+
+    model = AttentionLM(vocab_size=VOCAB, dim=32, depth=2, num_heads=4,
+                        max_len=64)
+    params = model.init(jax.random.PRNGKey(3))
+    prompt = jnp.asarray(
+        np.random.RandomState(1).randint(0, VOCAB, size=(2, 5)), jnp.int32)
+    ref = model.generate(params, prompt, length=6, temperature=0.0)
+
+    kc, vc, logits_all = attention_prefill(
+        params, prompt, model.num_heads, cache_len=model.max_len)
+    logits = logits_all[:, -1, :]
+    pos = jnp.full((2,), 5, jnp.int32)
+    toks = []
+    for _ in range(6):
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        toks.append(tok)
+        kc, vc, logits = attention_decode_step(
+            params, kc, vc, pos, tok, model.num_heads)
+        pos = pos + 1
+    got = jnp.stack(toks, axis=1)
+    np.testing.assert_array_equal(
+        np.asarray(got), np.asarray(ref[:, 5:]))
+
+
+def test_moe_lm_loss_learns_structure():
+    model = MoELM(vocab_size=VOCAB, embed_dim=16, hidden_dim=32,
+                  layer_dim=1, num_experts=4)
+    params = model.init(jax.random.PRNGKey(0))
+    tokens = jnp.asarray(
+        generate_char_tokens(16, 32, vocab_size=VOCAB, seed=0))
+    opt = optax.adam(5e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(p, s):
+        l, g = jax.value_and_grad(model.loss)(p, tokens)
+        u, s = opt.update(g, s, p)
+        return optax.apply_updates(p, u), s, l
+
+    losses = []
+    for _ in range(60):
+        params, opt_state, l = step(params, opt_state)
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.6
+    assert losses[-1] < np.log(VOCAB) * 0.75
+
+
+def test_attention_lm_loss_learns_structure():
+    model = AttentionLM(vocab_size=VOCAB, dim=32, depth=1, num_heads=4,
+                        max_len=64)
+    params = model.init(jax.random.PRNGKey(0))
+    tokens = jnp.asarray(
+        generate_char_tokens(16, 32, vocab_size=VOCAB, seed=0))
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(p, s):
+        l, g = jax.value_and_grad(model.loss)(p, tokens)
+        u, s = opt.update(g, s, p)
+        return optax.apply_updates(p, u), s, l
+
+    losses = []
+    for _ in range(80):
+        params, opt_state, l = step(params, opt_state)
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.8
+
+
+def test_moe_lm_rejects_bad_config():
+    with pytest.raises(ValueError, match="num_selected"):
+        MoELM(num_experts=2, num_selected=3)
+
+
+def test_attention_lm_rejects_indivisible_heads():
+    with pytest.raises(ValueError, match="divisible"):
+        AttentionLM(dim=30, num_heads=4)

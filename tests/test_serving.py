@@ -1,0 +1,428 @@
+"""Serving engine: continuous-batching parity vs single-request
+``generate``, zero retraces after warm-up, batched > serial throughput,
+obs telemetry, and chaos behavior - all in-process (the socket layer has
+its own file)."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_rnn_tpu.models import AttentionLM, CharRNN, MoELM
+from pytorch_distributed_rnn_tpu.obs.recorder import MetricsRecorder
+from pytorch_distributed_rnn_tpu.obs.summary import summarize_file
+from pytorch_distributed_rnn_tpu.resilience.faults import FaultSchedule
+from pytorch_distributed_rnn_tpu.serving.adapters import adapter_for
+from pytorch_distributed_rnn_tpu.serving.buckets import BucketSpec
+from pytorch_distributed_rnn_tpu.serving.engine import ServingEngine
+from pytorch_distributed_rnn_tpu.serving.scheduler import ServeRequest
+
+VOCAB = 48
+
+
+def small_char(cell="lstm"):
+    return CharRNN(vocab_size=VOCAB, embed_dim=16, hidden_dim=24,
+                   layer_dim=2, cell=cell, impl="scan")
+
+
+def make_engine(model, **kwargs):
+    params = model.init(jax.random.PRNGKey(1))
+    defaults = dict(num_slots=4, bucket_spec=BucketSpec((8, 16)),
+                    max_new_tokens=12)
+    defaults.update(kwargs)
+    engine = ServingEngine(adapter_for(model), params, **defaults)
+    return engine, params
+
+
+def mixed_requests(model, n, rng, max_prompt=15, max_new=12):
+    requests = []
+    for i in range(n):
+        plen = int(rng.randint(1, max_prompt + 1))
+        requests.append(ServeRequest(
+            prompt=rng.randint(0, model.vocab_size, size=plen).tolist(),
+            max_new_tokens=int(rng.randint(1, max_new + 1)),
+            temperature=[0.0, 0.7, 1.0][i % 3],
+            seed=1000 + i, id=str(i),
+        ))
+    return requests
+
+
+def assert_matches_reference(model, params, requests):
+    for r in requests:
+        assert r.status == "done", (r.id, r.status, r.error)
+        ref = model.generate(
+            params, jnp.asarray([r.prompt], jnp.int32), r.max_new_tokens,
+            key=jax.random.PRNGKey(r.seed), temperature=r.temperature,
+        )
+        assert r.tokens == np.asarray(ref)[0, len(r.prompt):].tolist(), (
+            f"request {r.id} (temp {r.temperature}) diverged from its "
+            "single-request reference decode"
+        )
+
+
+# ---------------------------------------------------------------------------
+# parity: continuous batch == single-request reference decode
+
+
+@pytest.mark.parametrize("model", [
+    small_char(), small_char("gru"),
+    MoELM(vocab_size=VOCAB, embed_dim=16, hidden_dim=24, layer_dim=2,
+          num_experts=4, num_selected=2),
+    AttentionLM(vocab_size=VOCAB, dim=32, depth=2, num_heads=4, max_len=64),
+], ids=["char-lstm", "char-gru", "moe", "attention"])
+def test_mixed_stream_matches_reference_decodes(model):
+    """9 mixed-length mixed-temperature requests through 4 slots: every
+    response equals its single-request ``generate`` (greedy AND seeded
+    sampling) - requests join/leave mid-decode and never perturb their
+    batch neighbours."""
+    engine, params = make_engine(model)
+    engine.warmup()
+    requests = mixed_requests(model, 9, np.random.RandomState(0))
+    for r in requests:
+        assert engine.submit(r), r.error
+    engine.drain()
+    assert_matches_reference(model, params, requests)
+
+
+def test_staggered_joins_do_not_restart_decode():
+    """Requests submitted WHILE the batch decodes join at step
+    boundaries; earlier slots' outputs are unaffected (pinned by
+    reference parity for every request)."""
+    model = small_char()
+    engine, params = make_engine(model, num_slots=2)
+    engine.warmup()
+    first = mixed_requests(model, 2, np.random.RandomState(1))
+    for r in first:
+        engine.submit(r)
+    # a few steps with the first wave only
+    for _ in range(3):
+        engine.run_step(wait_s=0.0)
+    late = mixed_requests(model, 4, np.random.RandomState(2))
+    for i, r in enumerate(late):
+        r.id = f"late-{i}"
+        r.seed = 2000 + i
+        engine.submit(r)
+    engine.drain()
+    assert_matches_reference(model, params, first + late)
+
+
+# ---------------------------------------------------------------------------
+# zero retraces after warm-up
+
+
+def test_zero_retraces_after_warmup_on_mixed_stream():
+    model = small_char()
+    engine, params = make_engine(model)
+    engine.warmup()
+    snapshot = engine.retrace_snapshot()
+    # warm-up traced exactly one prefill per bucket + step + join
+    assert snapshot == {
+        "prefill": 2, "step": 1, "join": 1,
+    }
+    rng = np.random.RandomState(3)
+    for r in mixed_requests(model, 16, rng):
+        engine.submit(r)
+    engine.drain()
+    assert engine.retraces_since(snapshot) == {}, (
+        "steady-state serving retraced a program"
+    )
+    # the jit caches agree with the python-side trace counters
+    assert engine._prefill._cache_size() == 2
+    assert engine._step._cache_size() == 1
+    assert engine._join._cache_size() == 1
+
+
+def test_oversized_prompt_and_new_tokens_are_rejected_not_retraced():
+    model = small_char()
+    engine, _ = make_engine(model)
+    engine.warmup()
+    snapshot = engine.retrace_snapshot()
+    too_long = ServeRequest(prompt=list(range(17)), max_new_tokens=4)
+    assert not engine.submit(too_long)
+    assert too_long.status == "error"
+    assert "exceeds the largest bucket" in too_long.error
+    too_many = ServeRequest(prompt=[1], max_new_tokens=99)
+    assert not engine.submit(too_many)
+    assert "max_new_tokens" in too_many.error
+    assert engine.retraces_since(snapshot) == {}
+
+
+def test_attention_context_budget_is_validated_at_construction():
+    model = AttentionLM(vocab_size=VOCAB, dim=16, depth=1, num_heads=2,
+                        max_len=32)
+    with pytest.raises(ValueError, match="context bound"):
+        ServingEngine(adapter_for(model), model.init(jax.random.PRNGKey(0)),
+                      bucket_spec=BucketSpec((16,)), max_new_tokens=32)
+
+
+# ---------------------------------------------------------------------------
+# throughput: continuous batching beats serial one-at-a-time decode
+
+
+@pytest.mark.parametrize("slots", [8])
+def test_batched_throughput_beats_serial(slots):
+    """The same 16-request workload through 8 slots vs through ONE slot
+    (serial one-request-at-a-time decode on the same engine machinery):
+    continuous batching amortizes per-step dispatch over the whole
+    batch and must sustain measurably higher tokens/sec."""
+    model = CharRNN(vocab_size=64, embed_dim=32, hidden_dim=64,
+                    layer_dim=2, impl="scan")
+    params = model.init(jax.random.PRNGKey(5))
+    rng = np.random.RandomState(7)
+    specs = [
+        (rng.randint(0, 64, size=rng.randint(2, 16)).tolist(), 32)
+        for _ in range(16)
+    ]
+
+    def run(num_slots):
+        engine = ServingEngine(
+            adapter_for(model), params, num_slots=num_slots,
+            bucket_spec=BucketSpec((16,)), max_new_tokens=32,
+            max_queue=64,
+        )
+        engine.warmup()
+        requests = [
+            ServeRequest(prompt=p, max_new_tokens=n, temperature=0.0,
+                         id=str(i))
+            for i, (p, n) in enumerate(specs)
+        ]
+        t0 = time.perf_counter()
+        for r in requests:
+            engine.submit(r)
+        engine.drain()
+        elapsed = time.perf_counter() - t0
+        tokens = sum(len(r.tokens) for r in requests)
+        assert all(r.status == "done" for r in requests)
+        return tokens / elapsed
+
+    serial = run(1)
+    batched = run(slots)
+    assert batched > 1.3 * serial, (
+        f"continuous batching ({batched:.0f} tok/s) did not beat serial "
+        f"decode ({serial:.0f} tok/s)"
+    )
+
+
+# ---------------------------------------------------------------------------
+# telemetry through obs/
+
+
+def test_serving_telemetry_summarizes_and_exports(tmp_path):
+    model = small_char()
+    metrics = tmp_path / "serve.jsonl"
+    recorder = MetricsRecorder(metrics, sample_every=4,
+                               heartbeat_every_s=0.0)
+    engine, params = make_engine(model, recorder=recorder)
+    engine.warmup()
+    requests = mixed_requests(model, 8, np.random.RandomState(4))
+    for r in requests:
+        engine.submit(r)
+    engine.drain()
+    engine.close()
+    recorder.close()
+
+    summary = summarize_file(metrics)
+    # decode-step stats ride the standard step-event path
+    assert summary["steps"] > 0
+    assert summary["step_s_mean"] is not None
+    # request latency/TTFT/queue-depth percentiles ride run_summary
+    assert summary["requests"] == 8
+    assert summary["latency_s_p50"] > 0
+    assert summary["latency_s_p95"] >= summary["latency_s_p50"]
+    assert summary["ttft_s_p50"] > 0
+    assert summary["queue_depth_max"] >= 0
+    assert summary["tokens_per_s"] > 0
+    assert summary["duration_s"] > 0
+
+    # the CLI contract: summarize exits 0 and prints the serving block
+    from pytorch_distributed_rnn_tpu.obs.cli import main as metrics_main
+    assert metrics_main(["summarize", str(metrics)]) == 0
+
+    # timeline export validates (prefill spans + step sub-spans +
+    # request instants all nest cleanly)
+    from pytorch_distributed_rnn_tpu.obs import validate_chrome_trace
+    from pytorch_distributed_rnn_tpu.obs.timeline import write_chrome_trace
+    trace = write_chrome_trace(metrics, tmp_path / "serve.trace.json")
+    validate_chrome_trace(trace)
+    names = {e.get("name") for e in trace["traceEvents"]}
+    assert "prefill" in names and "request" in names
+
+
+def test_serving_telemetry_off_by_default_is_null():
+    model = small_char()
+    engine, _ = make_engine(model)
+    assert not engine.recorder.enabled
+    engine.warmup()
+    r = ServeRequest(prompt=[1, 2], max_new_tokens=2)
+    engine.submit(r)
+    engine.drain()
+    assert r.status == "done"
+
+
+# ---------------------------------------------------------------------------
+# chaos on the decode loop
+
+
+@pytest.mark.chaos
+def test_stall_fault_holds_the_loop_but_requests_complete(tmp_path):
+    model = small_char()
+    faults = FaultSchedule.parse("step:2:stall:0.3")
+    metrics = tmp_path / "chaos.jsonl"
+    recorder = MetricsRecorder(metrics, heartbeat_every_s=0.0)
+    engine, params = make_engine(model, faults=faults, recorder=recorder)
+    engine.warmup()
+    requests = mixed_requests(model, 4, np.random.RandomState(6))
+    t0 = time.perf_counter()
+    for r in requests:
+        engine.submit(r)
+    engine.drain()
+    elapsed = time.perf_counter() - t0
+    engine.close()
+    recorder.close()
+    assert_matches_reference(model, params, requests)
+    assert faults.fired.get("stall") == 1
+    assert elapsed >= 0.3
+    text = metrics.read_text()
+    assert '"kind": "fault"' in text
+    assert '"fault_stall"' in text  # the stall span on the timeline
+
+
+@pytest.mark.chaos
+def test_nan_fault_fails_requests_cleanly_and_service_recovers():
+    model = small_char()
+    faults = FaultSchedule.parse("step:1:nan")
+    engine, params = make_engine(model, faults=faults)
+    engine.warmup()
+    poisoned = mixed_requests(model, 2, np.random.RandomState(8))
+    for r in poisoned:
+        engine.submit(r)
+    engine.drain()
+    # in-flight requests fail loudly instead of streaming garbage
+    assert all(r.status == "error" for r in poisoned)
+    assert all("non-finite" in r.error for r in poisoned)
+    assert engine.stats()["requests_failed"] == 2
+    # the engine stays serviceable: fresh requests decode correctly
+    fresh = mixed_requests(model, 3, np.random.RandomState(9))
+    for i, r in enumerate(fresh):
+        r.id = f"fresh-{i}"
+        engine.submit(r)
+    engine.drain()
+    assert_matches_reference(model, params, fresh)
+
+
+@pytest.mark.chaos
+def test_exception_fault_is_absorbed():
+    model = small_char()
+    faults = FaultSchedule.parse("step:1:exc")
+    engine, params = make_engine(model, faults=faults)
+    engine.warmup()
+    requests = mixed_requests(model, 3, np.random.RandomState(10))
+    for r in requests:
+        engine.submit(r)
+    engine.drain()
+    assert_matches_reference(model, params, requests)
+    assert engine.stats()["chaos_absorbed"] == 1
+
+
+# ---------------------------------------------------------------------------
+# concurrency: submit from other threads while the engine loop runs
+
+
+def test_concurrent_submission_with_running_loop():
+    model = small_char()
+    engine, params = make_engine(model, num_slots=3, max_queue=64)
+    engine.warmup()
+    stop = threading.Event()
+    loop = threading.Thread(target=engine.serve_forever, args=(stop,),
+                            daemon=True)
+    loop.start()
+    rng = np.random.RandomState(11)
+    requests = mixed_requests(model, 12, rng)
+    done = threading.Event()
+    remaining = [len(requests)]
+
+    def on_done(_r):
+        remaining[0] -= 1
+        if remaining[0] == 0:
+            done.set()
+
+    for r in requests:
+        r.on_done = on_done
+        assert engine.submit(r)
+        time.sleep(0.002)
+    assert done.wait(timeout=60.0), "requests did not complete"
+    stop.set()
+    loop.join(timeout=10.0)
+    assert_matches_reference(model, params, requests)
+
+
+def test_stats_is_safe_while_the_engine_appends():
+    """stats() is called from connection threads while the engine
+    thread appends to the windowed deques - an unguarded iteration
+    raises "deque mutated during iteration" and kills the caller."""
+    model = small_char()
+    engine, params = make_engine(model, num_slots=2, max_queue=64)
+    engine.warmup()
+    stop = threading.Event()
+    loop = threading.Thread(target=engine.serve_forever, args=(stop,),
+                            daemon=True)
+    loop.start()
+    requests = mixed_requests(model, 10, np.random.RandomState(12))
+    for r in requests:
+        assert engine.submit(r)
+    deadline = time.perf_counter() + 60.0
+    while (engine.stats()["requests"] < len(requests)
+           and time.perf_counter() < deadline):
+        engine.stats()  # hammer: must never raise mid-decode
+    stop.set()
+    loop.join(timeout=10.0)
+    assert engine.stats()["requests"] == len(requests)
+
+
+def test_close_fails_in_flight_requests():
+    """Shutdown mid-decode: active-slot requests get an error event
+    (their clients must not be left waiting on a dead socket) and are
+    counted in requests_failed."""
+    model = small_char()
+    engine, params = make_engine(model, num_slots=2)
+    engine.warmup()
+    requests = mixed_requests(model, 2, np.random.RandomState(13))
+    for r in requests:
+        r.max_new_tokens = 12
+        assert engine.submit(r)
+    engine.run_step()  # both join and start decoding
+    done_events = []
+    for r in requests:
+        r.on_done = lambda req: done_events.append(req.id)
+    engine.close()
+    assert sorted(done_events) == sorted(r.id for r in requests)
+    assert all(r.status == "error" for r in requests)
+    assert all("shut down" in r.error for r in requests)
+    assert engine.stats()["requests_failed"] == len(requests)
+
+
+def test_recover_failures_count_in_requests_failed():
+    """Requests failed through the decode-loop recovery path show up in
+    stats()/run_summary requests_failed - a sidecar must never read
+    clean while requests were dropped."""
+    model = small_char()
+    engine, params = make_engine(model, num_slots=2)
+    engine.warmup()
+    requests = mixed_requests(model, 2, np.random.RandomState(14))
+    for r in requests:
+        r.max_new_tokens = 12  # nobody finishes at the first step
+        assert engine.submit(r)
+    engine.run_step()
+    engine._recover()
+    assert all(r.status == "error" for r in requests)
+    assert engine.stats()["requests_failed"] == len(requests)
+    # the engine stays serviceable after recovery
+    fresh = mixed_requests(model, 2, np.random.RandomState(15))
+    for i, r in enumerate(fresh):
+        r.id = f"fresh-{i}"
+        assert engine.submit(r)
+    engine.drain()
+    assert_matches_reference(model, params, fresh)

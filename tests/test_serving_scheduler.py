@@ -1,0 +1,199 @@
+"""Serving scheduler core as a pure unit: admission, shedding, FIFO slot
+assignment at step boundaries, starvation-freedom, bucket selection.
+No jax anywhere - this is the satellite contract that the continuous-
+batching DECISIONS are testable without a device."""
+
+import pytest
+
+from pytorch_distributed_rnn_tpu.serving.buckets import BucketSpec
+from pytorch_distributed_rnn_tpu.serving.scheduler import (
+    ContinuousBatcher,
+    ServeRequest,
+)
+
+
+def req(n_tokens=4, prompt_len=3, **kwargs):
+    return ServeRequest(
+        prompt=list(range(prompt_len)), max_new_tokens=n_tokens, **kwargs
+    )
+
+
+# ---------------------------------------------------------------------------
+# buckets
+
+
+class TestBuckets:
+    def test_bucket_for_picks_smallest_holding_bucket(self):
+        spec = BucketSpec((8, 16, 64))
+        assert spec.bucket_for(1) == 8
+        assert spec.bucket_for(8) == 8
+        assert spec.bucket_for(9) == 16
+        assert spec.bucket_for(64) == 64
+
+    def test_bucket_overflow_and_empty_are_loud(self):
+        spec = BucketSpec((8, 16))
+        with pytest.raises(ValueError, match="exceeds the largest"):
+            spec.bucket_for(17)
+        with pytest.raises(ValueError, match="at least one token"):
+            spec.bucket_for(0)
+
+    def test_pad_shapes_and_content(self):
+        spec = BucketSpec((4, 8))
+        padded = spec.pad([5, 6, 7, 8, 9])
+        assert padded.shape == (1, 8)
+        assert padded[0, :5].tolist() == [5, 6, 7, 8, 9]
+
+    def test_parse_and_validation(self):
+        assert BucketSpec.parse("4,8,32").prompt_buckets == (4, 8, 32)
+        with pytest.raises(ValueError):
+            BucketSpec.parse("8,4")  # not increasing
+        with pytest.raises(ValueError):
+            BucketSpec.parse("")
+        with pytest.raises(ValueError):
+            BucketSpec.parse("4,nope")
+        with pytest.raises(ValueError):
+            BucketSpec((0, 4))
+
+
+# ---------------------------------------------------------------------------
+# admission / shedding
+
+
+class TestAdmission:
+    def test_fifo_admission_and_seq(self):
+        batcher = ContinuousBatcher(num_slots=2, max_queue=10)
+        requests = [req(id=str(i)) for i in range(5)]
+        for r in requests:
+            assert batcher.admit(r)
+        assert [r.seq for r in requests] == [0, 1, 2, 3, 4]
+        assert batcher.queue_depth == 5
+        assert batcher.admitted == 5
+
+    def test_shed_past_max_queue_is_immediate_and_marked(self):
+        batcher = ContinuousBatcher(num_slots=1, max_queue=2)
+        # admission budget = max_queue + free slots (1 here)
+        for _ in range(3):
+            assert batcher.admit(req())
+        extra = req()
+        assert not batcher.admit(extra)
+        assert extra.status == "shed"
+        assert batcher.shed == 1
+        assert batcher.queue_depth == 3  # the shed one never queued
+
+    def test_max_queue_zero_means_direct_to_slot_not_shed_everything(self):
+        batcher = ContinuousBatcher(num_slots=2, max_queue=0)
+        assert batcher.admit(req(id="a"))
+        assert batcher.admit(req(id="b"))
+        # both free slots are spoken for; no waiting line allowed
+        assert not batcher.admit(req(id="c"))
+        batcher.take_joins()
+        assert not batcher.admit(req(id="d"))  # batch full
+        batcher.release(0)
+        assert batcher.admit(req(id="e"))  # a slot freed: direct admit
+
+    def test_constructor_validation(self):
+        with pytest.raises(ValueError):
+            ContinuousBatcher(num_slots=0)
+        with pytest.raises(ValueError):
+            ContinuousBatcher(num_slots=1, max_queue=-1)
+
+
+# ---------------------------------------------------------------------------
+# join / leave at step boundaries
+
+
+class TestSlots:
+    def test_joins_fill_free_slots_fifo_ascending(self):
+        batcher = ContinuousBatcher(num_slots=3, max_queue=10)
+        requests = [req(id=str(i)) for i in range(5)]
+        for r in requests:
+            batcher.admit(r)
+        joins = batcher.take_joins()
+        assert [(slot, r.id) for slot, r in joins] == [
+            (0, "0"), (1, "1"), (2, "2")
+        ]
+        assert all(r.status == "active" for _, r in joins)
+        assert batcher.queue_depth == 2
+        # batch full: no join happens until a release
+        assert batcher.take_joins() == []
+
+    def test_release_frees_slot_for_next_join(self):
+        batcher = ContinuousBatcher(num_slots=2, max_queue=10)
+        for i in range(4):
+            batcher.admit(req(id=str(i)))
+        batcher.take_joins()
+        released = batcher.release(1)
+        assert released.id == "1"
+        assert released.slot is None
+        joins = batcher.take_joins()
+        # slot 1 refills with the QUEUE HEAD (request 2), request 3 waits
+        assert [(slot, r.id) for slot, r in joins] == [(1, "2")]
+        assert batcher.queue_depth == 1
+
+    def test_release_unoccupied_slot_is_loud(self):
+        batcher = ContinuousBatcher(num_slots=2, max_queue=4)
+        with pytest.raises(ValueError, match="not occupied"):
+            batcher.release(0)
+
+    def test_starvation_freedom_under_full_batch(self):
+        """With the batch saturated and a deep queue, every queued
+        request is served in admission order within a bounded number of
+        release cycles - no request can be bypassed by later arrivals."""
+        batcher = ContinuousBatcher(num_slots=2, max_queue=100)
+        order = []
+        for i in range(20):
+            batcher.admit(req(id=str(i)))
+        batcher.take_joins()
+        # release one slot per "step"; later arrivals keep landing
+        next_id = 20
+        for _ in range(18):
+            batcher.admit(req(id=str(next_id)))
+            next_id += 1
+            active = batcher.active()
+            slot, oldest = min(active, key=lambda t: t[1].seq)
+            order.append(batcher.release(slot).id)
+            batcher.take_joins()
+        # service order of completions follows admission order
+        assert order == [str(i) for i in range(18)]
+        # and the queue is exactly the not-yet-served tail, in order
+        remaining = [r.id for r in batcher._pending]
+        assert remaining == sorted(remaining, key=int)
+
+    def test_has_work_and_abort_pending(self):
+        batcher = ContinuousBatcher(num_slots=1, max_queue=10)
+        assert not batcher.has_work
+        a, b = req(id="a"), req(id="b")
+        batcher.admit(a)
+        batcher.admit(b)
+        batcher.take_joins()
+        assert batcher.has_work
+        aborted = batcher.abort_pending("shutdown")
+        assert [r.id for r in aborted] == ["b"]
+        assert b.status == "error" and b.error == "shutdown"
+        assert batcher.queue_depth == 0
+        assert batcher.has_work  # 'a' still decoding
+        batcher.release(0)
+        assert not batcher.has_work
+
+
+# ---------------------------------------------------------------------------
+# request lifecycle accounting
+
+
+class TestRequestTimings:
+    def test_derived_timings(self):
+        r = req(n_tokens=2)
+        assert r.latency_s is None and r.ttft_s is None
+        r.arrival_tm = 10.0
+        r.service_tm = 10.5
+        r.first_token_tm = 11.0
+        r.done_tm = 12.0
+        assert r.queue_wait_s == pytest.approx(0.5)
+        assert r.ttft_s == pytest.approx(1.0)
+        assert r.latency_s == pytest.approx(2.0)
+
+    def test_finished_tracks_max_new_tokens(self):
+        r = req(n_tokens=2)
+        assert not r.finished
+        r.tokens.extend([1, 2])
+        assert r.finished
