@@ -25,8 +25,10 @@ from pytorch_distributed_rnn_tpu.launcher.bench import (
     run_benchmark,
     run_network_test,
 )
+from pytorch_distributed_rnn_tpu.launcher.supervisor import ElasticSupervisor
 
 __all__ = [
+    "ElasticSupervisor",
     "RunConfig",
     "command_string",
     "get_command",

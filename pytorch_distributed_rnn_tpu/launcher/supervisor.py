@@ -1,0 +1,157 @@
+"""Elastic worker supervisor: watch, respawn, rejoin.
+
+The process half of elastic membership (``resilience/membership.py``):
+the master's roster can re-admit a worker mid-run, but something has to
+notice the death and relaunch the process.  :class:`ElasticSupervisor`
+is that something for the single-machine spawn world (the fake-cluster
+pattern, SURVEY §4.2) - the local analogue of a k8s restart policy or a
+preemptible-VM instance group:
+
+- each worker slot keeps its stable **worker-id** across respawns: the
+  relaunched process star-joins the transport on the same rank and
+  REGISTERs under the same id, so the master's push-seq watermark and
+  data shard carry over;
+- a worker exiting **0** is terminal (normal completion or a SIGTERM
+  drain) - never respawned;
+- a nonzero/signal exit is a death: respawned with ``rejoin=True`` up
+  to ``max_respawns`` times per slot (exponential-free fixed delay -
+  the join protocol itself is cheap; the model rebuild dominates);
+- when a slot's respawn budget is exhausted, the supervisor keeps the
+  run alive only while at least ``min_workers`` workers remain live or
+  completed - below the floor it tears the world down instead of
+  letting the master idle out its join timeout.
+
+The supervisor is deliberately dumb about *state*: everything a rejoin
+needs to continue correctly (params, watermarks, dedupe) lives in the
+master's STATE_SYNC reply, which is what makes the kill -> respawn ->
+rejoin path drillable with the chaos actions in ``resilience/faults.py``.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class _Slot:
+    """One supervised worker slot (worker-id == launch rank)."""
+
+    worker_id: int
+    rank: int
+    process: object
+    respawns: int = 0
+    completed: bool = False
+    failed: bool = False
+    history: list = field(default_factory=list)  # exit codes observed
+
+
+class ElasticSupervisor:
+    """Watches spawned PS worker processes; respawns dead ones with the
+    same worker-id so they rejoin via REGISTER."""
+
+    def __init__(self, spawn_worker, *, min_workers: int = 1,
+                 max_respawns: int = 3, respawn_delay_s: float = 0.1,
+                 poll_s: float = 0.05):
+        """``spawn_worker(rank, worker_id, rejoin) -> process`` launches
+        one worker process (``process`` needs ``is_alive()``,
+        ``exitcode`` and ``terminate()``/``join()``)."""
+        self._spawn_worker = spawn_worker
+        self.min_workers = int(min_workers)
+        self.max_respawns = int(max_respawns)
+        self.respawn_delay_s = float(respawn_delay_s)
+        self.poll_s = float(poll_s)
+        self.slots: dict[int, _Slot] = {}
+        self.total_respawns = 0
+
+    def launch(self, ranks) -> None:
+        """Spawn the initial worker set (worker-id == launch rank)."""
+        for rank in ranks:
+            proc = self._spawn_worker(rank, rank, False)
+            self.slots[rank] = _Slot(worker_id=rank, rank=rank,
+                                     process=proc)
+
+    # -- monitoring ----------------------------------------------------------
+
+    def _live_or_completed(self) -> int:
+        return sum(
+            1 for s in self.slots.values()
+            if s.completed or (not s.failed and s.process.is_alive())
+        )
+
+    def poll(self) -> bool:
+        """One supervision pass: reap exits, respawn deaths.  Returns
+        False when the worker pool has fallen below ``min_workers`` with
+        no respawn budget left (the caller should tear down)."""
+        for slot in self.slots.values():
+            if slot.completed or slot.failed or slot.process.is_alive():
+                continue
+            code = slot.process.exitcode
+            slot.history.append(code)
+            if code == 0:
+                # normal completion OR a SIGTERM drain: both are
+                # voluntary exits the roster already accounted for
+                slot.completed = True
+                log.info(
+                    f"supervisor: worker-id {slot.worker_id} exited 0 "
+                    f"(terminal)"
+                )
+                continue
+            if slot.respawns >= self.max_respawns:
+                slot.failed = True
+                log.error(
+                    f"supervisor: worker-id {slot.worker_id} died "
+                    f"(exit {code}) with no respawn budget left "
+                    f"({self.max_respawns} used)"
+                )
+                continue
+            slot.respawns += 1
+            self.total_respawns += 1
+            log.warning(
+                f"supervisor: worker-id {slot.worker_id} died "
+                f"(exit {code}); respawning into rank {slot.rank} "
+                f"(respawn {slot.respawns}/{self.max_respawns})"
+            )
+            time.sleep(self.respawn_delay_s)
+            slot.process = self._spawn_worker(
+                slot.rank, slot.worker_id, True
+            )
+        return self._live_or_completed() >= self.min_workers
+
+    def supervise(self, until_exit) -> bool:
+        """Supervision loop: poll until ``until_exit()`` returns an exit
+        code (the master process finishing) or the pool collapses below
+        the floor.  Returns True while healthy, False on collapse."""
+        while until_exit() is None:
+            if not self.poll():
+                return False
+            time.sleep(self.poll_s)
+        return True
+
+    def shutdown(self, timeout_s: float = 10.0) -> None:
+        """Terminate whatever is still running, reap everything, and
+        settle the final per-slot verdicts - without respawning (the
+        run is over)."""
+        for slot in self.slots.values():
+            if slot.process.is_alive():
+                slot.process.terminate()
+        deadline = time.monotonic() + timeout_s
+        for slot in self.slots.values():
+            slot.process.join(timeout=max(0.1, deadline - time.monotonic()))
+            if not slot.completed and not slot.failed \
+                    and not slot.process.is_alive():
+                slot.history.append(slot.process.exitcode)
+                slot.completed = slot.process.exitcode == 0
+                slot.failed = not slot.completed
+
+    def verdict(self) -> dict:
+        """Supervision outcome for logs/telemetry."""
+        return {
+            "workers": len(self.slots),
+            "completed": sum(1 for s in self.slots.values() if s.completed),
+            "failed": sum(1 for s in self.slots.values() if s.failed),
+            "respawns": self.total_respawns,
+        }

@@ -55,6 +55,12 @@ _SUMMARY_FIELDS = (
     ("ps_exchanges", "{:d}"),
     ("ps_retries", "{:d}"),
     ("ps_degraded_rounds", "{:d}"),
+    # elastic membership (None and skipped on non-elastic runs)
+    ("member_joins", "{:d}"),
+    ("member_rejoins", "{:d}"),
+    ("member_drains", "{:d}"),
+    ("member_deaths", "{:d}"),
+    ("roster", "{}"),
     ("checkpoint_saves", "{:d}"),
     # serving runs (absent on training sidecars - skipped when None)
     ("requests", "{:d}"),
@@ -141,7 +147,9 @@ def main(argv=None) -> int:
     p = sub.add_parser(
         "health",
         help="liveness check: flag ranks whose telemetry went stale "
-        "(dead) or whose heartbeats continue without progress (stalled)",
+        "(dead) or whose heartbeats continue without progress (stalled); "
+        "a rank that DEREGISTERed (member_drain - the SIGTERM drain "
+        "path) is 'drained' and healthy, not dead",
     )
     p.add_argument("files", nargs="+")
     p.add_argument("--stale-after", type=float, default=30.0, metavar="S",

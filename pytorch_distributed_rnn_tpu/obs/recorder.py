@@ -54,11 +54,27 @@ heartbeat           seq, progress (last step noted via note_progress) -
 ps_exchange         what (push|pull), step, seconds, retries
 ps_round            updates, gathered, expected, degraded
 ps_worker_dead      worker, error
-ps_summary          updates, degraded_rounds, workers_lost
+ps_summary          updates, degraded_rounds, workers_lost, rejoins
+member_join         worker_id, rank_slot, incarnation, via, rejoin +
+                    roster counts - a member (re)entered the elastic
+                    world (resilience/membership.py)
+member_drain        worker_id, rank_slot, seq + roster counts -
+                    voluntary leave (SIGTERM drain / DEREGISTER);
+                    pdrnn-metrics health classifies the rank drained,
+                    not dead
+member_dead         worker_id, rank_slot, error + roster counts -
+                    involuntary loss (transport death), rejoinable via
+                    REGISTER
+checkpoint_fallback path, reason, chosen - a corrupt checkpoint was
+                    skipped during --resume auto and resume fell back
 profile             dir, start, stop, captured
 run_summary         memory_mb, duration_s, device_peaks_mb, steps,
-                    nan_skipped, faults_fired
+                    nan_skipped, faults_fired; the PS master's variant
+                    carries roster counts + rejoins + degraded_rounds
 =================== =======================================================
+
+Span names on the ``member`` lane: ``state_sync`` (REGISTER -> params
+adoption, emitted by both master and the joining worker).
 """
 
 from __future__ import annotations

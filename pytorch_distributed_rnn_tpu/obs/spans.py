@@ -47,6 +47,9 @@ SUBSYSTEM_TIDS = {
     "resilience": 7,
     "sys": 8,
     "serving": 9,  # inference-server spans (prefill, serve-loop phases)
+    # elastic membership lane: member_join/drain/dead instants and
+    # state_sync spans (resilience/membership.py roster transitions)
+    "member": 10,
 }
 
 

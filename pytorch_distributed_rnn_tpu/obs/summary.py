@@ -212,6 +212,31 @@ def summarize_events(events: list[dict], path=None) -> dict:
             )
         ),
     }
+    # elastic membership (resilience/membership.py): transition counts
+    # off this rank's stream - the master's sidecar carries the whole
+    # roster story, workers their own join/drain.  None (not 0) on
+    # non-elastic runs so the text summary stays noise-free.
+    member_counts = {
+        "member_joins": sum(
+            1 for e in events if e["kind"] == "member_join"
+        ),
+        "member_rejoins": sum(
+            1 for e in events
+            if e["kind"] == "member_join" and e.get("rejoin")
+        ),
+        "member_drains": sum(
+            1 for e in events if e["kind"] == "member_drain"
+        ),
+        "member_deaths": sum(
+            1 for e in events if e["kind"] == "member_dead"
+        ),
+    }
+    if any(member_counts.values()):
+        summary.update(member_counts)
+    else:
+        summary.update(dict.fromkeys(member_counts))
+    if run and run.get("roster") is not None:
+        summary["roster"] = run["roster"]
     if run:
         for key in SERVING_SUMMARY_KEYS:
             if key in run:
@@ -275,9 +300,14 @@ def rank_health(events: list[dict], now: float | None = None,
     heartbeats keep this fresh as long as the process lives), the last
     *progress* (any non-heartbeat event, or a heartbeat whose noted
     ``progress`` step advanced), and whether the run finished (a
-    ``run_summary`` landed).  Status:
+    ``run_summary`` landed) or the rank left voluntarily (a
+    ``member_drain`` landed - the DEREGISTER half of preemption-aware
+    drain).  Status:
 
     - ``finished`` - run_summary present (age is irrelevant);
+    - ``drained``  - the rank deregistered on purpose (SIGTERM drain):
+      its stream going stale afterwards is the EXPECTED shape of a
+      voluntary leave, not a death - healthy, exit 0;
     - ``dead``     - nothing at all for ``stale_after`` seconds: the
       process stopped flushing (killed, wedged below Python);
     - ``stalled``  - heartbeats fresh but no progress for
@@ -289,7 +319,16 @@ def rank_health(events: list[dict], now: float | None = None,
         import time
 
         now = time.time()
+    rank = int(events[0].get("rank", 0))
     finished = any(e["kind"] == "run_summary" for e in events)
+    # only a drain of THIS rank counts: the master's sidecar carries
+    # member_drain events for its WORKERS (rank_slot != 0) and must not
+    # classify the master itself as drained mid-run
+    drained = any(
+        e["kind"] == "member_drain"
+        and int(e.get("rank_slot", e["rank"])) == rank
+        for e in events
+    )
     last_t = max(float(e["t"]) for e in events)
     progress_ts = [
         float(e["t"]) for e in events
@@ -306,6 +345,8 @@ def rank_health(events: list[dict], now: float | None = None,
     )
     if finished:
         status = "finished"
+    elif drained:
+        status = "drained"
     elif now - last_t > stale_after:
         status = "dead"
     elif now - last_progress_t > stale_after:
@@ -313,11 +354,12 @@ def rank_health(events: list[dict], now: float | None = None,
     else:
         status = "ok"
     return {
-        "rank": int(events[0].get("rank", 0)),
+        "rank": rank,
         "status": status,
         "last_event_age_s": now - last_t,
         "last_progress_age_s": now - last_progress_t,
         "finished": finished,
+        "drained": drained,
     }
 
 

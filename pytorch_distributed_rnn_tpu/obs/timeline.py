@@ -52,9 +52,9 @@ from pytorch_distributed_rnn_tpu.obs.summary import (
 _US = 1_000_000.0
 
 # event kinds rendered as instants (everything not a span / synthesized
-# span / skipped meta); faults are process-scoped so they flash across
-# the whole rank row in Perfetto
-_INSTANT_PROCESS_SCOPE = {"fault", "ps_worker_dead"}
+# span / skipped meta); faults and member deaths are process-scoped so
+# they flash across the whole rank row in Perfetto
+_INSTANT_PROCESS_SCOPE = {"fault", "ps_worker_dead", "member_dead"}
 
 
 def load_run(path) -> dict[int, list[dict]]:
@@ -357,10 +357,15 @@ def build_chrome_trace(by_rank: dict[int, list[dict]],
                 scope = "p" if kind in _INSTANT_PROCESS_SCOPE else "t"
                 cat = {
                     "fault": "resilience", "nan_skip": "resilience",
+                    "checkpoint_fallback": "ckpt",
                     "heartbeat": "sys", "collectives": "sys",
                     "profile": "sys", "eval": "eval",
                     "ps_round": "ps", "ps_summary": "ps",
                     "ps_worker_dead": "ps",
+                    # the membership lane: roster transitions as instants
+                    # (state_sync rides in as a span with cat=member)
+                    "member_join": "member", "member_drain": "member",
+                    "member_dead": "member",
                 }.get(kind, "sys")
                 tb.instant(rank, cat, kind, w, _args(e), scope)
 

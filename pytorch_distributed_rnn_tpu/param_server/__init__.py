@@ -47,7 +47,57 @@ def add_sub_command(sub_parser):
     parser.add_argument(
         "--ps-transport-retries", type=int, default=3, metavar="N",
         help="worker-side retries (exponential backoff + jitter) for a "
-        "failed push/pull exchange before giving up",
+        "failed push/pull exchange before giving up; the whole retry "
+        "storm is additionally wall-clock-capped at --ps-sync-timeout "
+        "so it can never outlive the round it is retrying into",
+    )
+    parser.add_argument(
+        "--elastic", action="store_true",
+        help="elastic membership: the master accepts REGISTER (re)joins "
+        "mid-run on the rendezvous listener, and (in spawn mode) a "
+        "supervisor respawns dead workers with the same WORKER-ID - the "
+        "stable membership identity, decoupled from the transport RANK "
+        "(the socket slot a respawn plugs back into).  A rejoiner "
+        "receives a STATE_SYNC (current params + its push-seq "
+        "watermark) and enters the next sync round",
+    )
+    parser.add_argument(
+        "--min-workers", type=int, default=1, metavar="N",
+        help="elastic spawn mode: the supervisor keeps the run alive "
+        "while at least N workers are live or completed; below the "
+        "floor (respawn budgets exhausted) it tears the world down",
+    )
+    parser.add_argument(
+        "--ps-max-respawns", type=int, default=3, metavar="N",
+        help="elastic spawn mode: respawn budget per worker slot",
+    )
+    parser.add_argument(
+        "--ps-join-timeout", type=float, default=60.0, metavar="SECONDS",
+        help="elastic: how long the master holds a dead member on the "
+        "roster awaiting its REGISTER rejoin before abandoning it "
+        "(an abandoned loss is what counts against --ps-quorum)",
+    )
+    parser.add_argument(
+        "--ps-rejoin", action="store_true",
+        help="multi-node rank mode: (re)enter a running --elastic world "
+        "- star-join the transport at --rank and REGISTER instead of "
+        "the initial rendezvous (the manual analogue of the spawn-mode "
+        "supervisor's respawn)",
+    )
+    parser.add_argument(
+        "--ps-worker-id", type=int, default=None, metavar="ID",
+        help="with --ps-rejoin: the stable worker-id to register under "
+        "(default: the transport rank).  The id keys the data shard, "
+        "dropout stream and push-seq watermark; the rank is just the "
+        "socket slot",
+    )
+    parser.add_argument(
+        "--ps-checkpoint-rounds", type=int, default=0, metavar="N",
+        help="master: write a crash-safe checkpoint of the "
+        "authoritative params + optimizer state to "
+        "--checkpoint-directory every N applied updates (and once at "
+        "the end); with --resume auto a restarted master bootstraps "
+        "from the newest valid one.  0 disables",
     )
     parser.set_defaults(func=execute)
 

@@ -1,4 +1,4 @@
-"""Parameter-server master: owns parameters and optimizer state.
+"""Parameter-server master: owns parameters, optimizer state, membership.
 
 Capability parity with the reference master
 (``/root/reference/src/motion/param_server/master.py:15-59``): a single
@@ -20,6 +20,16 @@ single update (DDP-equivalent math, useful for equivalence tests).
 The reference's in-run assertion that gradients actually arrived
 (``worker.py:55-58``) maps to the finite-gradient check before every
 update.
+
+Membership is a live object (``resilience/membership.py``): every worker
+is a rostered member with a stable worker-id decoupled from its
+transport rank.  ``elastic=True`` additionally runs an acceptor on the
+rendezvous listener so a new or respawned worker can (re)join mid-run
+via the REGISTER op - it receives a STATE_SYNC (current params + the
+master's update count + its own push-seq watermark) and enters the next
+sync round; the inverse of :meth:`_mark_dead`.  A SIGTERM-drained
+worker leaves via DEREGISTER: the roster shrinks *voluntarily*, without
+burning the quorum budget.
 """
 
 from __future__ import annotations
@@ -32,6 +42,7 @@ import time
 import numpy as np
 
 from pytorch_distributed_rnn_tpu.param_server import protocol
+from pytorch_distributed_rnn_tpu.resilience import membership
 
 log = logging.getLogger(__name__)
 
@@ -39,7 +50,9 @@ log = logging.getLogger(__name__)
 class ParameterServerMaster:
     def __init__(self, comm, flat_params: np.ndarray, apply_update,
                  sync_mode=False, sync_timeout: float = 300.0,
-                 quorum: float = 1.0, recorder=None):
+                 quorum: float = 1.0, recorder=None,
+                 elastic: bool = False, join_timeout: float = 60.0,
+                 max_world: int | None = None):
         """``apply_update(flat_grads) -> flat_params`` advances the owned
         state by one optimizer step and returns the new flat params.
         ``sync_timeout`` bounds how long a sync-mode round waits for
@@ -53,12 +66,21 @@ class ParameterServerMaster:
         arrived, apply, release the waiters - so a preempted worker slows
         the world instead of killing it (the Podracer/pjit preemptible-
         worker baseline).  A straggler's late gradient joins the next
-        round as an ordinary (stale) contribution."""
+        round as an ordinary (stale) contribution.
+
+        ``elastic`` accepts REGISTER (re)joins mid-run on the rendezvous
+        listener: a dead worker is held on the roster for
+        ``join_timeout`` seconds awaiting its respawn before being
+        abandoned; worker deaths are tolerated (pending rejoin) even at
+        quorum 1.0, and the final verdict only fails when an abandoned
+        loss leaves fewer than the quorum's worth of successful
+        (done/drained) workers.  ``max_world`` caps the transport rank
+        slots reserved for brand-new joiners (default: world + 8)."""
         if not 0.0 < quorum <= 1.0:
             raise ValueError(f"quorum must be in (0, 1], got {quorum}")
         # structured telemetry (obs/recorder.py): degraded rounds, dead
-        # workers and the serve() summary become events the CLI can
-        # summarize - quorum degradations were previously log-only
+        # workers, membership transitions and the serve() summary become
+        # events the CLI can summarize
         from pytorch_distributed_rnn_tpu.obs.recorder import NULL_RECORDER
 
         self.recorder = recorder if recorder is not None else NULL_RECORDER
@@ -68,10 +90,24 @@ class ParameterServerMaster:
         self.sync_mode = sync_mode
         self.sync_timeout = float(sync_timeout)
         self.quorum = float(quorum)
+        self.elastic = bool(elastic)
+        self.join_timeout = float(join_timeout)
+        self.max_world = max_world
         self.lock = threading.Lock()
         self.num_params = int(flat_params.size)
         self.updates_applied = 0
         self.degraded_rounds = 0
+        # the live membership table: launch-time workers are bootstrapped
+        # with worker-id == initial rank; later joins/respawns go through
+        # REGISTER.  Push-seq watermarks live on the members, so dedupe
+        # survives a worker's respawn (the double-count guard).
+        self.roster = membership.Roster(recorder=self.recorder)
+        # a fixed world's launch set is not membership telemetry: only
+        # elastic runs emit bootstrap member_join events (summarize/
+        # health report membership as absent on non-elastic sidecars)
+        self.roster.bootstrap(
+            range(1, self.comm.world_size), quiet=not self.elastic
+        )
         # sync-mode rendezvous state
         self._pending: dict[int, np.ndarray] = {}
         self._sync_cv = threading.Condition(self.lock)
@@ -85,74 +121,172 @@ class ParameterServerMaster:
         # a retried push shifts the ordinals.
         self._round_tm0: float | None = None
         self._round_seqs: dict[int, int] = {}
-        # workers whose transport died (quorum mode tolerates them):
-        # excluded from later rounds so the world shrinks instead of
-        # timing out on a corpse every round
-        self._dead: set[int] = set()
+        # elastic bookkeeping: per-rank service-thread generation (a
+        # stale thread dying after its rank was re-accepted must not
+        # mark the NEW incarnation dead), and the tolerated-death table
+        # a successful rejoin clears.  _gen_lock makes the stale check
+        # atomic against the acceptor's bump: a thread that passes it
+        # holds the lock through its _mark_dead, so the mark always
+        # lands BEFORE the replacement thread exists (and thus before
+        # the new incarnation can REGISTER), never after.
+        self._thread_gen: dict[int, int] = {}
+        self._gen_lock = threading.Lock()
+        self._tolerated: dict[int, BaseException] = {}
+        self._member_cv = threading.Condition()
 
     def serve(self):
-        """Block until every worker sends DONE.  A failure in a worker's
-        service thread (socket error, integrity assertion) is re-raised
-        here so the master process reports failure instead of silently
-        finishing on a reduced worker set - EXCEPT in quorum-degraded
-        sync mode (``quorum < 1``), where a dying worker is marked dead,
-        dropped from later rounds, and only a quorum-breaking loss of
-        workers is fatal (the preemptible-worker contract)."""
+        """Block until the roster reaches a terminal state: every member
+        done (DONE) or drained (DEREGISTER), with no dead member still
+        inside its rejoin window.  A failure in a worker's service
+        thread (socket error, integrity assertion) is re-raised here so
+        the master process reports failure instead of silently finishing
+        on a reduced worker set - EXCEPT when deaths are tolerated
+        (quorum-degraded sync mode, or any elastic world, where a dying
+        worker is marked dead, dropped from later rounds, and awaited
+        for rejoin); only a quorum-breaking abandoned loss is fatal."""
         num_workers = self.comm.world_size - 1
+        serve_tm0 = time.perf_counter()
         errors: dict[int, BaseException] = {}
-        tolerated: dict[int, BaseException] = {}
+        tolerate = self.elastic or (self.sync_mode and self.quorum < 1.0)
+        stop_accept = threading.Event()
 
-        def guarded(worker):
+        def guarded(worker, gen):
             try:
-                self._serve_worker(worker)
+                self._serve_worker(worker, gen=gen)
             except BaseException as exc:  # noqa: BLE001 - propagated below
-                if self.sync_mode and self.quorum < 1.0:
-                    tolerated[worker] = exc
-                    self._mark_dead(worker, exc)
-                else:
-                    errors[worker] = exc
+                with self._gen_lock:
+                    if self._thread_gen.get(worker) != gen:
+                        # a newer incarnation owns this rank already (the
+                        # respawn raced this thread's death detection)
+                        log.info(
+                            f"stale service thread for rank {worker} "
+                            f"exited ({type(exc).__name__}); rank re-owned"
+                        )
+                    elif tolerate:
+                        self._tolerated[worker] = exc
+                        self._mark_dead(worker, exc)
+                    else:
+                        errors[worker] = exc
+            finally:
+                with self._member_cv:
+                    self._member_cv.notify_all()
 
-        threads = [
-            threading.Thread(target=guarded, args=(w,))
-            for w in range(1, self.comm.world_size)
-        ]
-        for t in threads:
+        def spawn(worker):
+            with self._gen_lock:
+                gen = self._thread_gen.get(worker, 0) + 1
+                self._thread_gen[worker] = gen
+            t = threading.Thread(
+                target=guarded, args=(worker, gen), daemon=True
+            )
             t.start()
-        for t in threads:
-            t.join()
+            return t
+
+        if self.elastic and hasattr(self.comm, "reserve"):
+            # BEFORE any service thread: the reserve reallocates the
+            # peer table, which must not race in-flight send/recv
+            self.comm.reserve(
+                self.max_world or self.comm.world_size + 8
+            )
+        threads = [spawn(w) for w in range(1, self.comm.world_size)]
+
+        acceptor = None
+        if self.elastic and hasattr(self.comm, "accept_peer"):
+            def accept_loop():
+                while not stop_accept.is_set():
+                    rank = self.comm.accept_peer(timeout_s=0.25)
+                    if rank is not None:
+                        log.info(
+                            f"elastic accept: rank {rank} connected; "
+                            "awaiting REGISTER"
+                        )
+                        threads.append(spawn(rank))
+
+            acceptor = threading.Thread(target=accept_loop, daemon=True)
+            acceptor.start()
+
+        if not self.elastic:
+            for t in threads:
+                t.join()
+        else:
+            self._await_membership_terminal(errors)
+            stop_accept.set()
+            if acceptor is not None:
+                acceptor.join(timeout=5.0)
+            for t in list(threads):
+                t.join(timeout=5.0)
+
         if errors:
             worker, exc = next(iter(errors.items()))
             raise RuntimeError(
                 f"parameter-server worker thread(s) failed: "
                 f"{sorted(errors)} (first: worker {worker})"
             ) from exc
-        survivors = num_workers - len(tolerated)
-        if tolerated and survivors < self._quorum_count(num_workers):
-            worker, exc = next(iter(tolerated.items()))
+        members = self.roster.members()
+        lost = [m for m in members if m.state == membership.DEAD]
+        survivors = sum(
+            1 for m in members
+            if m.state in (membership.DONE, membership.DRAINED)
+        )
+        if lost and survivors < self._quorum_count(num_workers):
+            exc = self._tolerated.get(lost[0].rank)
             raise RuntimeError(
-                f"parameter server lost quorum: {sorted(tolerated)} "
-                f"worker(s) died, {survivors} survivor(s) < quorum "
+                f"parameter server lost quorum: "
+                f"{sorted(m.rank for m in lost)} worker(s) "
+                f"{'abandoned (rejoin window expired)' if self.elastic else 'died'}, "
+                f"{survivors} survivor(s) < quorum "
                 f"{self._quorum_count(num_workers)}"
             ) from exc
+        counts = self.roster.counts()
         log.info(
-            f"parameter server done: {self.updates_applied} updates applied"
-            + (f", {self.degraded_rounds} degraded round(s), "
-               f"{len(tolerated)} worker(s) lost" if tolerated
-               or self.degraded_rounds else "")
+            f"parameter server done: {self.updates_applied} updates "
+            f"applied, roster {counts}"
+            + (f", {self.degraded_rounds} degraded round(s)"
+               if self.degraded_rounds else "")
+            + (f", {self.roster.rejoins} rejoin(s)"
+               if self.roster.rejoins else "")
         )
         self.recorder.record(
             "ps_summary", updates=self.updates_applied,
             degraded_rounds=self.degraded_rounds,
-            workers_lost=len(tolerated),
+            workers_lost=len(lost), rejoins=self.roster.rejoins,
+        )
+        # the run_summary carries the roster verdict so `pdrnn-metrics
+        # summarize`/`health` read membership off the master's sidecar
+        # like any other run outcome
+        self.recorder.record(
+            "run_summary",
+            duration_s=time.perf_counter() - serve_tm0,
+            steps=self.updates_applied,
+            roster=counts, rejoins=self.roster.rejoins,
+            degraded_rounds=self.degraded_rounds,
         )
         self.recorder.flush()
         return self.params
 
+    def _await_membership_terminal(self, errors):
+        """Elastic completion wait: the run is over when no member is
+        still joined and every dead member's rejoin window has expired
+        (a rejoin re-enters ``joined`` and keeps the run alive)."""
+        while not errors:
+            members = self.roster.members()
+            now = time.perf_counter()
+            joined = [m for m in members if m.state == membership.JOINED]
+            awaiting = [
+                m for m in members
+                if m.state == membership.DEAD and m.died_tm is not None
+                and now - m.died_tm < self.join_timeout
+            ]
+            if not joined and not awaiting:
+                return
+            with self._member_cv:
+                self._member_cv.wait(timeout=0.2)
+
     def _mark_dead(self, worker: int, exc: BaseException):
-        """Quorum mode: drop a dead worker from the rendezvous so later
-        rounds close over the survivors instead of timing out on a
+        """Involuntary loss: drop a dead worker from the rendezvous so
+        later rounds close over the survivors instead of timing out on a
         corpse; if the in-flight round now has every live worker's
-        gradient, close it here."""
+        gradient, close it here.  The member stays rostered as ``dead``
+        so an elastic respawn can re-enter - only via REGISTER."""
         log.warning(
             f"worker {worker} dropped from the sync rendezvous "
             f"({type(exc).__name__}: {exc}); degrading to survivors"
@@ -161,32 +295,81 @@ class ParameterServerMaster:
             "ps_worker_dead", worker=worker,
             error=f"{type(exc).__name__}: {str(exc)[:200]}",
         )
+        self.roster.mark_dead(
+            worker, error=f"{type(exc).__name__}: {str(exc)[:200]}"
+        )
+        self._rendezvous_leave(worker)
+
+    def _rendezvous_leave(self, worker: int):
+        """A member left the round rendezvous (death or drain): discard
+        its in-flight contribution and close the round if the survivors
+        now cover it.  The roster transition must already have happened
+        (``round_ranks`` excludes the leaver)."""
         with self._sync_cv:
-            self._dead.add(worker)
             self._pending.pop(worker, None)
             self._round_seqs.pop(worker, None)
             self._waiting.discard(worker)
-            live = self.comm.world_size - 1 - len(self._dead)
+            live = len(self.roster.round_ranks())
             if self._pending and len(self._pending) >= max(1, live):
                 self._close_round()
 
-    def _serve_worker(self, worker: int):
-        last_push_seq = None
+    def _serve_worker(self, worker: int, gen: int | None = None):
         while True:
+            if gen is not None and self._thread_gen.get(worker) != gen:
+                # the rank's socket slot was re-accepted while this
+                # thread was processing a request: the NEW fd belongs to
+                # the replacement thread - exit instead of racing it on
+                # the wire framing
+                return
             opcode, grads, seq = protocol.recv_request(
                 self.comm, worker, self.num_params
             )
             if opcode == protocol.OP_DONE:
+                self.roster.complete(worker)
+                return
+            if opcode == protocol.OP_REGISTER:
+                self._register_worker(worker, worker_id=seq or worker)
+                continue
+            if opcode == protocol.OP_DEREGISTER:
+                # voluntary leave (preemption-aware drain): exits the
+                # rendezvous and the quorum denominator without burning
+                # the quorum budget - and exits this thread cleanly
+                self.roster.drain(worker, seq=seq)
+                self._rendezvous_leave(worker)
                 return
             if opcode == protocol.OP_PULL:
                 with self.lock:
                     protocol.send_params(self.comm, worker, self.params)
                 continue
             # OP_PUSH
-            if seq == last_push_seq:
-                # a retried push whose ORIGINAL made it through but whose
-                # reply leg failed (resilience/retry.py retries the whole
-                # exchange): the gradient is already in an update - do
+            member = self.roster.member_for_rank(worker)
+            if member is None and self.elastic:
+                # a star-joined rank pushing without REGISTER: unrostered
+                # gradients must never be averaged in (and its _pending
+                # entry could close a round early against a rendezvous
+                # that does not count it) - entry is join-protocol-only
+                raise RuntimeError(
+                    f"push from unrostered rank {worker} without "
+                    "REGISTER; elastic-world entry requires the join "
+                    "protocol"
+                )
+            if member is not None and member.state == membership.DEAD:
+                # a rank marked dead whose transport recovered: it must
+                # re-enter via REGISTER (state sync + watermarks), never
+                # by silently reappearing - applying its stale stream
+                # here could double-count against its respawn's
+                raise RuntimeError(
+                    f"push from dead member (worker-id "
+                    f"{member.worker_id}, rank {worker}) without "
+                    "REGISTER; membership re-entry requires the join "
+                    "protocol"
+                )
+            if not self.roster.note_push(worker, seq):
+                # at-or-below the member's push-seq watermark: a retried
+                # push whose ORIGINAL made it through but whose reply leg
+                # failed (resilience/retry.py retries the whole
+                # exchange), or a rejoined worker's stale in-flight push.
+                # Either way the gradient is already accounted for - do
                 # not average it in twice, just resend current params
                 log.warning(
                     f"worker {worker} re-sent push seq {seq}; replying "
@@ -195,7 +378,6 @@ class ParameterServerMaster:
                 with self.lock:
                     protocol.send_params(self.comm, worker, self.params)
                 continue
-            last_push_seq = seq
             assert grads is not None and grads.size == self.num_params, (
                 f"worker {worker} pushed a malformed gradient"
             )
@@ -223,12 +405,44 @@ class ParameterServerMaster:
                             seq=seq, mode="async",
                         )
 
+    def _register_worker(self, worker: int, worker_id: int):
+        """The join protocol's master half: roster the (re)join, then
+        reply with a STATE_SYNC - current params, the master's update
+        count, and the member's push-seq watermark, so the joiner adopts
+        authoritative state and numbers its pushes above everything
+        already applied."""
+        t0 = time.perf_counter()
+        member = self.roster.join(worker_id, worker)
+        self._tolerated.pop(worker, None)
+        with self.lock:
+            step_watermark = self.updates_applied
+            seq_watermark = member.push_seq
+            protocol.send_state_sync(
+                self.comm, worker, self.params, step_watermark,
+                seq_watermark,
+            )
+        log.info(
+            f"state sync: worker-id {worker_id} (rank {worker}, "
+            f"incarnation {member.incarnation}) <- {self.num_params} "
+            f"params @ update {step_watermark}, push-seq watermark "
+            f"{seq_watermark}"
+        )
+        if self.recorder.enabled:
+            self.recorder.emit_span(
+                "state_sync", t0, time.perf_counter() - t0, cat="member",
+                worker_id=worker_id, rank_slot=worker,
+                incarnation=member.incarnation, step=step_watermark,
+                seq=seq_watermark,
+            )
+        with self._member_cv:
+            self._member_cv.notify_all()
+
     def _close_round(self, degraded: bool = False):
         """Average the gathered gradients, apply ONE update, reply to
         every worker owed fresh params, wake the waiters.  Caller holds
         the lock."""
         gathered = len(self._pending)
-        expected = self.comm.world_size - 1 - len(self._dead)
+        expected = len(self.roster.round_ranks())
         tm0 = self._round_tm0
         self._round_tm0 = None
         seqs = {str(w): s for w, s in self._round_seqs.items()
@@ -254,7 +468,7 @@ class ParameterServerMaster:
             try:
                 protocol.send_params(self.comm, w, self.params)
             except Exception as exc:
-                if self.quorum >= 1.0:
+                if self.quorum >= 1.0 and not self.elastic:
                     raise
                 # a worker that died between push and reply: its service
                 # thread will also fail and _mark_dead it; do not let the
@@ -273,13 +487,16 @@ class ParameterServerMaster:
 
     def _push_sync(self, worker: int, grads: np.ndarray,
                    seq: int | None = None):
-        """Gather one gradient per worker, average, apply once, release.
+        """Gather one gradient per live synced worker, average, apply
+        once, release.
 
         On straggler timeout the round degrades to the configured quorum
         (``quorum < 1`` and enough gradients arrived) or fails loudly
-        (strict mode, or not even a quorum delivered)."""
+        (strict mode, or not even a quorum delivered).  A member that
+        (re)joined mid-round is not expected until its first push lands
+        - it enters the NEXT round."""
         with self._sync_cv:
-            num_workers = self.comm.world_size - 1 - len(self._dead)
+            num_workers = max(1, len(self.roster.round_ranks()))
             if not self._pending:
                 self._round_tm0 = time.perf_counter()  # round opens here
             self._pending[worker] = grads
@@ -298,6 +515,7 @@ class ParameterServerMaster:
             # wait_for re-checks under the lock, so exactly one waiter
             # observes the still-open round and owns the timeout decision;
             # later waiters see updates_applied advanced and return above
+            num_workers = max(1, len(self.roster.round_ranks()))
             missing = num_workers - len(self._pending)
             if self.quorum < 1.0 and len(self._pending) >= self._quorum_count(
                 num_workers

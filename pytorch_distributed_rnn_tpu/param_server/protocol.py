@@ -22,6 +22,23 @@ skips the re-apply, and just resends current params - without it a
 lost-reply retry would average the same gradient into two consecutive
 updates.  float32 carries step counts exactly up to 2^24 (~16.7M steps
 per run, far past any schedule here).
+
+Elastic membership (``resilience/membership.py``) extends the same wire
+format with three membership ops:
+
+  REGISTER   - a new or respawned worker announces its stable WORKER-ID
+               (the seq header slot); the master replies with a
+               STATE_SYNC payload: [master update count, the worker's
+               push-seq watermark] + the current flat params, so the
+               joiner adopts authoritative state AND resumes its push
+               numbering above everything already applied (stale
+               in-flight pushes then dedupe away instead of
+               double-averaging)
+  DEREGISTER - voluntary leave (preemption-aware drain): the seq slot
+               carries the worker's last push seq; the master shrinks
+               the roster without burning quorum budget
+  STATE_SYNC - reserved for symmetry (the reply to REGISTER; never sent
+               worker -> master)
 """
 
 from __future__ import annotations
@@ -31,9 +48,12 @@ import numpy as np
 OP_PULL = 1
 OP_PUSH = 2
 OP_DONE = 3
+OP_REGISTER = 4
+OP_DEREGISTER = 5
+OP_STATE_SYNC = 6
 
 _HEADER_DTYPE = np.float32
-_HEADER_LEN = 2  # [opcode, seq]
+_HEADER_LEN = 2  # [opcode, seq]  (seq doubles as worker-id for REGISTER)
 
 
 def send_request(comm, opcode: int, grads: np.ndarray = None,
@@ -62,3 +82,27 @@ def send_params(comm, worker: int, flat_params: np.ndarray):
 
 def recv_params(comm, num_params: int) -> np.ndarray:
     return comm.recv(0, (num_params,), np.float32)
+
+
+def send_state_sync(comm, worker: int, flat_params: np.ndarray,
+                    step: int, seq: int):
+    """Master side: the REGISTER reply - [step watermark (master update
+    count), the worker's push-seq watermark] then the current params."""
+    header = np.array(
+        [float(OP_STATE_SYNC), float(step), float(seq)], dtype=_HEADER_DTYPE
+    )
+    comm.send(worker, header)
+    send_params(comm, worker, flat_params)
+
+
+def recv_state_sync(comm, num_params: int):
+    """Worker side: receive the REGISTER reply.
+    Returns (flat_params, step_watermark, seq_watermark)."""
+    header = comm.recv(0, (3,), np.float32)
+    opcode = int(header[0])
+    if opcode != OP_STATE_SYNC:
+        raise RuntimeError(
+            f"expected a STATE_SYNC reply to REGISTER, got opcode {opcode}"
+        )
+    flat = recv_params(comm, num_params)
+    return flat, int(header[1]), int(header[2])

@@ -7,6 +7,16 @@ process each.  Like the reference, a single invocation launches the role
 for ITS rank (one process per node); additionally, omitting ``--rank``
 spawns the whole world locally via multiprocessing - the single-machine
 fake-cluster pattern (SURVEY §4.2).
+
+Elastic mode (``--elastic``): the spawn world is supervised
+(``launcher/supervisor.py``) - a worker that dies is respawned with the
+same worker-id, star-joins the transport, and re-enters the run via the
+REGISTER/STATE_SYNC join protocol; a SIGTERM'd worker drains (flushes
+its in-flight gradient, DEREGISTERs, exits 0) instead of crashing.  The
+master can additionally bootstrap its authoritative state from the
+newest valid checkpoint (``--resume auto`` + ``--checkpoint-directory``)
+and write one every ``--ps-checkpoint-rounds`` updates, so a master
+restart re-seeds the world from durable state.
 """
 
 from __future__ import annotations
@@ -14,6 +24,7 @@ from __future__ import annotations
 import json
 import logging
 import multiprocessing as mp
+import threading
 
 import jax
 import numpy as np
@@ -23,6 +34,59 @@ from jax.flatten_util import ravel_pytree
 from pytorch_distributed_rnn_tpu.runtime import Communicator
 
 log = logging.getLogger(__name__)
+
+# exit code of a worker that drained on SIGTERM: 0 on purpose - a
+# voluntary leave is success (the supervisor must not respawn it, CI
+# must not redden on it); the telemetry distinction rides the
+# member_drain event, not the exit code
+DRAIN_EXIT_CODE = 0
+
+
+class AsyncCheckpointWriter:
+    """Coalescing background checkpoint writer for the master.
+
+    ``apply_update`` runs under the master's round lock (sync-mode close
+    or the async push handler), so serializing the full params+opt state
+    to disk inline would stall every worker's push/pull reply behind
+    file I/O.  The master's state values are REPLACED per update, never
+    mutated, so a snapshot is a reference grab: :meth:`submit` parks the
+    newest snapshot and the writer thread persists it outside every
+    lock.  Back-to-back submissions coalesce - only the most recent
+    pending snapshot is written."""
+
+    def __init__(self, write):
+        self._write = write
+        self._cv = threading.Condition()
+        self._snap = None
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._run, name="ps-ckpt-writer", daemon=True
+        )
+        self._thread.start()
+
+    def submit(self, *snap) -> None:
+        with self._cv:
+            self._snap = snap
+            self._cv.notify()
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while self._snap is None and not self._stop:
+                    self._cv.wait()
+                snap, self._snap = self._snap, None
+                if snap is None:
+                    return
+            self._write(*snap)
+
+    def close(self, timeout: float = 60.0) -> None:
+        """Stop the writer (dropping any still-pending snapshot - the
+        caller writes the authoritative final state synchronously)."""
+        with self._cv:
+            self._snap = None
+            self._stop = True
+            self._cv.notify()
+        self._thread.join(timeout=timeout)
 
 
 def _build_model_and_flat_params(args, training_set, seed):
@@ -58,6 +122,33 @@ def run_master(args):
     optimizer = optax.adam(args.learning_rate)
     opt_state = optimizer.init(unravel(flat))
 
+    # master-restart bootstrap: --resume auto re-seeds the authoritative
+    # params + optimizer state from the newest VALID checkpoint (corrupt
+    # files are skipped by the loader), so a restarted master hands
+    # rejoining workers trained state instead of a fresh init
+    ckpt_dir = getattr(args, "checkpoint_directory", None)
+    ckpt_rounds = int(getattr(args, "ps_checkpoint_rounds", 0) or 0)
+    ckpt_count = 0
+    if getattr(args, "resume", None) is not None and ckpt_dir:
+        from pytorch_distributed_rnn_tpu.training.checkpoint import (
+            find_latest_checkpoint,
+            load_checkpoint,
+        )
+
+        latest = find_latest_checkpoint(ckpt_dir)
+        if latest is not None:
+            params, opt_state, meta = load_checkpoint(
+                latest, unravel(flat), opt_state
+            )
+            flat = np.asarray(ravel_pytree(params)[0], np.float32)
+            ckpt_count = int(meta["epoch"])
+            log.info(
+                f"master bootstrap: restored {latest} "
+                f"(checkpoint ordinal {ckpt_count})"
+            )
+
+    state = {"flat": flat, "opt": opt_state, "updates": 0}
+
     @jax.jit
     def _update(flat_params, opt_state, flat_grads):
         params = unravel(flat_params)
@@ -67,18 +158,40 @@ def run_master(args):
         new_flat, _ = ravel_pytree(new_params)
         return new_flat, opt_state
 
-    state = {"flat": flat, "opt": opt_state}
-
     def apply_update(flat_grads):
         new_flat, new_opt = _update(state["flat"], state["opt"], flat_grads)
         state["flat"] = np.asarray(new_flat, np.float32)
         state["opt"] = new_opt
+        state["updates"] += 1
+        if ckpt_writer is not None and state["updates"] % ckpt_rounds == 0:
+            # snapshot, don't write: apply_update runs under the
+            # master's round lock, and the state values are replaced
+            # (never mutated), so the references are a consistent pair
+            ckpt_writer.submit(state["flat"], state["opt"], state["updates"])
         return state["flat"]
+
+    def _save_master_checkpoint(flat_now, opt_now, updates_now):
+        from pytorch_distributed_rnn_tpu.training.checkpoint import (
+            save_checkpoint,
+        )
+
+        nonlocal ckpt_count
+        path = save_checkpoint(
+            ckpt_dir, ckpt_count, unravel(flat_now), opt_now, loss=0.0,
+        )
+        ckpt_count += 1
+        log.info(f"master checkpoint: {path} @ update {updates_now}")
+
+    ckpt_writer = (
+        AsyncCheckpointWriter(_save_master_checkpoint)
+        if ckpt_rounds and ckpt_dir else None
+    )
 
     from pytorch_distributed_rnn_tpu.obs import MetricsRecorder
 
     # the master's sidecar is rank-0's (workers are ranks >= 1): quorum
-    # degradations and dead workers land next to the workers' step events
+    # degradations, membership transitions and dead workers land next to
+    # the workers' step events
     recorder = MetricsRecorder.resolve(args, rank=0, meta={"role": "master"})
     comm = Communicator(
         args.master_address, int(args.master_port), 0, args.world_size
@@ -89,9 +202,20 @@ def run_master(args):
             sync_timeout=getattr(args, "ps_sync_timeout", 300.0),
             quorum=getattr(args, "ps_quorum", 1.0),
             recorder=recorder,
+            elastic=bool(getattr(args, "elastic", False)),
+            join_timeout=getattr(args, "ps_join_timeout", 60.0),
         )
         final = master.serve()
+        if ckpt_writer is not None:
+            # drain the writer, then persist the authoritative final
+            # state synchronously (no lock is held here)
+            ckpt_writer.close()
+            _save_master_checkpoint(
+                state["flat"], state["opt"], state["updates"]
+            )
     finally:
+        if ckpt_writer is not None:
+            ckpt_writer.close()
         comm.close()
         recorder.close()
     return final
@@ -108,18 +232,38 @@ def _worker_faults(args, rank: int | None = None):
     return FaultSchedule.resolve(args, rank=rank)
 
 
-def run_worker(args, rank: int):
+def run_worker(args, rank: int, worker_id: int | None = None,
+               rejoin: bool = False):
+    """One PS worker process.  ``rejoin=True`` is the elastic path: the
+    transport is star-joined (the master's acceptor installs the rank)
+    and the run enters via REGISTER/STATE_SYNC instead of the initial
+    rendezvous + pull.  Returns this worker's train history; a SIGTERM
+    drain returns None after deregistering (process exits 0)."""
     from pytorch_distributed_rnn_tpu.param_server.worker import (
         ParameterServerWorkerTrainer,
     )
+    from pytorch_distributed_rnn_tpu.resilience.membership import (
+        DrainRequested,
+        DrainSignal,
+    )
 
     logging.basicConfig(level=args.log)
+    # the preemption notice: SIGTERM requests a drain; the trainer
+    # honors it at the next step boundary (in-flight gradient flushed)
+    drain = DrainSignal().install()
+    faults = _worker_faults(args, rank)
+    if rejoin and faults is not None:
+        # a respawned incarnation must not replay the deterministic
+        # lifetime fault that killed its predecessor (addresses are
+        # run-relative; the drill would never converge)
+        faults = faults.for_rejoin()
     # rendezvous BEFORE loading data: the master preprocesses first and
     # writes the cache, so workers (released only once the master's side of
     # the rendezvous exists) read the warm cache instead of racing to
     # preprocess the same files
     comm = Communicator(
-        args.master_address, int(args.master_port), rank, args.world_size
+        args.master_address, int(args.master_port), rank, args.world_size,
+        star=rejoin,
     )
     training_set, _, _ = _load_datasets(args)
     model, _, _ = _build_model_and_flat_params(
@@ -130,9 +274,14 @@ def run_worker(args, rank: int):
 
     trainer_class = families.wrap_trainer(args, ParameterServerWorkerTrainer)
     # per-worker telemetry sidecar (rank-suffixed path): ps_exchange
-    # latency/retry events plus the base trainer's step/epoch stream
-    recorder = MetricsRecorder.resolve(args, rank=rank,
-                                       meta={"role": "worker"})
+    # latency/retry events plus the base trainer's step/epoch stream.
+    # A respawn REWRITES the rank's sidecar (its meta carries the
+    # incarnation hint via rejoin) - the master's sidecar keeps the
+    # whole membership story either way
+    recorder = MetricsRecorder.resolve(
+        args, rank=rank, meta={"role": "worker", "rejoin": rejoin}
+    )
+    train_history = None
     try:
         trainer = trainer_class(
             comm,
@@ -141,7 +290,7 @@ def run_worker(args, rank: int):
             batch_size=args.batch_size,
             learning_rate=args.learning_rate,
             worker_rank=rank,
-            num_workers=args.world_size - 1,
+            num_workers=max(1, args.world_size - 1),
             seed=args.seed,
             # forwarded so the unsupported-flag guard raises instead of
             # the flag being silently dropped
@@ -151,16 +300,32 @@ def run_worker(args, rank: int):
                                       "gathered"),
             checkpoint_async=getattr(args, "checkpoint_async", False),
             transport_retries=getattr(args, "ps_transport_retries", 3),
-            faults=_worker_faults(args, rank),
+            # retry storms must die inside the round they retry into
+            transport_deadline_s=getattr(args, "ps_sync_timeout", 300.0),
+            worker_id=worker_id if worker_id is not None else rank,
+            register=rejoin,
+            drain_signal=drain,
+            faults=faults,
             recorder=recorder,
         )
-        _, train_history, _ = trainer.train(epochs=args.epochs)
-        trainer.finish()
+        try:
+            _, train_history, _ = trainer.train(epochs=args.epochs)
+            trainer.finish()
+        except DrainRequested:
+            # preemption-aware drain: the in-flight gradient already
+            # flushed (the drain is honored after the exchange), so
+            # deregister and leave SUCCESSFULLY - distinguishable from a
+            # crash by exit code AND by the member_drain event
+            trainer.deregister()
+            log.warning(
+                f"worker {rank} drained on SIGTERM (exit "
+                f"{DRAIN_EXIT_CODE})"
+            )
     finally:
         comm.close()
         recorder.close()
 
-    if rank == 1:
+    if rank == 1 and train_history is not None:
         with open("history.json", "w") as file:
             json.dump(
                 {"train_history": train_history, "validation_history": []}, file
@@ -168,7 +333,7 @@ def run_worker(args, rank: int):
     return train_history
 
 
-def _spawn_entry(args, rank):
+def _spawn_entry(args, rank, worker_id=None, rejoin=False):
     # force CPU in spawned children: each child would otherwise race to
     # claim the single local accelerator
     import jax as _jax
@@ -177,7 +342,54 @@ def _spawn_entry(args, rank):
     if rank == 0:
         run_master(args)
     else:
-        run_worker(args, rank)
+        run_worker(args, rank, worker_id=worker_id, rejoin=rejoin)
+
+
+def _run_elastic(args, ctx):
+    """Supervised elastic spawn world: the master runs unsupervised (it
+    owns the state); workers are supervised - a death is respawned with
+    the same worker-id (rejoining via REGISTER) until the respawn
+    budget runs out, a drain/completion (exit 0) is terminal."""
+    from pytorch_distributed_rnn_tpu.launcher.supervisor import (
+        ElasticSupervisor,
+    )
+
+    master = ctx.Process(target=_spawn_entry, args=(args, 0))
+    master.start()
+
+    def spawn_worker(rank, worker_id, rejoin):
+        p = ctx.Process(
+            target=_spawn_entry, args=(args, rank, worker_id, rejoin)
+        )
+        p.start()
+        return p
+
+    supervisor = ElasticSupervisor(
+        spawn_worker,
+        min_workers=int(getattr(args, "min_workers", 1) or 1),
+        max_respawns=int(getattr(args, "ps_max_respawns", 3)),
+    )
+    supervisor.launch(range(1, args.world_size))
+    healthy = supervisor.supervise(lambda: master.exitcode)
+    if not healthy:
+        log.error(
+            "elastic supervisor: worker pool fell below --min-workers "
+            f"{supervisor.min_workers} with no respawn budget left; "
+            "tearing down"
+        )
+        master.terminate()
+    master.join()
+    # the master's exit ends the run: reap/terminate what remains WITHOUT
+    # respawning into a dead world
+    supervisor.shutdown()
+    verdict = supervisor.verdict()
+    log.info(f"elastic supervisor verdict: {verdict}")
+    if not healthy or master.exitcode != 0:
+        raise SystemExit(
+            f"elastic parameter-server run failed: master exit "
+            f"{master.exitcode}, supervisor {verdict}"
+        )
+    return 0
 
 
 def run(args):
@@ -201,13 +413,21 @@ def run(args):
     if faults is not None:
         faults.export_network()
     if args.rank is not None:
-        # one role per invocation (multi-node layout)
+        # one role per invocation (multi-node layout); --ps-rejoin is
+        # the manual elastic re-entry: star-join + REGISTER under the
+        # given (or rank-derived) worker-id
         if args.rank == 0:
             return run_master(args)
-        return run_worker(args, args.rank)
+        return run_worker(
+            args, args.rank,
+            worker_id=getattr(args, "ps_worker_id", None),
+            rejoin=bool(getattr(args, "ps_rejoin", False)),
+        )
 
     # local mode: spawn the whole world (fake-cluster pattern)
     ctx = mp.get_context("spawn")
+    if getattr(args, "elastic", False):
+        return _run_elastic(args, ctx)
     procs = [
         ctx.Process(target=_spawn_entry, args=(args, rank))
         for rank in range(args.world_size)

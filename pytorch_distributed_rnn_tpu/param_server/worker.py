@@ -11,11 +11,22 @@ worker - shipping activations over RPC per batch would starve it), then
 pushes the flat gradient and receives fresh parameters.  Evaluation and
 checkpointing are disabled on workers like the reference
 (``worker.py:67-75``).
+
+Elastic membership (``resilience/membership.py``): a worker has a stable
+``worker_id`` decoupled from its transport rank.  With ``register=True``
+(a respawned or late-joining worker) the initial pull is replaced by the
+join protocol - REGISTER, then a STATE_SYNC reply carrying the current
+params and the worker's push-seq watermark, so its push numbering
+resumes above everything the master already applied and any stale
+in-flight push dedupes away.  A SIGTERM (preemption notice) is a
+*drain*: the in-flight gradient exchange completes, DEREGISTER is sent,
+and the process exits 0 - telemetry-distinguishable from a crash.
 """
 
 from __future__ import annotations
 
 import logging
+import math
 import time
 
 import jax
@@ -24,6 +35,7 @@ from jax.flatten_util import ravel_pytree
 
 from pytorch_distributed_rnn_tpu.data.sampler import DistributedSampler
 from pytorch_distributed_rnn_tpu.param_server import protocol
+from pytorch_distributed_rnn_tpu.resilience.membership import DrainSignal
 from pytorch_distributed_rnn_tpu.resilience.retry import retry_transport
 from pytorch_distributed_rnn_tpu.training.base import Trainer
 from pytorch_distributed_rnn_tpu.training.formatter import TrainingMessageFormatter
@@ -54,16 +66,26 @@ class ParameterServerWorkerTrainer(Trainer):
         checkpoint_format: str = "gathered",
         checkpoint_async: bool = False,
         transport_retries: int = 3,
+        transport_deadline_s: float | None = None,
+        worker_id: int | None = None,
+        register: bool = False,
+        drain_signal: DrainSignal | None = None,
         # resilience knobs; on PS workers only `faults` is meaningful
         # (checkpointing is disabled here, and the optimizer that applies
         # updates lives on the MASTER, whose finite-gradient assertion is
         # the PS-side integrity guard)
         **kwargs,
     ):
+        # the shard follows the stable worker-id (a respawn re-reads ITS
+        # data stream); a late joiner beyond the launch world wraps onto
+        # an existing shard - PS semantics tolerate overlap, gradients
+        # just average
+        shard = ((worker_id if worker_id is not None else worker_rank) - 1
+                 ) % max(1, num_workers)
         sampler = DistributedSampler(
             len(training_set),
             num_replicas=num_workers,
-            rank=worker_rank - 1,
+            rank=shard,
             seed=seed or 0,
         )
         super().__init__(
@@ -92,11 +114,24 @@ class ParameterServerWorkerTrainer(Trainer):
         self.comm = comm
         self.worker_rank = worker_rank
         self.num_workers = num_workers
+        # the stable membership identity: survives respawns (the
+        # supervisor relaunches a dead worker with the same id), while
+        # worker_rank is just the transport slot it plugs back into
+        self.worker_id = int(worker_id) if worker_id is not None else int(
+            worker_rank
+        )
+        # preemption-aware drain: checked at step boundaries, AFTER the
+        # in-flight exchange completed (the flush contract)
+        self._drain = drain_signal
         # transient transport errors (injected faults, preemptible
         # networks) retry with exponential backoff + jitter seeded by the
         # rank, so workers decorrelate their retry storms while a chaos
         # run stays reproducible
         self._transport_retries = int(transport_retries)
+        # total-deadline budget for one exchange's retry storm: derived
+        # from --ps-sync-timeout by the runner, so retries can never
+        # outlive the sync round they are retrying into
+        self._transport_deadline = transport_deadline_s
         # per-step push sequence number: a RETRY re-sends the same seq,
         # so the master can detect a duplicate (reply leg failed after
         # the update applied) and not average the gradient in twice
@@ -104,13 +139,61 @@ class ParameterServerWorkerTrainer(Trainer):
         flat, self._unravel = ravel_pytree(self.params)
         self.num_params = int(flat.size)
 
-        # initial pull: adopt the master's authoritative parameters
-        # (hvd.broadcast_parameters / DDP-wrap analogue for the PS world)
-        self._adopt(self._exchange(self._pull_params, what="initial pull"))
+        if register:
+            # join protocol (respawn/late join): REGISTER announces the
+            # stable worker-id; the STATE_SYNC reply carries the params
+            # AND the push-seq watermark this worker's stream already
+            # reached, so numbering resumes above it
+            self._state_sync()
+        else:
+            # initial pull: adopt the master's authoritative parameters
+            # (hvd.broadcast_parameters / DDP-wrap analogue for the PS
+            # world)
+            self._adopt(
+                self._exchange(self._pull_params, what="initial pull")
+            )
 
     def _pull_params(self):
         protocol.send_request(self.comm, protocol.OP_PULL)
         return protocol.recv_params(self.comm, self.num_params)
+
+    def _state_sync(self):
+        """REGISTER -> STATE_SYNC: adopt the master's params, update
+        count and this worker's push-seq watermark; position the epoch
+        cursor so training resumes where this worker-id's stream left
+        off instead of re-pushing every epoch from scratch."""
+
+        def register():
+            protocol.send_request(
+                self.comm, protocol.OP_REGISTER, seq=self.worker_id
+            )
+            return protocol.recv_state_sync(self.comm, self.num_params)
+
+        t0 = time.perf_counter()
+        flat, step_wm, seq_wm = self._exchange(register, what="register")
+        self._adopt(flat)
+        self._push_seq = int(seq_wm)
+        # epoch-granularity resume off the push watermark: the seq IS
+        # this worker's own step count, so floor-divide by its steps per
+        # epoch (re-pushing the dead incarnation's partial epoch is the
+        # price of epoch-granularity restart - those gradients average
+        # into live rounds like any straggler's)
+        steps_per_epoch = max(
+            1, math.ceil(len(self.sampler) / self.batch_size)
+        )
+        self._start_epoch = int(seq_wm) // steps_per_epoch
+        log.info(
+            f"state sync: worker-id {self.worker_id} rejoined at master "
+            f"update {step_wm}, push-seq watermark {seq_wm} -> resuming "
+            f"at epoch {self._start_epoch}"
+        )
+        if self.recorder.enabled:
+            self.recorder.emit_span(
+                "state_sync", t0, time.perf_counter() - t0, cat="member",
+                worker_id=self.worker_id, rank_slot=self.worker_rank,
+                step=int(step_wm), seq=int(seq_wm),
+                resume_epoch=self._start_epoch,
+            )
 
     def _exchange(self, fn, what: str, seq: int | None = None):
         """One protocol exchange under the retry policy.  An exchange is
@@ -137,6 +220,7 @@ class ParameterServerWorkerTrainer(Trainer):
                 fn, retries=self._transport_retries, seed=self.worker_rank,
                 what=f"{what} (worker {self.worker_rank})",
                 on_retry=on_retry if recording else None,
+                deadline_s=self._transport_deadline,
             )
         except Exception:
             if recording:
@@ -162,8 +246,9 @@ class ParameterServerWorkerTrainer(Trainer):
         return TrainingMessageFormatter(epochs, self.worker_rank)
 
     def _fold_rank(self, key):
-        # each PS worker draws its own dropout mask
-        return jax.random.fold_in(key, self.worker_rank)
+        # each PS worker draws its own dropout mask (folded by the
+        # stable id, so a respawn redraws ITS stream, not a neighbor's)
+        return jax.random.fold_in(key, self.worker_id)
 
     def _build_train_step(self):
         """Local fused forward+backward; the update is remote."""
@@ -188,9 +273,34 @@ class ParameterServerWorkerTrainer(Trainer):
                 seq=seq,
             )
             self._adopt(new_flat)
+            if self._drain is not None:
+                # the step's exchange is complete (gradient flushed,
+                # params adopted): a pending SIGTERM drain is honored
+                # HERE, so the last push is applied exactly once and
+                # nothing is torn mid-protocol
+                self._drain.check()
             return self.params, opt_state, loss, metrics
 
         return step
 
     def finish(self):
         protocol.send_request(self.comm, protocol.OP_DONE)
+
+    def deregister(self):
+        """Voluntary leave (the drain path): tell the master this worker
+        is exiting on purpose - the roster shrinks without burning the
+        quorum budget - and record the drain on this rank's sidecar so
+        ``pdrnn-metrics health`` classifies it drained, not dead."""
+        protocol.send_request(
+            self.comm, protocol.OP_DEREGISTER, seq=self._push_seq
+        )
+        log.info(
+            f"worker-id {self.worker_id} (rank {self.worker_rank}) "
+            f"deregistered after push seq {self._push_seq}"
+        )
+        if self.recorder.enabled:
+            self.recorder.record(
+                "member_drain", worker_id=self.worker_id,
+                rank_slot=self.worker_rank, seq=self._push_seq,
+            )
+            self.recorder.flush()

@@ -15,8 +15,14 @@ killed the run.  This package supplies both sides:
 - ``guard``: the :class:`NonFiniteGuard` (XLA-level skip of non-finite
   updates, host-level abort after K consecutive bad steps) and
   checkpoint auto-resume with fallback across corrupt files.
-- ``retry``: exponential backoff with deterministic jitter for
-  transport-level operations (the parameter-server worker's push/pull).
+- ``retry``: exponential backoff with deterministic jitter (and an
+  optional total wall-clock deadline) for transport-level operations
+  (the parameter-server worker's push/pull).
+- ``membership``: elastic world membership - the master-side
+  :class:`Roster` (stable worker-ids, joined/drained/dead lifecycle,
+  push-seq watermarks surviving respawns) and the worker-side
+  :class:`DrainSignal` (SIGTERM as a preemption notice: flush,
+  deregister, exit 0).
 """
 
 from pytorch_distributed_rnn_tpu.resilience.faults import (
@@ -30,15 +36,25 @@ from pytorch_distributed_rnn_tpu.resilience.guard import (
     NonFiniteGuard,
     resume_latest,
 )
+from pytorch_distributed_rnn_tpu.resilience.membership import (
+    DrainRequested,
+    DrainSignal,
+    Member,
+    Roster,
+)
 from pytorch_distributed_rnn_tpu.resilience.retry import retry_transport
 
 __all__ = [
     "ChaosError",
+    "DrainRequested",
+    "DrainSignal",
     "FaultEvent",
     "FaultSchedule",
     "fault_env",
+    "Member",
     "NonFiniteAbort",
     "NonFiniteGuard",
+    "Roster",
     "resume_latest",
     "retry_transport",
 ]

@@ -27,6 +27,15 @@ Spec grammar (``--faults`` flag / ``PDRNN_CHAOS`` env)::
             | exc                           data-loader exception (ChaosError)
             | kill                          SIGKILL this process (simulated
                                             preemption; pairs with --resume auto)
+            | respawn                       abrupt crash exit (nonzero, no
+                                            cleanup): the death an elastic
+                                            supervisor respawns - pairs with
+                                            parameter-server --elastic to drill
+                                            kill -> respawn -> REGISTER rejoin
+            | preempt                       SIGTERM this process (graceful
+                                            preemption notice): a PS worker
+                                            drains - flushes its in-flight
+                                            gradient, DEREGISTERs, exits 0
 
 An event may carry an ``@<rank>`` suffix (``epoch:1:kill@2``): it then
 fires only in the process bound to that rank via :meth:`FaultSchedule.
@@ -55,9 +64,14 @@ CHAOS_ENV = "PDRNN_CHAOS"
 FAULT_DELAY_ENV = "PDRNN_FAULT_DELAY_MS"
 FAULT_LOSS_ENV = "PDRNN_FAULT_LOSS_PROB"
 
-_ACTIONS = ("nan", "stall", "exc", "kill")
+_ACTIONS = ("nan", "stall", "exc", "kill", "respawn", "preempt")
 _TRIGGERS = ("step", "epoch", "prob")
 _DEFAULT_STALL_S = 0.25
+# process-lifetime actions (maybe_kill handles all three): how each dies
+_LIFETIME_ACTIONS = ("kill", "respawn", "preempt")
+# the respawn action's abrupt-crash exit code: nonzero so a supervisor
+# classifies it as a death (respawn), never as completion/drain
+RESPAWN_EXIT_CODE = 17
 
 
 class ChaosError(RuntimeError):
@@ -223,6 +237,24 @@ class FaultSchedule:
         bound.recorder = self.recorder
         return bound
 
+    def for_rejoin(self) -> "FaultSchedule":
+        """The schedule for a RESPAWNED incarnation (elastic supervisor
+        relaunch): deterministic step/epoch-addressed process-lifetime
+        events (kill/respawn/preempt) are dropped - they already fired
+        in the incarnation they terminated, and fault step/epoch
+        addresses are run-relative, so replaying them would kill every
+        respawn at the same address and no rejoin drill could ever
+        reach completion.  Probabilistic lifetime events (a flaky
+        worker) and all data-path events persist."""
+        kept = [
+            e for e in self.events
+            if not (e.action in _LIFETIME_ACTIONS
+                    and e.trigger in ("step", "epoch"))
+        ]
+        bound = FaultSchedule(kept, self.network, self.seed, rank=self.rank)
+        bound.recorder = self.recorder
+        return bound
+
     # -- trigger matching ----------------------------------------------------
 
     @property
@@ -255,9 +287,9 @@ class FaultSchedule:
                 "fault", action=event.action, trigger=event.trigger,
                 where=where,
             )
-            if event.action == "kill":
-                # SIGKILL joins no flush thread: drain NOW or the event
-                # (the whole point of chaos telemetry) dies with us
+            if event.action in ("kill", "respawn"):
+                # SIGKILL/_exit joins no flush thread: drain NOW or the
+                # event (the whole point of chaos telemetry) dies with us
                 self.recorder.flush()
 
     # -- action execution ----------------------------------------------------
@@ -306,21 +338,37 @@ class FaultSchedule:
 
     def maybe_kill(self, *, step: int | None = None,
                    epoch: int | None = None):
-        """Simulated preemption: SIGKILL this process at the addressed
-        step/epoch - no cleanup, no atexit, exactly like a preempted VM.
+        """Process-lifetime faults at the addressed step/epoch:
+
+        - ``kill``: SIGKILL - no cleanup, no atexit, exactly like a
+          preempted VM (pairs with --resume auto);
+        - ``respawn``: abrupt nonzero exit - the crash an elastic
+          supervisor respawns into the same worker-id;
+        - ``preempt``: SIGTERM - the graceful preemption notice.  A PS
+          worker's DrainSignal turns it into a drain (flush in-flight
+          gradient, DEREGISTER, exit 0); processes without a handler
+          die with the default disposition.
+
         Epoch triggers fire at epoch START (work since the last
         checkpoint is lost, the case auto-resume exists for)."""
         if step is not None:
             events = [e for e in self._matches(("step", "prob"), step)
-                      if e.action == "kill"]
+                      if e.action in _LIFETIME_ACTIONS]
             where = f"step {step}"
         else:
             events = [e for e in self._matches(("epoch",), epoch)
-                      if e.action == "kill"]
+                      if e.action in _LIFETIME_ACTIONS]
             where = f"epoch {epoch}"
         for e in events:
             self._fire(e, where)
-            logging.shutdown()  # flush handlers; SIGKILL won't
+            if e.action == "preempt":
+                # deliverable mid-run: the handler only sets a flag, so
+                # the step in flight completes before the drain
+                os.kill(os.getpid(), signal.SIGTERM)
+                continue
+            logging.shutdown()  # flush handlers; SIGKILL/_exit won't
+            if e.action == "respawn":
+                os._exit(RESPAWN_EXIT_CODE)
             os.kill(os.getpid(), signal.SIGKILL)
 
     def on_epoch_start(self, epoch: int):

@@ -92,13 +92,28 @@ def resume_latest(trainer, checkpoint_dir):
 
     Candidates are tried newest-first; a corrupt/truncated file is
     logged and skipped so resume falls back to the previous valid one.
-    Returns the checkpoint metadata, or ``None`` when no usable
-    checkpoint exists (fresh start).
+    Every skip additionally lands as a structured ``checkpoint_fallback``
+    event (path, reason, chosen fallback) on the trainer's metrics
+    sidecar, so chaos drills assert the fallback from telemetry instead
+    of grepping stderr.  Returns the checkpoint metadata, or ``None``
+    when no usable checkpoint exists (fresh start).
     """
     from pytorch_distributed_rnn_tpu.training.checkpoint import (
         CheckpointCorruptError,
         checkpoint_candidates,
     )
+
+    skipped: list[tuple[str, str]] = []
+
+    def _record_fallbacks(chosen):
+        recorder = getattr(trainer, "recorder", None)
+        if recorder is None or not recorder.enabled:
+            return
+        for path, reason in skipped:
+            recorder.record(
+                "checkpoint_fallback", path=path, reason=reason,
+                chosen=chosen,
+            )
 
     for path in checkpoint_candidates(checkpoint_dir):
         try:
@@ -107,10 +122,13 @@ def resume_latest(trainer, checkpoint_dir):
             log.warning(
                 f"auto-resume: skipping corrupt checkpoint {path}: {exc}"
             )
+            skipped.append((str(path), str(exc)))
             continue
         log.info(
             f"auto-resume: restored {path} (epoch {meta['epoch']}, "
             f"loss {meta['loss']:.6f})"
         )
+        _record_fallbacks(str(path))
         return meta
+    _record_fallbacks(None)
     return None

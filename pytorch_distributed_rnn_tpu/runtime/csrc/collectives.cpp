@@ -36,6 +36,7 @@
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <random>
 #include <string>
 #include <sys/socket.h>
@@ -47,6 +48,10 @@ namespace {
 
 constexpr int kMaxRetries = 300;      // rendezvous connect retries (x100ms)
 constexpr double kRtoPenaltyMs = 200; // simulated retransmit timeout
+// elastic (re)join handshake marker: a star joiner announces itself with
+// this magic so the master's acceptor can reject stray connections
+// (port scanners, half-open dials) instead of installing them as peers
+constexpr int32_t kElasticMagic = 0x70647273;  // 'pdrs'
 
 struct Comm {
   int rank = 0;
@@ -366,6 +371,98 @@ int pdrnn_broadcast(Comm* c, int root, void* data, int64_t nbytes) {
     return 0;
   }
   return pdrnn_recv(c, root, data, nbytes);
+}
+
+// -- elastic membership (parameter-server star topology) ---------------------
+//
+// The initial rendezvous builds a fixed-world full mesh; the functions
+// below let the PS world change membership afterwards.  They are
+// star-only by design: PS traffic is strictly master<->worker, so a
+// (re)joining worker dials rank 0 and nothing else - no table
+// re-exchange, no mesh rebuild, no recompile of anything.
+
+// Grow the peer table to `capacity` slots.  Must be called BEFORE any
+// concurrent use of the communicator (the resize reallocates the
+// vector): the master reserves its elastic headroom right after init,
+// before the acceptor thread starts, so accepts never reallocate under
+// in-flight send/recv.
+int pdrnn_reserve(Comm* c, int capacity) {
+  if (capacity <= static_cast<int>(c->peer_fd.size())) return 0;
+  c->peer_fd.resize(capacity, -1);
+  return 0;
+}
+
+// Master side: accept one elastic (re)join on the rendezvous listener.
+// Waits up to timeout_ms; returns the joining rank, -1 on timeout, -2
+// on a handshake/validity error (the stray connection is closed).  A
+// rank whose slot is occupied (a respawn racing its predecessor's
+// death) has the old socket shut down and replaced - the old service
+// thread's blocked recv wakes with an error and takes the death path.
+int pdrnn_accept_peer(Comm* c, int timeout_ms) {
+  if (c->listen_fd < 0) return -2;
+  pollfd pfd{c->listen_fd, POLLIN, 0};
+  int ready = poll(&pfd, 1, timeout_ms);
+  if (ready == 0) return -1;
+  if (ready < 0) return errno == EINTR ? -1 : -2;
+  int fd = accept(c->listen_fd, nullptr, nullptr);
+  if (fd < 0) return -2;
+  set_sockopts(fd);
+  // bound the handshake read: a connection that never identifies
+  // itself must not wedge the acceptor thread
+  timeval tv{2, 0};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  int32_t magic = 0, peer_rank = -1;
+  if (!recv_all(fd, &magic, 4) || magic != kElasticMagic ||
+      !recv_all(fd, &peer_rank, 4) || peer_rank < 1 ||
+      peer_rank >= static_cast<int>(c->peer_fd.size())) {
+    close(fd);
+    return -2;
+  }
+  timeval off{0, 0};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &off, sizeof(off));
+  if (c->peer_fd[peer_rank] >= 0) {
+    shutdown(c->peer_fd[peer_rank], SHUT_RDWR);
+    close(c->peer_fd[peer_rank]);
+  }
+  c->peer_fd[peer_rank] = fd;
+  if (peer_rank >= c->world) c->world = peer_rank + 1;
+  return peer_rank;
+}
+
+// Close one peer's socket (drain/death cleanup).  A later elastic
+// accept of the same rank installs a fresh socket in the slot.
+int pdrnn_close_peer(Comm* c, int rank) {
+  if (rank < 0 || rank >= static_cast<int>(c->peer_fd.size())) return -1;
+  if (c->peer_fd[rank] >= 0) {
+    shutdown(c->peer_fd[rank], SHUT_RDWR);
+    close(c->peer_fd[rank]);
+    c->peer_fd[rank] = -1;
+  }
+  return 0;
+}
+
+// Worker side: star-join a running world as `rank` - dial the master
+// only and identify via the elastic handshake.  No listener, no mesh,
+// no port-table exchange; only peer 0 is reachable afterwards.
+Comm* pdrnn_init_star(const char* master_addr, int master_port, int rank,
+                      int world) {
+  if (rank < 1) return nullptr;
+  auto* c = new Comm();
+  c->rank = rank;
+  c->world = world > rank ? world : rank + 1;
+  c->peer_fd.assign(c->world, -1);
+  int fd = dial(master_addr, static_cast<uint16_t>(master_port));
+  if (fd < 0) {
+    pdrnn_destroy(c);
+    return nullptr;
+  }
+  int32_t magic = kElasticMagic, r32 = rank;
+  if (!send_all(c, fd, &magic, 4) || !send_all(c, fd, &r32, 4)) {
+    pdrnn_destroy(c);
+    return nullptr;
+  }
+  c->peer_fd[0] = fd;
+  return c;
 }
 
 }  // extern "C"

@@ -84,8 +84,18 @@ def _load():
         ctypes.c_int,
         ctypes.c_int,
     ]
+    lib.pdrnn_init_star.restype = ctypes.c_void_p
+    lib.pdrnn_init_star.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int,
+    ]
     lib.pdrnn_rank.argtypes = [ctypes.c_void_p]
     lib.pdrnn_world.argtypes = [ctypes.c_void_p]
+    lib.pdrnn_reserve.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.pdrnn_accept_peer.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.pdrnn_close_peer.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.pdrnn_set_fault.argtypes = [ctypes.c_void_p, ctypes.c_double, ctypes.c_double]
     lib.pdrnn_send.argtypes = [
         ctypes.c_void_p,
@@ -139,16 +149,29 @@ class Communicator:
         master_port: int = 29500,
         rank: int = 0,
         world_size: int = 1,
+        star: bool = False,
     ):
         lib = _load()
         self._lib = lib
-        self._handle = lib.pdrnn_init(
-            master_addr.encode(), master_port, rank, world_size
-        )
+        if star:
+            # elastic (re)join: dial rank 0 only - the star topology the
+            # parameter server actually uses.  The master must be running
+            # an elastic acceptor (`accept_peer`) for the dial to be
+            # installed as a peer; no mesh or port table is exchanged.
+            if rank < 1:
+                raise ValueError("star join is for worker ranks (>= 1)")
+            self._handle = lib.pdrnn_init_star(
+                master_addr.encode(), master_port, rank, world_size
+            )
+        else:
+            self._handle = lib.pdrnn_init(
+                master_addr.encode(), master_port, rank, world_size
+            )
         if not self._handle:
             raise RuntimeError(
                 f"rendezvous failed (rank {rank}/{world_size} via "
-                f"{master_addr}:{master_port})"
+                f"{master_addr}:{master_port}"
+                f"{', star join' if star else ''})"
             )
         self.rank = rank
         self.world_size = world_size
@@ -164,6 +187,32 @@ class Communicator:
 
     def set_fault(self, delay_ms: float = 0.0, loss_prob: float = 0.0):
         self._lib.pdrnn_set_fault(self._handle, delay_ms, loss_prob)
+
+    # -- elastic membership (master side) ------------------------------------
+
+    def reserve(self, capacity: int):
+        """Grow the peer table to ``capacity`` rank slots so elastic
+        accepts of brand-new ranks never reallocate it under concurrent
+        send/recv.  Call once, before the acceptor thread starts."""
+        self._lib.pdrnn_reserve(self._handle, int(capacity))
+
+    def accept_peer(self, timeout_s: float = 0.5) -> int | None:
+        """Accept one elastic (re)join on the rendezvous listener (rank 0
+        only).  Returns the joining rank, or ``None`` on timeout or a
+        rejected stray connection.  ``world_size`` grows when a brand-new
+        rank joins."""
+        rank = self._lib.pdrnn_accept_peer(
+            self._handle, int(timeout_s * 1000)
+        )
+        if rank < 0:
+            return None
+        self.world_size = max(self.world_size, rank + 1)
+        return rank
+
+    def close_peer(self, rank: int):
+        """Shut down and close one peer's socket (drain/death cleanup);
+        a later elastic accept of the same rank installs a fresh one."""
+        self._lib.pdrnn_close_peer(self._handle, int(rank))
 
     # -- primitives ----------------------------------------------------------
 

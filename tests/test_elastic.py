@@ -1,0 +1,1085 @@
+"""Elastic membership: roster lifecycle, the REGISTER/STATE_SYNC/
+DEREGISTER join protocol, transport star-joins, the supervised respawn
+drill, and preemption-aware drain.
+
+The spec of ISSUE 7: the quorum PS (PR 2) could only SHRINK a world;
+these tests pin the grow-back half - a worker killed mid-run is
+respawned with the same worker-id, re-enters via REGISTER (never by its
+old rank silently reappearing), state-syncs, and the roster returns to
+full strength; a SIGTERM'd worker drains voluntarily (exit 0, quorum
+budget untouched, telemetry-distinguishable from a crash).
+"""
+
+import json
+import threading
+import time
+from argparse import Namespace
+from collections import deque
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_rnn_tpu.resilience import membership
+from pytorch_distributed_rnn_tpu.resilience.membership import Roster
+
+PORT = 29880
+
+
+class _ListRecorder:
+    """Minimal recorder double: captures events in order."""
+
+    enabled = True
+
+    def __init__(self):
+        self.events = []
+
+    def record(self, kind, **fields):
+        self.events.append({"kind": kind, **fields})
+
+    def emit_span(self, name, tm_start, dur_s, cat="train", **attrs):
+        self.events.append({"kind": "span", "name": name, "cat": cat,
+                            "dur_s": dur_s, **attrs})
+
+    def flush(self):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Roster lifecycle
+# ---------------------------------------------------------------------------
+
+
+class TestRoster:
+    def test_bootstrap_and_counts(self):
+        rec = _ListRecorder()
+        roster = Roster(recorder=rec)
+        roster.bootstrap([1, 2, 3])
+        assert roster.counts() == {
+            "joined": 3, "drained": 0, "dead": 0, "done": 0,
+        }
+        assert roster.round_ranks() == {1, 2, 3}
+        joins = [e for e in rec.events if e["kind"] == "member_join"]
+        assert len(joins) == 3 and all(e["via"] == "bootstrap"
+                                       for e in joins)
+
+    def test_lifecycle_transitions_emit_events(self):
+        rec = _ListRecorder()
+        roster = Roster(recorder=rec)
+        roster.bootstrap([1, 2])
+        roster.drain(1, seq=5)
+        roster.mark_dead(2, error="socket closed")
+        assert roster.counts() == {
+            "joined": 0, "drained": 1, "dead": 1, "done": 0,
+        }
+        assert roster.round_ranks() == set()
+        kinds = [e["kind"] for e in rec.events]
+        assert kinds.count("member_drain") == 1
+        assert kinds.count("member_dead") == 1
+        drain = next(e for e in rec.events if e["kind"] == "member_drain")
+        assert drain["seq"] == 5 and drain["worker_id"] == 1
+
+    def test_rejoin_bumps_incarnation_and_keeps_watermark(self):
+        roster = Roster()
+        roster.bootstrap([1])
+        assert roster.note_push(1, 1) and roster.note_push(1, 2)
+        roster.mark_dead(1, error="killed")
+        member = roster.join(1, 1)
+        assert member.incarnation == 2
+        assert member.state == membership.JOINED
+        assert member.push_seq == 2  # the dedupe watermark survives
+        assert roster.rejoins == 1
+        # a rejoiner is NOT in the round rendezvous until its first push
+        assert roster.round_ranks() == set()
+        assert roster.note_push(1, 3)
+        assert roster.round_ranks() == {1}
+
+    def test_note_push_dedupes_at_or_below_watermark(self):
+        roster = Roster()
+        roster.bootstrap([1])
+        assert roster.note_push(1, 1)
+        assert not roster.note_push(1, 1)  # retry duplicate
+        assert roster.note_push(1, 2)
+        roster.mark_dead(1, error="x")
+        roster.join(1, 1)
+        # the respawn's stale in-flight push (seq <= watermark) dedupes
+        assert not roster.note_push(1, 2)
+        assert not roster.note_push(1, 1)
+        assert roster.note_push(1, 3)
+
+    def test_terminal_states(self):
+        roster = Roster()
+        roster.bootstrap([1, 2])
+        roster.complete(1)
+        roster.drain(2)
+        assert roster.all_terminal()
+        assert roster.counts()["done"] == 1
+
+    def test_fresh_register_join_enters_next_round(self):
+        """A brand-new worker-id REGISTERing mid-run (not a respawn) is
+        excluded from the round rendezvous until its first push lands -
+        same contract as a rejoiner, so an in-flight round never blocks
+        on the joiner's data load + model build."""
+        roster = Roster()
+        roster.bootstrap([1])
+        member = roster.join(7, 3)  # fresh worker-id via REGISTER
+        assert member.state == membership.JOINED and not member.synced
+        assert roster.round_ranks() == {1}
+        assert roster.note_push(3, 1)
+        assert roster.round_ranks() == {1, 3}
+
+    def test_bootstrap_quiet_suppresses_events(self):
+        rec = _ListRecorder()
+        roster = Roster(recorder=rec)
+        roster.bootstrap([1, 2], quiet=True)
+        assert roster.counts()["joined"] == 2
+        assert not [e for e in rec.events if e["kind"] == "member_join"]
+
+
+# ---------------------------------------------------------------------------
+# Protocol: REGISTER / STATE_SYNC / DEREGISTER wire format
+# ---------------------------------------------------------------------------
+
+
+class _PipeComm:
+    """Scripted two-endpoint comm: everything sent lands in a deque the
+    peer's recv pops (worker-side endpoint view, master is peer 0)."""
+
+    def __init__(self):
+        self.sent = []
+        self.inbox = deque()
+
+    def send(self, dst, arr):
+        self.sent.append((dst, np.array(arr)))
+
+    def recv(self, src, shape, dtype=np.float32):
+        return np.asarray(self.inbox.popleft(), dtype).reshape(shape)
+
+
+class TestProtocol:
+    def test_state_sync_round_trip(self):
+        from pytorch_distributed_rnn_tpu.param_server import protocol
+
+        master_side = _PipeComm()
+        params = np.arange(6, dtype=np.float32)
+        protocol.send_state_sync(master_side, 3, params, step=17, seq=4)
+        worker_side = _PipeComm()
+        worker_side.inbox.extend(arr for _, arr in master_side.sent)
+        flat, step, seq = protocol.recv_state_sync(worker_side, 6)
+        np.testing.assert_array_equal(flat, params)
+        assert step == 17 and seq == 4
+
+    def test_state_sync_rejects_wrong_opcode(self):
+        from pytorch_distributed_rnn_tpu.param_server import protocol
+
+        worker_side = _PipeComm()
+        worker_side.inbox.append(np.array([2.0, 0.0, 0.0], np.float32))
+        with pytest.raises(RuntimeError, match="STATE_SYNC"):
+            protocol.recv_state_sync(worker_side, 4)
+
+    def test_register_and_deregister_headers(self):
+        from pytorch_distributed_rnn_tpu.param_server import protocol
+
+        comm = _PipeComm()
+        protocol.send_request(comm, protocol.OP_REGISTER, seq=7)
+        protocol.send_request(comm, protocol.OP_DEREGISTER, seq=12)
+        (_, reg), (_, dereg) = comm.sent
+        assert reg.tolist() == [float(protocol.OP_REGISTER), 7.0]
+        assert dereg.tolist() == [float(protocol.OP_DEREGISTER), 12.0]
+
+
+# ---------------------------------------------------------------------------
+# Master-side membership logic (scripted comm, no processes)
+# ---------------------------------------------------------------------------
+
+
+class _ScriptedComm:
+    world_size = 3
+
+    def __init__(self, messages):
+        self.inbox = deque(np.asarray(m, np.float32) for m in messages)
+        self.sent = []
+
+    def recv(self, src, shape, dtype=np.float32):
+        return self.inbox.popleft().reshape(shape)
+
+    def send(self, dst, arr):
+        self.sent.append((dst, np.array(arr)))
+
+
+def _master(messages, n=4, **kwargs):
+    from pytorch_distributed_rnn_tpu.param_server.master import (
+        ParameterServerMaster,
+    )
+
+    state = {"p": np.zeros(n, np.float32)}
+
+    def apply_update(g):
+        state["p"] = state["p"] - 0.1 * np.asarray(g)
+        return state["p"]
+
+    comm = _ScriptedComm(messages)
+    master = ParameterServerMaster(
+        comm, state["p"].copy(), apply_update, **kwargs
+    )
+    return master, comm, state
+
+
+class TestMasterMembership:
+    def test_register_replies_state_sync_with_watermarks(self):
+        n = 4
+        master, comm, state = _master(
+            [
+                [2.0, 1.0], np.ones(n),  # push seq 1 (applied)
+                [4.0, 2.0],              # REGISTER, worker-id 2 (rank 1!)
+                [3.0, 0.0],              # DONE
+            ],
+            n=n,
+        )
+        master._serve_worker(1)
+        # reply order: params for the push, then the STATE_SYNC header +
+        # params for the REGISTER
+        assert len(comm.sent) == 3
+        _, sync_header = comm.sent[1]
+        assert sync_header.tolist() == [6.0, 1.0, 0.0]  # op, step=1, seq wm 0
+        member = master.roster.get(2)
+        assert member is not None and member.rank == 1
+
+    def test_deregister_drains_without_burning_quorum(self):
+        master, comm, _ = _master([[5.0, 3.0]])  # DEREGISTER after seq 3
+        master._serve_worker(1)
+        member = master.roster.member_for_rank(1)
+        assert member.state == membership.DRAINED
+        assert master.roster.counts()["drained"] == 1
+        # the drained member is a SURVIVOR for the final quorum verdict
+        # (serve()'s check counts done+drained); nothing raised here
+
+    def test_non_elastic_master_emits_no_membership_telemetry(self):
+        """A plain PS run's fixed launch set is not membership
+        telemetry: only elastic masters emit bootstrap member_join
+        events (pdrnn-metrics reports membership as absent otherwise)."""
+        rec = _ListRecorder()
+        _master([], recorder=rec)
+        assert not [e for e in rec.events if e["kind"] == "member_join"]
+        rec = _ListRecorder()
+        _master([], recorder=rec, elastic=True)
+        joins = [e for e in rec.events if e["kind"] == "member_join"]
+        assert len(joins) == 2  # world_size 3: launch workers 1 and 2
+
+    def test_elastic_push_from_unrostered_rank_rejected(self):
+        """A star-joined rank that never sent REGISTER must not get its
+        gradient averaged in (nor count toward closing a round): elastic
+        world entry is join-protocol-only."""
+        n = 4
+        master, comm, state = _master(
+            [[2.0, 1.0], np.ones(n)], n=n, elastic=True
+        )
+        with pytest.raises(RuntimeError, match="unrostered"):
+            master._serve_worker(5)  # outside the bootstrapped world
+        assert master.updates_applied == 0
+        np.testing.assert_array_equal(state["p"], np.zeros(n))
+
+    def test_push_from_dead_member_requires_register(self):
+        """ISSUE 7 satellite: a worker marked dead whose transport
+        recovers must re-enter only via REGISTER - its old rank pushing
+        again is an error, and nothing is applied."""
+        n = 4
+        master, comm, state = _master(
+            [[2.0, 7.0], np.ones(n)], n=n
+        )
+        master._mark_dead(1, RuntimeError("socket reset"))
+        with pytest.raises(RuntimeError, match="REGISTER"):
+            master._serve_worker(1)
+        assert master.updates_applied == 0
+        np.testing.assert_array_equal(state["p"], np.zeros(n))
+
+    def test_rejoin_stale_push_dedupes_not_double_applied(self):
+        """The double-count pin: after death + REGISTER, a stale
+        in-flight push at (or below) the watermark is answered with
+        params but NOT averaged in again."""
+        n = 4
+        master, comm, state = _master(
+            [
+                [2.0, 1.0], np.ones(n),   # incarnation 1: push seq 1
+                [2.0, 2.0], np.ones(n),   # incarnation 1: push seq 2
+            ],
+            n=n,
+        )
+        with pytest.raises(IndexError):
+            master._serve_worker(1)  # runs out of scripted messages
+        assert master.updates_applied == 2
+        master._mark_dead(1, RuntimeError("killed"))
+        # respawn: REGISTER, then a STALE re-push of seq 2, then real seq 3
+        comm.inbox.extend(
+            np.asarray(m, np.float32) for m in [
+                [4.0, 1.0],               # REGISTER worker-id 1
+                [2.0, 2.0], np.ones(n),   # stale in-flight push (dup)
+                [2.0, 3.0], np.ones(n),   # first real post-rejoin push
+                [3.0, 0.0],               # DONE
+            ]
+        )
+        master._serve_worker(1)
+        # seq 2 must NOT be re-applied: 2 (before) + 1 (seq 3) updates
+        assert master.updates_applied == 3
+        member = master.roster.get(1)
+        assert member.incarnation == 2 and member.push_seq == 3
+        np.testing.assert_allclose(state["p"], -0.3 * np.ones(n),
+                                   rtol=1e-6)
+
+    def test_state_sync_watermark_survives_respawn(self):
+        n = 4
+        master, comm, _ = _master(
+            [
+                [2.0, 1.0], np.ones(n),
+                [2.0, 2.0], np.ones(n),
+                [3.0, 0.0],
+            ],
+            n=n,
+        )
+        master._serve_worker(1)
+        master._mark_dead(1, RuntimeError("killed"))
+        comm.inbox.extend(
+            np.asarray(m, np.float32) for m in [[4.0, 1.0], [3.0, 0.0]]
+        )
+        master._serve_worker(1)
+        sync_header = next(
+            arr for _, arr in comm.sent
+            if arr.size == 3 and arr[0] == 6.0
+        )
+        # step watermark 2 updates, push-seq watermark 2
+        assert sync_header.tolist() == [6.0, 2.0, 2.0]
+
+    def test_drain_closes_inflight_round(self):
+        """Sync mode: worker 1 waits on a round; worker 2's DEREGISTER
+        shrinks the rendezvous and the round closes over worker 1 alone
+        - the drain analogue of _mark_dead's round-close path."""
+        from pytorch_distributed_rnn_tpu.param_server.master import (
+            ParameterServerMaster,
+        )
+
+        class _RecordingComm:
+            world_size = 3
+
+            def __init__(self):
+                self.sent = []
+
+            def send(self, dst, arr):
+                self.sent.append((dst, np.array(arr)))
+
+        applied = []
+        master = ParameterServerMaster(
+            _RecordingComm(), np.zeros(4, np.float32),
+            lambda g: (applied.append(np.array(g)), -np.asarray(g))[1],
+            sync_mode=True, sync_timeout=30.0, quorum=0.5,
+        )
+        t = threading.Thread(
+            target=master._push_sync, args=(1, np.full(4, 4.0, np.float32))
+        )
+        t.start()
+        time.sleep(0.05)
+        master.roster.drain(2, seq=0)
+        master._rendezvous_leave(2)
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert master.updates_applied == 1 and master.degraded_rounds == 0
+        np.testing.assert_allclose(applied[0], np.full(4, 4.0))
+
+
+# ---------------------------------------------------------------------------
+# Master checkpoint writer (off the round lock)
+# ---------------------------------------------------------------------------
+
+
+class TestAsyncCheckpointWriter:
+    def test_writes_happen_off_the_caller(self):
+        from pytorch_distributed_rnn_tpu.param_server.runner import (
+            AsyncCheckpointWriter,
+        )
+
+        written = []
+        done = threading.Event()
+
+        def write(flat, opt, updates):
+            written.append((np.array(flat), opt, updates))
+            done.set()
+
+        writer = AsyncCheckpointWriter(write)
+        writer.submit(np.ones(3, np.float32), {"o": 1}, 4)
+        assert done.wait(timeout=10)
+        writer.close()
+        assert len(written) == 1 and written[0][2] == 4
+
+    def test_coalesces_to_newest_snapshot(self):
+        from pytorch_distributed_rnn_tpu.param_server.runner import (
+            AsyncCheckpointWriter,
+        )
+
+        written = []
+        gate = threading.Event()
+        first_started = threading.Event()
+
+        def write(flat, opt, updates):
+            first_started.set()
+            gate.wait(timeout=10)  # hold the writer mid-save
+            written.append(updates)
+
+        writer = AsyncCheckpointWriter(write)
+        writer.submit(np.zeros(1), None, 1)
+        assert first_started.wait(timeout=10)
+        # submitted while the writer is busy: only the newest survives
+        writer.submit(np.zeros(1), None, 2)
+        writer.submit(np.zeros(1), None, 3)
+        gate.set()
+        deadline = time.monotonic() + 10
+        while len(written) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        writer.close()
+        assert written == [1, 3]
+
+    def test_close_drops_pending_and_is_idempotent(self):
+        from pytorch_distributed_rnn_tpu.param_server.runner import (
+            AsyncCheckpointWriter,
+        )
+
+        written = []
+        writer = AsyncCheckpointWriter(
+            lambda *snap: written.append(snap)
+        )
+        writer.close()
+        writer.submit(np.zeros(1), None, 1)  # after stop: never written
+        writer.close()
+        assert written == []
+
+
+# ---------------------------------------------------------------------------
+# Transport: star joins on the native communicator (threads, no spawn)
+# ---------------------------------------------------------------------------
+
+
+class TestElasticTransport:
+    def test_respawn_and_new_rank_star_join(self):
+        from pytorch_distributed_rnn_tpu.runtime import Communicator
+
+        port = PORT + 31
+        res = {}
+
+        def master():
+            c = Communicator("127.0.0.1", port, 0, 3)
+            c.reserve(8)
+            res["r1"] = c.recv(1, (4,))
+            c.close_peer(2)  # rank 2 "died"
+            rank = None
+            while rank is None:
+                rank = c.accept_peer(timeout_s=1.0)
+            res["rejoined"] = rank
+            res["r2"] = c.recv(2, (4,))
+            c.send(2, np.full(4, 9.0, np.float32))
+            rank = None
+            while rank is None:
+                rank = c.accept_peer(timeout_s=1.0)
+            res["new_rank"] = rank
+            res["r3"] = c.recv(3, (2,))
+            res["world"] = c.world_size
+            c.close()
+
+        def w1():
+            c = Communicator("127.0.0.1", port, 1, 3)
+            c.send(0, np.full(4, 1.0, np.float32))
+            time.sleep(1.0)
+            c.close()
+
+        def w2_initial():
+            Communicator("127.0.0.1", port, 2, 3).close()
+
+        def w2_respawn():
+            time.sleep(0.3)
+            c = Communicator("127.0.0.1", port, 2, 3, star=True)
+            c.send(0, np.full(4, 2.0, np.float32))
+            res["w2_params"] = c.recv(0, (4,))
+            c.close()
+
+        def w3_new():
+            time.sleep(0.8)
+            c = Communicator("127.0.0.1", port, 3, 4, star=True)
+            c.send(0, np.full(2, 3.0, np.float32))
+            c.close()
+
+        threads = [
+            threading.Thread(target=f)
+            for f in (master, w1, w2_initial, w2_respawn, w3_new)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads)
+        assert res["rejoined"] == 2 and res["new_rank"] == 3
+        np.testing.assert_array_equal(res["r2"], np.full(4, 2.0))
+        np.testing.assert_array_equal(res["w2_params"], np.full(4, 9.0))
+        np.testing.assert_array_equal(res["r3"], np.full(2, 3.0))
+        assert res["world"] == 4  # the world GREW
+
+    def test_star_join_rejects_rank_zero(self):
+        from pytorch_distributed_rnn_tpu.runtime import Communicator
+
+        with pytest.raises(ValueError, match="star"):
+            Communicator("127.0.0.1", PORT + 32, 0, 2, star=True)
+
+
+# ---------------------------------------------------------------------------
+# Supervisor (fake processes)
+# ---------------------------------------------------------------------------
+
+
+class _FakeProc:
+    def __init__(self, exitcode=None):
+        self.exitcode = exitcode
+        self.terminated = False
+
+    def is_alive(self):
+        return self.exitcode is None
+
+    def terminate(self):
+        self.terminated = True
+        if self.exitcode is None:
+            self.exitcode = -15
+
+    def join(self, timeout=None):
+        pass
+
+
+class TestSupervisor:
+    def _supervisor(self, **kwargs):
+        from pytorch_distributed_rnn_tpu.launcher.supervisor import (
+            ElasticSupervisor,
+        )
+
+        spawned = []
+
+        def spawn(rank, worker_id, rejoin):
+            proc = _FakeProc()
+            spawned.append((rank, worker_id, rejoin, proc))
+            return proc
+
+        sup = ElasticSupervisor(spawn, respawn_delay_s=0.0, **kwargs)
+        return sup, spawned
+
+    def test_nonzero_exit_respawns_with_same_worker_id(self):
+        sup, spawned = self._supervisor(max_respawns=2)
+        sup.launch([1, 2])
+        spawned[1][3].exitcode = -9  # worker-id 2 dies
+        assert sup.poll()
+        assert len(spawned) == 3
+        rank, worker_id, rejoin, _ = spawned[2]
+        assert (rank, worker_id, rejoin) == (2, 2, True)
+        assert sup.total_respawns == 1
+
+    def test_exit_zero_is_terminal_never_respawned(self):
+        sup, spawned = self._supervisor()
+        sup.launch([1])
+        spawned[0][3].exitcode = 0  # drain or completion
+        assert sup.poll()
+        assert len(spawned) == 1
+        assert sup.slots[1].completed
+
+    def test_budget_exhaustion_respects_min_workers_floor(self):
+        sup, spawned = self._supervisor(max_respawns=1, min_workers=2)
+        sup.launch([1, 2])
+        spawned[1][3].exitcode = 1
+        assert sup.poll()  # respawn 1/1
+        spawned[2][3].exitcode = 1
+        assert not sup.poll()  # budget gone, 1 live < min_workers 2
+        assert sup.slots[2].failed
+
+    def test_shutdown_settles_verdicts(self):
+        sup, spawned = self._supervisor()
+        sup.launch([1, 2])
+        spawned[0][3].exitcode = 0
+        sup.shutdown()
+        verdict = sup.verdict()
+        assert verdict["completed"] == 1 and verdict["failed"] == 1
+        assert spawned[1][3].terminated
+
+
+# ---------------------------------------------------------------------------
+# Chaos actions: preempt / respawn (+ rejoin schedule semantics)
+# ---------------------------------------------------------------------------
+
+
+class TestLifetimeFaults:
+    def test_parse_preempt_and_respawn(self):
+        from pytorch_distributed_rnn_tpu.resilience import FaultSchedule
+
+        s = FaultSchedule.parse("epoch:1:preempt@2,step:3:respawn")
+        assert [e.action for e in s.events] == ["preempt", "respawn"]
+        s2 = FaultSchedule.parse(str(s))
+        assert s2.events == s.events
+
+    def test_preempt_sends_sigterm_to_self(self, monkeypatch):
+        import os
+        import signal as signal_mod
+
+        from pytorch_distributed_rnn_tpu.resilience import FaultSchedule
+
+        sent = []
+        monkeypatch.setattr(
+            os, "kill", lambda pid, sig: sent.append((pid, sig))
+        )
+        s = FaultSchedule.parse("step:1:preempt")
+        s.maybe_kill(step=1)
+        assert sent == [(os.getpid(), signal_mod.SIGTERM)]
+        assert s.fired == {"preempt": 1}
+
+    def test_for_rejoin_drops_deterministic_lifetime_events(self):
+        from pytorch_distributed_rnn_tpu.resilience import FaultSchedule
+
+        s = FaultSchedule.parse(
+            "epoch:1:kill@2,step:3:respawn,step:2:nan,prob:0.1:kill,"
+            "step:4:preempt"
+        ).for_rank(2)
+        rejoined = s.for_rejoin()
+        actions = [(e.trigger, e.action) for e in rejoined.events]
+        # deterministic lifetime events dropped; nan + prob kill persist
+        assert actions == [("step", "nan"), ("prob", "kill")]
+        assert rejoined.rank == 2
+
+    def test_drain_signal_flag_and_check(self):
+        from pytorch_distributed_rnn_tpu.resilience import (
+            DrainRequested,
+            DrainSignal,
+        )
+
+        drain = DrainSignal()
+        drain.check()  # no-op while not requested
+        drain._on_sigterm(15, None)
+        with pytest.raises(DrainRequested):
+            drain.check()
+
+
+# ---------------------------------------------------------------------------
+# Retry deadline budget (ISSUE 7 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestRetryDeadline:
+    @pytest.mark.parametrize("seed", range(8))
+    @pytest.mark.parametrize("deadline", [0.01, 0.1, 1.0, 5.0])
+    def test_backoff_delay_sums_stay_under_budget(self, seed, deadline):
+        """The property the satellite asks for: however many retries are
+        configured, the trimmed schedule's sleep sum never exceeds the
+        wall-clock budget."""
+        from pytorch_distributed_rnn_tpu.resilience.retry import (
+            backoff_delays,
+        )
+
+        delays = backoff_delays(64, seed=seed, deadline_s=deadline)
+        assert sum(delays) <= deadline
+        # the trim only ever removes from the tail
+        full = backoff_delays(64, seed=seed)
+        assert delays == full[: len(delays)]
+
+    def test_deadline_trims_attempts(self):
+        from pytorch_distributed_rnn_tpu.resilience import retry_transport
+
+        calls = {"n": 0}
+
+        def always_bad():
+            calls["n"] += 1
+            raise RuntimeError(f"failure {calls['n']}")
+
+        # a tiny budget admits no sleeps at all: exactly one attempt
+        with pytest.raises(RuntimeError, match="failure 1"):
+            retry_transport(
+                always_bad, retries=50, deadline_s=1e-9,
+                sleep=lambda _: None,
+            )
+        assert calls["n"] == 1
+
+    def test_elapsed_time_burns_the_budget(self):
+        """Attempts that consume wall clock count against the deadline
+        even when the sleep schedule alone would fit."""
+        from pytorch_distributed_rnn_tpu.resilience import retry_transport
+
+        now = {"t": 0.0}
+
+        def clock():
+            return now["t"]
+
+        calls = {"n": 0}
+
+        def slow_and_bad():
+            calls["n"] += 1
+            now["t"] += 0.6  # each attempt costs 0.6s of wall clock
+            raise RuntimeError(f"failure {calls['n']}")
+
+        with pytest.raises(RuntimeError, match="failure 1"):
+            retry_transport(
+                slow_and_bad, retries=10, deadline_s=1.0,
+                sleep=lambda _: None, clock=clock,
+            )
+        # attempt 1 at t=0.6 (delay fits), attempt 2 at t=1.2 (> budget)
+        assert calls["n"] == 2
+
+    def test_no_deadline_keeps_historical_behavior(self):
+        from pytorch_distributed_rnn_tpu.resilience import retry_transport
+
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] <= 2:
+                raise RuntimeError("transient")
+            return "ok"
+
+        assert retry_transport(flaky, retries=3,
+                               sleep=lambda _: None) == "ok"
+        assert calls["n"] == 3
+
+
+# ---------------------------------------------------------------------------
+# checkpoint_fallback structured event (ISSUE 7 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestCheckpointFallbackEvent:
+    def test_corrupt_fallback_emits_event(self, tmp_path):
+        from pytorch_distributed_rnn_tpu.data import MotionDataset
+        from pytorch_distributed_rnn_tpu.data.synthetic import (
+            generate_har_arrays,
+        )
+        from pytorch_distributed_rnn_tpu.models import MotionModel
+        from pytorch_distributed_rnn_tpu.resilience import resume_latest
+        from pytorch_distributed_rnn_tpu.training import Trainer
+
+        X, y = generate_har_arrays(96, seq_length=12, seed=0)
+        motion_set = MotionDataset(X, y)
+        model = MotionModel(input_dim=9, hidden_dim=8, layer_dim=1,
+                            output_dim=6)
+        t = Trainer(model, motion_set, batch_size=48, learning_rate=2.5e-3,
+                    seed=7, checkpoint_dir=tmp_path, checkpoint_every=1)
+        t.train(epochs=2)
+        latest = tmp_path / "checkpoint-epoch-2.ckpt"
+        latest.write_bytes(latest.read_bytes()[:50])  # truncate
+
+        rec = _ListRecorder()
+        fresh = Trainer(model, motion_set, batch_size=48,
+                        learning_rate=2.5e-3, seed=7)
+        fresh.recorder = rec
+        meta = resume_latest(fresh, tmp_path)
+        assert meta is not None and meta["epoch"] == 1
+        events = [e for e in rec.events
+                  if e["kind"] == "checkpoint_fallback"]
+        assert len(events) == 1
+        assert events[0]["path"].endswith("checkpoint-epoch-2.ckpt")
+        assert "header" in events[0]["reason"]  # 50-byte cut = header
+        assert events[0]["chosen"].endswith("checkpoint-epoch-1.ckpt")
+
+
+# ---------------------------------------------------------------------------
+# Observability: health drained, summarize counts, timeline lane
+# ---------------------------------------------------------------------------
+
+
+def _sidecar(path, rank, events):
+    now = time.time()
+    head = {"kind": "meta", "schema": 2, "rank": rank, "t": now - 300,
+            "tm": 0.0, "sample_every": 1}
+    lines = [head] + [
+        {"rank": rank, "t": now - 200, "tm": 100.0, **e} for e in events
+    ]
+    path.write_text("".join(json.dumps(e) + "\n" for e in lines))
+    return now
+
+
+class TestMembershipObservability:
+    def test_health_classifies_drained_rank_exit_zero(self, tmp_path,
+                                                      capsys):
+        from pytorch_distributed_rnn_tpu.obs.cli import main as metrics_main
+
+        now = _sidecar(tmp_path / "m.jsonl", 0, [
+            {"kind": "run_summary", "duration_s": 1.0},
+        ])
+        _sidecar(tmp_path / "m-r1.jsonl", 1, [
+            {"kind": "member_drain", "worker_id": 1, "rank_slot": 1,
+             "seq": 4},
+        ])
+        rc = metrics_main([
+            "health", str(tmp_path / "m.jsonl"),
+            "--now", str(now), "--stale-after", "30",
+        ])
+        out = capsys.readouterr().out
+        assert rc == 0  # drained is healthy - the satellite's contract
+        assert "rank 1: drained" in out
+
+    def test_health_dead_rank_still_flagged(self, tmp_path):
+        from pytorch_distributed_rnn_tpu.obs.cli import main as metrics_main
+
+        now = _sidecar(tmp_path / "m.jsonl", 0, [
+            {"kind": "run_summary", "duration_s": 1.0},
+        ])
+        _sidecar(tmp_path / "m-r1.jsonl", 1, [
+            {"kind": "step", "step": 0, "dispatch_s": 0.001},
+        ])
+        rc = metrics_main([
+            "health", str(tmp_path / "m.jsonl"),
+            "--now", str(now), "--stale-after", "30",
+        ])
+        assert rc == 1  # stale without a drain marker stays DEAD
+
+    def test_masters_worker_drain_does_not_drain_master(self, tmp_path):
+        """The master's sidecar carries member_drain events for its
+        WORKERS; rank 0 itself must not classify as drained."""
+        from pytorch_distributed_rnn_tpu.obs import load_events, rank_health
+
+        now = _sidecar(tmp_path / "m.jsonl", 0, [
+            {"kind": "member_drain", "worker_id": 2, "rank_slot": 2,
+             "seq": 3},
+        ])
+        report = rank_health(load_events(tmp_path / "m.jsonl"), now=now,
+                             stale_after=30)
+        assert report["status"] == "dead"  # stale master IS dead
+        assert not report["drained"]
+
+    def test_summarize_counts_membership_events(self, tmp_path):
+        from pytorch_distributed_rnn_tpu.obs.summary import summarize_file
+
+        _sidecar(tmp_path / "m.jsonl", 0, [
+            {"kind": "member_join", "worker_id": 1, "rank_slot": 1,
+             "via": "bootstrap", "rejoin": False},
+            {"kind": "member_join", "worker_id": 2, "rank_slot": 2,
+             "via": "register", "rejoin": True},
+            {"kind": "member_dead", "worker_id": 2, "rank_slot": 2},
+            {"kind": "member_drain", "worker_id": 1, "rank_slot": 1},
+            {"kind": "run_summary", "duration_s": 1.0,
+             "roster": {"joined": 0, "drained": 1, "dead": 0, "done": 1}},
+        ])
+        summary = summarize_file(tmp_path / "m.jsonl")
+        assert summary["member_joins"] == 2
+        assert summary["member_rejoins"] == 1
+        assert summary["member_deaths"] == 1
+        assert summary["member_drains"] == 1
+        assert summary["roster"]["done"] == 1
+
+    def test_summarize_membership_none_on_plain_runs(self, tmp_path):
+        from pytorch_distributed_rnn_tpu.obs.summary import summarize_file
+
+        _sidecar(tmp_path / "m.jsonl", 0, [
+            {"kind": "step", "step": 0, "dispatch_s": 0.001},
+        ])
+        summary = summarize_file(tmp_path / "m.jsonl")
+        assert summary["member_joins"] is None
+
+    def test_timeline_renders_membership_lane(self, tmp_path):
+        from pytorch_distributed_rnn_tpu.obs import validate_chrome_trace
+        from pytorch_distributed_rnn_tpu.obs.spans import SUBSYSTEM_TIDS
+        from pytorch_distributed_rnn_tpu.obs.timeline import (
+            build_chrome_trace,
+            load_run,
+        )
+
+        _sidecar(tmp_path / "m.jsonl", 0, [
+            {"kind": "member_join", "worker_id": 2, "rank_slot": 2,
+             "via": "register", "rejoin": True},
+            {"kind": "member_dead", "worker_id": 2, "rank_slot": 2},
+            {"kind": "span", "name": "state_sync", "cat": "member",
+             "dur_s": 0.01, "worker_id": 2},
+            {"kind": "checkpoint_fallback", "path": "x.ckpt",
+             "reason": "truncated", "chosen": "y.ckpt"},
+        ])
+        trace = build_chrome_trace(load_run(tmp_path / "m.jsonl"))
+        validate_chrome_trace(trace)
+        member_events = [
+            e for e in trace["traceEvents"] if e.get("cat") == "member"
+        ]
+        assert {e["name"] for e in member_events} == {
+            "member_join", "member_dead", "state_sync",
+        }
+        assert all(e["tid"] == SUBSYSTEM_TIDS["member"]
+                   for e in member_events)
+        dead = next(e for e in member_events if e["name"] == "member_dead")
+        assert dead["s"] == "p"  # process-scoped flash
+        ckpt = next(e for e in trace["traceEvents"]
+                    if e.get("name") == "checkpoint_fallback")
+        assert ckpt["cat"] == "ckpt"
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+
+def test_elastic_cli_flags_parse():
+    from pytorch_distributed_rnn_tpu.main import build_parser
+
+    args = build_parser().parse_args(
+        ["parameter-server", "--world-size", "3", "--elastic",
+         "--min-workers", "2", "--ps-max-respawns", "5",
+         "--ps-join-timeout", "12", "--ps-checkpoint-rounds", "4"]
+    )
+    assert args.elastic and args.min_workers == 2
+    assert args.ps_max_respawns == 5
+    assert args.ps_join_timeout == 12.0
+    assert args.ps_checkpoint_rounds == 4
+    rejoin = build_parser().parse_args(
+        ["parameter-server", "--world-size", "3", "--rank", "2",
+         "--ps-rejoin", "--ps-worker-id", "2"]
+    )
+    assert rejoin.ps_rejoin and rejoin.ps_worker_id == 2
+
+
+# ---------------------------------------------------------------------------
+# End-to-end drills (spawn-mode worlds; the acceptance tests)
+# ---------------------------------------------------------------------------
+
+
+def _ps_args(tmp_path, port, **kw):
+    args = Namespace(
+        checkpoint_directory=tmp_path / "models",
+        dataset_path=tmp_path / "har",
+        output_path=None,
+        stacked_layer=1,
+        hidden_units=8,
+        epochs=3,
+        validation_fraction=0.1,
+        batch_size=48,
+        learning_rate=2.5e-3,
+        dropout=0.0,
+        log="WARNING",
+        num_threads=2,
+        seed=7,
+        no_validation=True,
+        cell="lstm",
+        resume=None,
+        world_size=3,
+        rank=None,
+        master_address="127.0.0.1",
+        master_port=str(port),
+        ps_mode="sync",
+        ps_quorum=0.5,
+        ps_sync_timeout=60.0,
+        ps_transport_retries=2,
+        elastic=True,
+        min_workers=1,
+        ps_max_respawns=3,
+        ps_join_timeout=30.0,
+    )
+    for k, v in kw.items():
+        setattr(args, k, v)
+    return args
+
+
+@pytest.fixture()
+def har_dir(tmp_path):
+    from pytorch_distributed_rnn_tpu.data.synthetic import (
+        write_synthetic_har_dataset,
+    )
+
+    write_synthetic_har_dataset(
+        tmp_path / "har", num_train=120, num_test=16, seq_length=12
+    )
+    return tmp_path
+
+
+def _load_family(path):
+    from pytorch_distributed_rnn_tpu.obs.summary import rank_files
+
+    events = {}
+    for member in rank_files(path):
+        rows = [json.loads(line) for line in Path(member).read_text()
+                .splitlines() if line.strip()]
+        events[rows[0]["rank"]] = rows
+    return events
+
+
+@pytest.mark.chaos
+class TestElasticDrills:
+    def test_kill_respawn_rejoin_completes_full_strength(self, har_dir,
+                                                         monkeypatch):
+        """The acceptance drill: SIGKILL worker 2 mid-run; the
+        supervisor respawns it into the same worker-id; it REGISTERs,
+        state-syncs, re-enters the rounds; the roster ends at full
+        strength (done == 2, dead == 0) and the run exits 0 with a
+        finite history."""
+        from pytorch_distributed_rnn_tpu.param_server.runner import run
+
+        monkeypatch.chdir(har_dir)
+        args = _ps_args(har_dir, PORT + 41, faults="epoch:1:kill@2",
+                        metrics=str(har_dir / "m.jsonl"))
+        assert run(args) == 0
+
+        history = json.loads((har_dir / "history.json").read_text())
+        assert len(history["train_history"]) == 3
+        assert all(np.isfinite(history["train_history"]))
+
+        master_events = _load_family(har_dir / "m.jsonl")[0]
+        deaths = [e for e in master_events if e["kind"] == "member_dead"]
+        rejoins = [e for e in master_events
+                   if e["kind"] == "member_join" and e.get("rejoin")]
+        assert len(deaths) == 1 and deaths[0]["worker_id"] == 2
+        assert len(rejoins) == 1 and rejoins[0]["worker_id"] == 2
+        syncs = [e for e in master_events
+                 if e["kind"] == "span" and e.get("name") == "state_sync"]
+        assert len(syncs) == 1 and syncs[0]["worker_id"] == 2
+        run_summary = next(e for e in reversed(master_events)
+                           if e["kind"] == "run_summary")
+        assert run_summary["roster"] == {
+            "joined": 0, "drained": 0, "dead": 0, "done": 2,
+        }
+        assert run_summary["rejoins"] == 1
+
+    def test_sigterm_drain_exits_zero_and_health_reports_drained(
+        self, har_dir, monkeypatch, capsys
+    ):
+        """The drain drill: chaos `preempt` SIGTERMs worker 2; it
+        flushes its in-flight gradient (applied exactly once - the
+        master's round seq proves it), DEREGISTERs, exits 0; the master
+        roster records a drain, not a death; `pdrnn-metrics health`
+        reports the rank drained and exits 0."""
+        from pytorch_distributed_rnn_tpu.obs.cli import main as metrics_main
+        from pytorch_distributed_rnn_tpu.param_server.runner import run
+
+        monkeypatch.chdir(har_dir)
+        args = _ps_args(har_dir, PORT + 47, faults="epoch:1:preempt@2",
+                        metrics=str(har_dir / "m.jsonl"))
+        assert run(args) == 0
+
+        family = _load_family(har_dir / "m.jsonl")
+        master_events = family[0]
+        drains = [e for e in master_events if e["kind"] == "member_drain"]
+        assert len(drains) == 1 and drains[0]["worker_id"] == 2
+        assert not [e for e in master_events
+                    if e["kind"] == "member_dead"]
+        # exactly-once pin: the drained worker's final push seq appears
+        # in exactly ONE master round's contribution map
+        drained_seq = drains[0]["seq"]
+        rounds = [e for e in master_events
+                  if e["kind"] == "span" and e.get("name") == "ps_round"]
+        consuming = [r for r in rounds
+                     if r.get("seqs", {}).get("2") == drained_seq]
+        assert len(consuming) == 1
+        # the worker's own sidecar carries its drain marker too
+        worker_events = family[2]
+        assert any(e["kind"] == "member_drain" for e in worker_events)
+        # health: drained is healthy (exit 0), printed as such
+        rc = metrics_main([
+            "health", str(har_dir / "m.jsonl"), "--stale-after", "1.0",
+        ])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "rank 2: drained" in out
+
+    def test_respawn_action_drills_supervisor(self, har_dir, monkeypatch):
+        """The `respawn` chaos action (abrupt nonzero exit) drives the
+        same supervisor path as SIGKILL - the drill the action exists
+        for."""
+        from pytorch_distributed_rnn_tpu.param_server.runner import run
+
+        monkeypatch.chdir(har_dir)
+        args = _ps_args(har_dir, PORT + 53, faults="epoch:1:respawn@2",
+                        metrics=str(har_dir / "m.jsonl"))
+        assert run(args) == 0
+        master_events = _load_family(har_dir / "m.jsonl")[0]
+        run_summary = next(e for e in reversed(master_events)
+                           if e["kind"] == "run_summary")
+        assert run_summary["roster"]["done"] == 2
+        assert run_summary["rejoins"] == 1
