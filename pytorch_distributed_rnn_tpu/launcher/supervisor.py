@@ -55,10 +55,17 @@ class ElasticSupervisor:
 
     def __init__(self, spawn_worker, *, min_workers: int = 1,
                  max_respawns: int = 3, respawn_delay_s: float = 0.1,
-                 poll_s: float = 0.05):
+                 poll_s: float = 0.05, on_event=None):
         """``spawn_worker(rank, worker_id, rejoin) -> process`` launches
         one worker process (``process`` needs ``is_alive()``,
-        ``exitcode`` and ``terminate()``/``join()``)."""
+        ``exitcode`` and ``terminate()``/``join()``).
+
+        ``on_event(kind, **fields)`` is an optional observer hook fired
+        on supervision transitions (``worker_respawn`` / ``worker_lost``
+        / ``pool_collapse``): the runner wires it to the live plane's
+        alert pusher (``obs/live.EventPusher``) so the fleet aggregator
+        sees supervisor actions as structured alerts - the supervisor
+        itself stays transport-agnostic.  Hook failures are swallowed."""
         self._spawn_worker = spawn_worker
         self.min_workers = int(min_workers)
         self.max_respawns = int(max_respawns)
@@ -66,6 +73,15 @@ class ElasticSupervisor:
         self.poll_s = float(poll_s)
         self.slots: dict[int, _Slot] = {}
         self.total_respawns = 0
+        self._on_event = on_event
+
+    def _emit(self, kind: str, **fields) -> None:
+        if self._on_event is None:
+            return
+        try:
+            self._on_event(kind, **fields)
+        except Exception:  # observability must never kill supervision
+            log.exception(f"supervisor: on_event({kind}) hook failed")
 
     def launch(self, ranks) -> None:
         """Spawn the initial worker set (worker-id == launch rank)."""
@@ -107,6 +123,9 @@ class ElasticSupervisor:
                     f"(exit {code}) with no respawn budget left "
                     f"({self.max_respawns} used)"
                 )
+                self._emit("worker_lost", worker_id=slot.worker_id,
+                           rank=slot.rank, exit_code=code,
+                           respawns_used=slot.respawns)
                 continue
             slot.respawns += 1
             self.total_respawns += 1
@@ -115,11 +134,19 @@ class ElasticSupervisor:
                 f"(exit {code}); respawning into rank {slot.rank} "
                 f"(respawn {slot.respawns}/{self.max_respawns})"
             )
+            self._emit("worker_respawn", worker_id=slot.worker_id,
+                       rank=slot.rank, exit_code=code,
+                       respawn=slot.respawns,
+                       max_respawns=self.max_respawns)
             time.sleep(self.respawn_delay_s)
             slot.process = self._spawn_worker(
                 slot.rank, slot.worker_id, True
             )
-        return self._live_or_completed() >= self.min_workers
+        healthy = self._live_or_completed() >= self.min_workers
+        if not healthy:
+            self._emit("pool_collapse", min_workers=self.min_workers,
+                       live_or_completed=self._live_or_completed())
+        return healthy
 
     def supervise(self, until_exit) -> bool:
         """Supervision loop: poll until ``until_exit()`` returns an exit

@@ -215,6 +215,23 @@ def build_parser() -> argparse.ArgumentParser:
         "step's outputs to measure true step wall time (default 16); "
         "the other steps stay fully async",
     )
+    parser.add_argument(
+        "--live", default=None, metavar="[HOST:]PORT",
+        help="live observability plane (obs/live.py; needs --metrics): "
+        "rank 0 serves GET /metrics (Prometheus text), /health "
+        "(ok/stalled/dead/drained per rank), /events (recent alerts) "
+        "and /fleet on this address; other ranks push digests to it.  "
+        "Arms the anomaly watchdog (in-run stall detection with "
+        "all-thread stack dumps, NaN streaks, loss spikes; tune via "
+        "PDRNN_WATCHDOG_STALL seconds, disable with PDRNN_WATCHDOG=0).  "
+        "Also read from the PDRNN_LIVE env when the flag is absent.  "
+        "Watch it live with `pdrnn-metrics watch HOST:PORT`",
+    )
+    parser.add_argument(
+        "--live-port-file", default=None, type=Path, metavar="PATH",
+        help="write 'host port' of the live endpoint here once bound "
+        "(how scripts and tests find a --live 0 ephemeral port)",
+    )
 
     sub_parser = parser.add_subparsers(
         title="Available commands", metavar="command [options ...]"

@@ -10,12 +10,33 @@
   per-rank liveness (``rank_health``).
 - :mod:`.timeline`: cross-rank clock alignment, Chrome-trace/Perfetto
   export + validator, phase attribution.
-- :mod:`.cli`: the ``pdrnn-metrics`` CLI over all of the above.
+- :mod:`.live`: the live plane - rolling windows, digest exporter (no
+  thread of its own: rides the recorder's writer thread), and the
+  per-process ``LivePlane`` wiring (``--live`` / ``PDRNN_LIVE``).
+- :mod:`.aggregator`: rank-0/master digest aggregation + the stdlib
+  HTTP server behind ``GET /metrics`` (Prometheus), ``/health``,
+  ``/events`` and ``/fleet``.
+- :mod:`.watchdog`: in-run anomaly detection (stall / NaN streak / loss
+  spike / serving SLO) with all-thread stack dumps, plus the SIGUSR2
+  on-demand dump hook every long-lived entrypoint installs.
+- :mod:`.cli`: the ``pdrnn-metrics`` CLI over all of the above
+  (including ``watch``, the live fleet table).
 
 This package imports neither jax nor the training stack at module
 import time, so CLI startup and jax-free tooling stay cheap.
 """
 
+from pytorch_distributed_rnn_tpu.obs.aggregator import (
+    Aggregator,
+    AggregatorServer,
+    render_prometheus,
+)
+from pytorch_distributed_rnn_tpu.obs.live import (
+    LIVE_ENV,
+    LiveExporter,
+    LivePlane,
+    RollingWindow,
+)
 from pytorch_distributed_rnn_tpu.obs.profile import StepTraceCapture
 from pytorch_distributed_rnn_tpu.obs.recorder import (
     METRICS_ENV,
@@ -38,6 +59,11 @@ from pytorch_distributed_rnn_tpu.obs.summary import (
     summarize_file,
     summarize_run,
 )
+from pytorch_distributed_rnn_tpu.obs.watchdog import (
+    AnomalyWatchdog,
+    dump_stacks,
+    install_stack_dump_handler,
+)
 from pytorch_distributed_rnn_tpu.obs.timeline import (
     attribute_rank,
     attribute_run,
@@ -50,15 +76,25 @@ from pytorch_distributed_rnn_tpu.obs.timeline import (
 )
 
 __all__ = [
+    "Aggregator",
+    "AggregatorServer",
+    "AnomalyWatchdog",
+    "LIVE_ENV",
+    "LiveExporter",
+    "LivePlane",
     "METRICS_ENV",
     "METRICS_HEARTBEAT_ENV",
     "METRICS_SAMPLE_ENV",
     "NULL_RECORDER",
+    "RollingWindow",
     "SCHEMA_VERSION",
     "MalformedMetricsError",
     "MetricsRecorder",
     "NullRecorder",
     "StepTraceCapture",
+    "dump_stacks",
+    "install_stack_dump_handler",
+    "render_prometheus",
     "attribute_rank",
     "attribute_run",
     "attribute_stragglers",

@@ -19,6 +19,7 @@ Examples::
   pdrnn-metrics timeline metrics.jsonl -o run.trace.json  # -> Perfetto
   pdrnn-metrics attribute metrics.jsonl    # phase fractions + blame
   pdrnn-metrics health metrics.jsonl --stale-after 30
+  pdrnn-metrics watch 127.0.0.1:9100       # live fleet table (aggregator)
 """
 
 from __future__ import annotations
@@ -26,6 +27,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 from pathlib import Path
 
 from pytorch_distributed_rnn_tpu.obs.summary import (
@@ -52,6 +54,8 @@ _SUMMARY_FIELDS = (
     ("memory_mb", "{:.1f}"),
     ("device_peak_mb", "{:.1f}"),
     ("nan_skipped", "{:d}"),
+    ("alerts", "{:d}"),
+    ("alerts_by_kind", "{}"),
     ("ps_exchanges", "{:d}"),
     ("ps_retries", "{:d}"),
     ("ps_degraded_rounds", "{:d}"),
@@ -160,6 +164,25 @@ def main(argv=None) -> int:
                    "pass a run-contemporary stamp for post-hoc checks)")
     p.add_argument("--json", action="store_true")
 
+    p = sub.add_parser(
+        "watch",
+        help="poll a live aggregator (obs/aggregator.py - the --live "
+        "flag / PDRNN_LIVE run-side) and render the fleet table: one "
+        "row per source with status, step-time window, loss, queue "
+        "depth and recent alerts",
+    )
+    p.add_argument("target", help="aggregator address (HOST:PORT or "
+                   "http://HOST:PORT)")
+    p.add_argument("--interval", type=float, default=2.0, metavar="S",
+                   help="poll cadence in seconds (default 2)")
+    p.add_argument("--once", action="store_true",
+                   help="print one snapshot and exit (0 healthy, 1 if "
+                   "any source is stalled/dead - the health exit "
+                   "contract)")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw fleet+events JSON instead of the "
+                   "table (implies --once)")
+
     args = parser.parse_args(argv)
     try:
         return _dispatch(args)
@@ -205,6 +228,8 @@ def _dispatch(args) -> int:
         return _attribute(args)
     if args.cmd == "health":
         return _health(args)
+    if args.cmd == "watch":
+        return _watch(args)
 
     # stragglers
     summaries = [summarize_file(p) for p in _expand_families(args.files)]
@@ -320,6 +345,89 @@ def _attribute(args) -> int:
             f"{f['phase']} (+{f['phase_excess_s']:.6f}s/step vs median)"
         )
     return 1 if flagged else 0
+
+
+def _watch_fetch(base: str, path: str):
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(base + path, timeout=5.0) as resp:
+            return json.loads(resp.read())
+    except OSError as exc:
+        # /health replies 503 when a source is stalled/dead - that is a
+        # VALID payload for the watch table, not a fetch failure
+        body = getattr(exc, "read", lambda: None)()
+        if body:
+            try:
+                return json.loads(body)
+            except ValueError:
+                pass
+        raise MalformedMetricsError(
+            f"{base}{path}: aggregator unreachable ({exc})"
+        ) from exc
+
+
+def _watch_row(source_id: str, digest: dict) -> str:
+    step = digest.get("step_s") or {}
+    loss = digest.get("loss") or {}
+    depth = digest.get("queue_depth") or {}
+    serving = digest.get("serving") or {}
+
+    def num(value, fmt="{:.4f}"):
+        return fmt.format(value) if value is not None else "-"
+
+    return (
+        f"{source_id:>14} {str(digest.get('status', '?')):>9} "
+        f"{num(digest.get('progress'), '{:d}'):>8} "
+        f"{num(step.get('p50')):>10} {num(step.get('p95')):>10} "
+        f"{num(loss.get('last')):>10} "
+        f"{num(depth.get('last'), '{:.0f}'):>6} "
+        f"{num(serving.get('req_per_s_60s'), '{:.1f}'):>7} "
+        f"{num(digest.get('alerts_total'), '{:d}'):>7}"
+    )
+
+
+def _watch(args) -> int:
+    base = args.target
+    if "://" not in base:
+        base = f"http://{base}"
+    base = base.rstrip("/")
+    header = (
+        f"{'source':>14} {'status':>9} {'step':>8} {'p50_s':>10} "
+        f"{'p95_s':>10} {'loss':>10} {'queue':>6} {'req/s':>7} "
+        f"{'alerts':>7}"
+    )
+    while True:
+        fleet = _watch_fetch(base, "/fleet")
+        events = _watch_fetch(base, "/events")
+        sources = fleet.get("sources") or {}
+        flagged = any(
+            d.get("status") in ("stalled", "dead")
+            for d in sources.values()
+        )
+        if args.json:
+            print(json.dumps({"fleet": fleet, "events": events}, indent=1))
+            return 1 if flagged else 0
+        print(f"== {base} @ {time.strftime('%H:%M:%S')} "
+              f"({len(sources)} source(s))")
+        print(header)
+        for source_id in sorted(sources):
+            line = _watch_row(source_id, sources[source_id])
+            if sources[source_id].get("status") in ("stalled", "dead"):
+                line = line.upper()
+            print(line)
+        for event in events[-5:]:
+            print(
+                f"  ALERT {event.get('source', '?')}: "
+                f"{event.get('alert', '?')} "
+                f"[{event.get('severity', '?')}] seq={event.get('seq')}"
+            )
+        if args.once:
+            return 1 if flagged else 0
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:  # pragma: no cover - interactive
+            return 0
 
 
 def _health(args) -> int:

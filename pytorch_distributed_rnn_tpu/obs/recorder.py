@@ -67,6 +67,16 @@ member_dead         worker_id, rank_slot, error + roster counts -
                     REGISTER
 checkpoint_fallback path, reason, chosen - a corrupt checkpoint was
                     skipped during --resume auto and resume fell back
+alert               alert (stall | stall_cleared | nan_streak |
+                    loss_spike | slo_breach | slo_recovered | straggler
+                    | worker_respawn | worker_lost | pool_collapse),
+                    severity (warning|info), seq (per-emitter monotone)
+                    + detector fields; chaos_fired carries the fault
+                    schedule's fired counters when chaos is active and
+                    fleet=True marks aggregator-born findings
+                    (obs/watchdog.py + obs/aggregator.py; the live
+                    plane's /events and the Prometheus exposition in
+                    obs/aggregator.py mirror this stream)
 profile             dir, start, stop, captured
 run_summary         memory_mb, duration_s, device_peaks_mb, steps,
                     nan_skipped, faults_fired; the PS master's variant
@@ -144,6 +154,15 @@ class NullRecorder:
     def note_progress(self, step: int) -> None:  # noqa: PD105 - null object
         pass
 
+    progress = None
+
+    def attach_live(self, live) -> None:
+        raise RuntimeError(
+            "live export needs an enabled recorder (--metrics / "
+            "PDRNN_METRICS); the null recorder has no event stream to "
+            "window"
+        )
+
     def flush(self) -> None:  # noqa: PD105 - null object by design
         pass
 
@@ -195,6 +214,10 @@ class MetricsRecorder:
         # bare int store, read by the writer thread's heartbeats so a
         # stalled rank's heartbeats visibly stop advancing
         self._progress = None
+        # the live plane (obs/live.py): None unless attach_live was
+        # called - record() feeds it and the writer thread pushes its
+        # digests, so live export adds NO thread of its own
+        self._live = None
         # wall<->monotonic anchor: t and tm below describe the SAME
         # instant, so anchor + any event's tm reconstructs its wall time
         # on THIS rank's clock (obs/timeline.py aligns across ranks)
@@ -253,6 +276,12 @@ class MetricsRecorder:
         if "tm" in fields and "t" not in fields:
             event["t"] = self._anchor + float(fields["tm"])
         event.update(fields)
+        live = self._live
+        if live is not None:
+            try:
+                live.observe_event(event)
+            except Exception:  # live telemetry must never kill the run
+                log.exception("live window update failed")
         with self._lock:
             self._buffer.append(event)
             signal = len(self._buffer) >= self._flush_threshold
@@ -281,6 +310,17 @@ class MetricsRecorder:
         fresh, progress frozen) from a dead one (heartbeats stale)."""
         self._progress = int(step)
 
+    @property
+    def progress(self) -> int | None:
+        """The last ``note_progress`` value (live-plane/watchdog read)."""
+        return self._progress
+
+    def attach_live(self, live) -> None:
+        """Bind a live exporter (obs/live.py): ``record`` feeds its
+        rolling windows and the writer thread pushes its digests on the
+        existing wake cadence - live export adds no thread here."""
+        self._live = live
+
     def is_sample_step(self, step: int) -> bool:
         """Whether this step pays the fencing round-trip (step wall-time
         measurement): every ``sample_every``-th step, plus step 1 - the
@@ -305,6 +345,12 @@ class MetricsRecorder:
                         progress=self._progress,
                     )
                     next_hb = now + self._heartbeat_every
+            live = self._live
+            if live is not None:
+                try:
+                    live.maybe_push()
+                except Exception:  # pragma: no cover - must never kill
+                    log.exception("live digest push failed")
             self._drain()
         self._drain()
 
@@ -340,6 +386,15 @@ class MetricsRecorder:
         self._wake.set()
         self._thread.join(timeout=5.0)
         self._drain()
+        live = self._live
+        if live is not None:
+            # final digest AFTER the last drain: it carries the
+            # run_summary-derived finished flag, so a live /health shows
+            # the source finished instead of going dead
+            try:
+                live.push_now()
+            except Exception:  # pragma: no cover - must never kill
+                log.exception("final live digest push failed")
 
     def __del__(self):  # pragma: no cover - GC timing is interpreter-specific
         try:

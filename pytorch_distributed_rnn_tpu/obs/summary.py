@@ -212,6 +212,19 @@ def summarize_events(events: list[dict], path=None) -> dict:
             )
         ),
     }
+    # watchdog alerts (obs/watchdog.py): count + by-kind breakdown;
+    # None (not 0) on alert-free runs so the text summary stays quiet
+    alerts = [e for e in events if e["kind"] == "alert"]
+    if alerts:
+        by_kind: dict[str, int] = {}
+        for e in alerts:
+            kind = str(e.get("alert", "?"))
+            by_kind[kind] = by_kind.get(kind, 0) + 1
+        summary["alerts"] = len(alerts)
+        summary["alerts_by_kind"] = by_kind
+    else:
+        summary["alerts"] = None
+        summary["alerts_by_kind"] = None
     # elastic membership (resilience/membership.py): transition counts
     # off this rank's stream - the master's sidecar carries the whole
     # roster story, workers their own join/drain.  None (not 0) on
@@ -288,8 +301,10 @@ def diff_summaries(baseline: dict, candidate: dict,
 
 
 # events that witness forward progress (vs mere liveness): everything a
-# run emits except the writer thread's own heartbeats and the meta head
-_NON_PROGRESS_KINDS = ("meta", "heartbeat")
+# run emits except the writer thread's own heartbeats, the meta head,
+# and watchdog alerts - a STALL alert is evidence of the opposite of
+# progress, and counting it would flip the stalled rank back to ok
+_NON_PROGRESS_KINDS = ("meta", "heartbeat", "alert")
 
 
 def rank_health(events: list[dict], now: float | None = None,

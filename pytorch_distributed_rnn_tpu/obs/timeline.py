@@ -357,6 +357,9 @@ def build_chrome_trace(by_rank: dict[int, list[dict]],
                 scope = "p" if kind in _INSTANT_PROCESS_SCOPE else "t"
                 cat = {
                     "fault": "resilience", "nan_skip": "resilience",
+                    # watchdog findings land on the resilience row next
+                    # to the faults they often correlate with
+                    "alert": "resilience",
                     "checkpoint_fallback": "ckpt",
                     "heartbeat": "sys", "collectives": "sys",
                     "profile": "sys", "eval": "eval",

@@ -24,6 +24,7 @@ from __future__ import annotations
 import json
 import logging
 import multiprocessing as mp
+import os
 import threading
 
 import jax
@@ -31,6 +32,8 @@ import numpy as np
 import optax
 from jax.flatten_util import ravel_pytree
 
+from pytorch_distributed_rnn_tpu.obs.live import LIVE_ENV
+from pytorch_distributed_rnn_tpu.obs.recorder import METRICS_ENV
 from pytorch_distributed_rnn_tpu.runtime import Communicator
 
 log = logging.getLogger(__name__)
@@ -193,6 +196,20 @@ def run_master(args):
     # degradations, membership transitions and dead workers land next to
     # the workers' step events
     recorder = MetricsRecorder.resolve(args, rank=0, meta={"role": "master"})
+    # live plane: the master anchors the /metrics + /health aggregator
+    # (the digests it ingests include its own - roster story included -
+    # and every worker's); SIGUSR2 dumps all-thread stacks on demand
+    plane = None
+    if recorder.enabled:
+        from pytorch_distributed_rnn_tpu.obs.live import LivePlane
+        from pytorch_distributed_rnn_tpu.obs.watchdog import (
+            install_stack_dump_handler,
+        )
+
+        install_stack_dump_handler(recorder.path)
+        # no chaos annotation here: fault schedules fire in the workers
+        # (the master applies updates, it does not run the data path)
+        plane = LivePlane.resolve(args, recorder, rank=0, role="master")
     comm = Communicator(
         args.master_address, int(args.master_port), 0, args.world_size
     )
@@ -218,6 +235,8 @@ def run_master(args):
             ckpt_writer.close()
         comm.close()
         recorder.close()
+        if plane is not None:
+            plane.close()
     return final
 
 
@@ -281,6 +300,19 @@ def run_worker(args, rank: int, worker_id: int | None = None,
     recorder = MetricsRecorder.resolve(
         args, rank=rank, meta={"role": "worker", "rejoin": rejoin}
     )
+    # live plane: workers push digests to the master's aggregator (the
+    # --live address is shared via the spawned args / PDRNN_LIVE env);
+    # each worker runs its own stall watchdog + SIGUSR2 dump hook
+    plane = None
+    if recorder.enabled:
+        from pytorch_distributed_rnn_tpu.obs.live import LivePlane
+        from pytorch_distributed_rnn_tpu.obs.watchdog import (
+            install_stack_dump_handler,
+        )
+
+        install_stack_dump_handler(recorder.path)
+        plane = LivePlane.resolve(args, recorder, rank=rank,
+                                  role="worker", faults=faults)
     train_history = None
     try:
         trainer = trainer_class(
@@ -324,6 +356,8 @@ def run_worker(args, rank: int, worker_id: int | None = None,
     finally:
         comm.close()
         recorder.close()
+        if plane is not None:
+            plane.close()
 
     if rank == 1 and train_history is not None:
         with open("history.json", "w") as file:
@@ -364,10 +398,32 @@ def _run_elastic(args, ctx):
         p.start()
         return p
 
+    # supervisor events -> fleet alerts: the parent process has no
+    # recorder (rank 0's sidecar belongs to the master child), so
+    # respawn/collapse findings go straight to the aggregator over the
+    # live plane's push contract
+    on_event = None
+    live_spec = getattr(args, "live", None) or os.environ.get(LIVE_ENV)
+    if live_spec and (
+        getattr(args, "metrics", None) or os.environ.get(METRICS_ENV)
+    ):
+        from pytorch_distributed_rnn_tpu.obs.live import (
+            EventPusher,
+            parse_live_spec,
+            resolve_push_url,
+        )
+
+        host, port = parse_live_spec(live_spec)
+        # lazy sink: with --live 0 the master CHILD binds the port after
+        # this point - the port file is only readable at push time
+        on_event = EventPusher(
+            lambda: resolve_push_url(args, host, port, wait_s=2.0)
+        ).push
     supervisor = ElasticSupervisor(
         spawn_worker,
         min_workers=int(getattr(args, "min_workers", 1) or 1),
         max_respawns=int(getattr(args, "ps_max_respawns", 3)),
+        on_event=on_event,
     )
     supervisor.launch(range(1, args.world_size))
     healthy = supervisor.supervise(lambda: master.exitcode)

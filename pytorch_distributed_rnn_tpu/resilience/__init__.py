@@ -11,7 +11,11 @@ killed the run.  This package supplies both sides:
   gradients, simulated preemption (SIGKILL), and network delay/loss -
   the latter bridged onto the native transport's ``PDRNN_FAULT_*``
   contract so the bench netem sweep and the chaos tests share one
-  mechanism.
+  mechanism.  The live anomaly watchdog (``obs/watchdog.py``) closes
+  the loop from the other side: every alert it emits carries the
+  schedule's :meth:`FaultSchedule.fired_snapshot`, so injected faults
+  and organic anomalies are distinguishable in the event stream - the
+  chaos ``stall`` drill is the live plane's acceptance test.
 - ``guard``: the :class:`NonFiniteGuard` (XLA-level skip of non-finite
   updates, host-level abort after K consecutive bad steps) and
   checkpoint auto-resume with fallback across corrupt files.

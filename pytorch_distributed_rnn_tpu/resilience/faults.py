@@ -279,6 +279,14 @@ class FaultSchedule:
                 if random.Random(mixed).random() < e.at:
                     yield e
 
+    def fired_snapshot(self) -> dict[str, int]:
+        """Copy of the fired-fault counters (``{action: count}``).  The
+        anomaly watchdog (``obs/watchdog.py``) stamps this onto every
+        alert it emits as ``chaos_fired``, so a drill's INJECTED stall
+        is distinguishable from an organic hang in the event stream -
+        the watchdog <-> chaos contract."""
+        return dict(self.fired)
+
     def _fire(self, event: FaultEvent, where: str):
         self.fired[event.action] = self.fired.get(event.action, 0) + 1
         log.warning(f"chaos: injecting {event} at {where}")

@@ -119,6 +119,19 @@ def build_serve_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument("--metrics", default=None, type=Path, metavar="PATH")
     parser.add_argument("--metrics-sample-every", default=None, type=int)
+    parser.add_argument(
+        "--live", default=None, metavar="[HOST:]PORT",
+        help="live observability plane (obs/live.py; needs --metrics): "
+        "serve GET /metrics (Prometheus), /health, /events and /fleet "
+        "on this address, with the anomaly watchdog (stall / NaN / SLO "
+        "breach alerts + stack dumps) armed; also read from the "
+        "PDRNN_LIVE env.  SLO threshold via PDRNN_WATCHDOG_SLO_P95_MS",
+    )
+    parser.add_argument(
+        "--live-port-file", default=None, type=Path, metavar="PATH",
+        help="write 'host port' of the live endpoint here once bound "
+        "(how scripts find a --live 0 ephemeral port)",
+    )
     parser.add_argument("--log", default="INFO")
     return parser
 
@@ -199,12 +212,29 @@ def serve_main(argv=None) -> int:
     faults = FaultSchedule.resolve(args)
     if faults is not None:
         log.warning(f"pdrnn-serve: chaos schedule active: {faults}")
+    if recorder.enabled:
+        # on-demand hang diagnosis: kill -USR2 <pid> dumps all-thread
+        # stacks next to the sidecar (obs/watchdog.py)
+        from pytorch_distributed_rnn_tpu.obs.watchdog import (
+            install_stack_dump_handler,
+        )
+
+        install_stack_dump_handler(recorder.path)
     engine = ServingEngine(
         adapter_for(model), params, num_slots=args.slots,
         bucket_spec=BucketSpec.parse(args.prompt_buckets),
         max_new_tokens=args.max_new_tokens, max_queue=args.max_queue,
         recorder=recorder, faults=faults,
     )
+    # live plane: /metrics + /health + /events served from this process
+    # (the serving engine IS the rank-0 anchor), with the engine's gauge
+    # block riding every digest
+    from pytorch_distributed_rnn_tpu.obs.live import LivePlane
+
+    plane = LivePlane.resolve(args, recorder, rank=0, role="serve",
+                              faults=faults)
+    if plane is not None:
+        plane.exporter.add_source(engine.live_source)
     if not args.no_warmup:
         engine.warmup()
     server = ServingServer(
@@ -230,6 +260,10 @@ def serve_main(argv=None) -> int:
     while not stop.is_set():
         stop.wait(timeout=0.5)
     server.shutdown()
+    if plane is not None:
+        # after server.shutdown(): the recorder's close pushed the final
+        # finished digest, so the last scrape-able state is honest
+        plane.close()
     stats = engine.stats()
     log.info(
         f"pdrnn-serve: served {stats['requests']} requests "

@@ -51,6 +51,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from pytorch_distributed_rnn_tpu.obs.live import RATE_HORIZON_S, RollingWindow
 from pytorch_distributed_rnn_tpu.obs.recorder import NULL_RECORDER
 from pytorch_distributed_rnn_tpu.obs.summary import percentile
 from pytorch_distributed_rnn_tpu.resilience.faults import ChaosError
@@ -189,6 +190,12 @@ class ServingEngine:
         self._queue_depths: deque[int] = deque(maxlen=_DEPTH_WINDOW)
         self._requests_failed = 0
         self._chaos_exceptions = 0
+        # time-bounded rate windows (obs/live.py RollingWindow - THE
+        # windowing implementation, shared with the live exporter):
+        # completions observe the request's token count (so one window
+        # yields both req/s and tokens/s), sheds observe 1
+        self._completions = RollingWindow(RATE_HORIZON_S)
+        self._sheds = RollingWindow(RATE_HORIZON_S)
 
     # -- construction helpers ------------------------------------------------
 
@@ -270,6 +277,8 @@ class ServingEngine:
             admitted = self.batcher.admit(request)
             if admitted:
                 self._work.notify_all()
+        if not admitted and request.status == "shed":
+            self._sheds.observe(1.0)
         return admitted
 
     # -- serve loop (one thread) ---------------------------------------------
@@ -372,6 +381,7 @@ class ServingEngine:
             request.status = "done"
         self._requests_done += 1
         self._tokens_out += len(request.tokens)
+        self._completions.observe(len(request.tokens))
         with self._stats_lock:
             if request.latency_s is not None:
                 self._latencies.append(request.latency_s)
@@ -461,6 +471,11 @@ class ServingEngine:
             "tokens_out": self._tokens_out,
             "tokens_per_s": self._tokens_out / elapsed if elapsed > 0
             else None,
+            # rolling-window rates (last RATE_HORIZON_S seconds, honest
+            # early in the run: the divisor is the window's actual age)
+            "req_per_s_60s": self._completions.count_rate(),
+            "tokens_per_s_60s": self._completions.sum_rate(),
+            "shed_per_s_60s": self._sheds.count_rate(),
             "latency_s_p50": percentile(lat, 0.50) if lat else None,
             "latency_s_p95": percentile(lat, 0.95) if lat else None,
             "ttft_s_p50": percentile(ttft, 0.50) if ttft else None,
@@ -475,6 +490,23 @@ class ServingEngine:
             "chaos_absorbed": self._chaos_exceptions,
             "trace_counts": dict(self._trace_counts),
         }
+
+    def live_source(self) -> dict:
+        """Digest contribution for the live exporter
+        (``LiveExporter.add_source``): the serving gauge block behind
+        the aggregator's ``pdrnn_serving_*`` Prometheus series and the
+        watchdog's SLO detector - the same numbers the ``stats`` op
+        serves, under one ``serving`` key."""
+        stats = self.stats()
+        return {"serving": {
+            k: stats.get(k) for k in (
+                "requests", "requests_shed", "requests_failed",
+                "tokens_out", "queue_depth", "active",
+                "req_per_s_60s", "tokens_per_s_60s", "shed_per_s_60s",
+                "latency_s_p50", "latency_s_p95",
+                "ttft_s_p50", "ttft_s_p95",
+            )
+        }}
 
     def close(self):
         """Abort queued AND in-flight requests (their clients get an

@@ -163,6 +163,23 @@ def _run_trainer(args, trainer_class, model, datasets):
     recorder = MetricsRecorder.resolve(args, rank=jax.process_index())
     profile_steps = StepTraceCapture.resolve(args)
 
+    # live plane (obs/live.py): --live / PDRNN_LIVE - rank 0 serves the
+    # /metrics + /health aggregator, every rank runs the watchdog; None
+    # (nothing constructed, no threads) when live export is off
+    plane = None
+    if recorder.enabled:
+        from pytorch_distributed_rnn_tpu.obs.live import LivePlane
+        from pytorch_distributed_rnn_tpu.obs.watchdog import (
+            install_stack_dump_handler,
+        )
+
+        # kill -USR2 <pid>: all-thread stack dump next to the sidecar
+        install_stack_dump_handler(recorder.path)
+        plane = LivePlane.resolve(
+            args, recorder, rank=jax.process_index(), role="trainer",
+            faults=faults,
+        )
+
     training_set, validation_set, test_set = datasets
     trainer = trainer_class(
         model=model,
@@ -221,8 +238,11 @@ def _run_trainer(args, trainer_class, model, datasets):
     finally:
         # the writer thread must drain even when training raises - the
         # partial telemetry of a crashed run is exactly what the perf-line
-        # pipeline always lost
+        # pipeline always lost.  Plane closes AFTER the recorder so the
+        # final (finished) digest lands before the HTTP server goes away.
         recorder.close()
+        if plane is not None:
+            plane.close()
     history = {
         "train_history": train_history,
         "validation_history": validation_history,

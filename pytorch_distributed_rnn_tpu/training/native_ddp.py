@@ -226,6 +226,18 @@ def run_rank(comm, args, model, datasets, trainer_class=None):
     # per-process profilers would otherwise race one hostname-keyed
     # xplane file in the shared trace dir
     profile_steps = StepTraceCapture.resolve(args) if comm.rank == 0 else None
+    # live plane: rank 0 anchors the /metrics aggregator, other ranks
+    # push digests to it; SIGUSR2 dumps stacks next to the sidecar
+    plane = None
+    if recorder.enabled:
+        from pytorch_distributed_rnn_tpu.obs.live import LivePlane
+        from pytorch_distributed_rnn_tpu.obs.watchdog import (
+            install_stack_dump_handler,
+        )
+
+        install_stack_dump_handler(recorder.path)
+        plane = LivePlane.resolve(args, recorder, rank=comm.rank,
+                                  role="trainer", faults=faults)
     trainer = (trainer_class or NativeDDPTrainer)(
         comm=comm,
         model=model,
@@ -269,6 +281,8 @@ def run_rank(comm, args, model, datasets, trainer_class=None):
         )
     finally:
         recorder.close()
+        if plane is not None:
+            plane.close()
     # the rank-parity observable (reference example_ddp.py:92 prints the
     # same quantity): identical on every rank iff replicas stayed in sync
     flat, _ = ravel_pytree(trainer.params)

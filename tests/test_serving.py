@@ -251,6 +251,64 @@ def test_serving_telemetry_summarizes_and_exports(tmp_path):
     assert "prefill" in names and "request" in names
 
 
+def test_stats_rolling_window_rates(tmp_path):
+    """The stats op's rolling-window rates (req/s, tokens/s, shed/s over
+    the shared RATE_HORIZON_S window) come from obs/live.RollingWindow -
+    the SAME windowing implementation the live exporter digests use."""
+    from pytorch_distributed_rnn_tpu.obs.live import RollingWindow
+
+    model = small_char()
+    engine, _ = make_engine(model, max_queue=2)
+    assert isinstance(engine._completions, RollingWindow)
+    engine.warmup()
+    stats = engine.stats()
+    assert stats["req_per_s_60s"] == 0.0
+    assert stats["shed_per_s_60s"] == 0.0
+    requests = mixed_requests(model, 6, np.random.RandomState(2))
+    for r in requests:
+        engine.submit(r)
+        engine.drain()
+    stats = engine.stats()
+    assert stats["req_per_s_60s"] > 0
+    assert stats["tokens_per_s_60s"] > 0
+    # tokens/s over the window must reconcile with the totals: both are
+    # sums over the SAME completions, the rate just divides by window age
+    assert stats["tokens_per_s_60s"] == pytest.approx(
+        stats["req_per_s_60s"] * stats["tokens_out"] / stats["requests"],
+        rel=0.3,
+    )
+    # overflow the 2-deep queue without draining: the overflow sheds
+    backlog = mixed_requests(model, 8, np.random.RandomState(3))
+    shed = sum(0 if engine.submit(r) else 1 for r in backlog)
+    assert shed > 0
+    assert engine.stats()["shed_per_s_60s"] > 0
+    engine.drain()
+    engine.close()
+
+
+def test_live_source_mirrors_stats_op():
+    """The digest block the live exporter pushes and the TCP stats op
+    answer with the same numbers - one accounting, two transports."""
+    model = small_char()
+    engine, _ = make_engine(model)
+    engine.warmup()
+    requests = mixed_requests(model, 4, np.random.RandomState(5))
+    for r in requests:
+        engine.submit(r)
+    engine.drain()
+    block = engine.live_source()["serving"]
+    stats = engine.stats()
+    for key in ("requests", "requests_shed", "tokens_out",
+                "latency_s_p95", "queue_depth"):
+        assert block[key] == stats[key], key
+    # rate denominators are wall-clock window ages, so two reads a
+    # moment apart agree approximately, not bit-exactly
+    assert block["req_per_s_60s"] == pytest.approx(
+        stats["req_per_s_60s"], rel=0.05
+    )
+    engine.close()
+
+
 def test_serving_telemetry_off_by_default_is_null():
     model = small_char()
     engine, _ = make_engine(model)
