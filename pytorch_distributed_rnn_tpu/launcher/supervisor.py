@@ -1,30 +1,38 @@
-"""Elastic worker supervisor: watch, respawn, rejoin.
+"""Process supervisors: watch, respawn, rejoin.
 
-The process half of elastic membership (``resilience/membership.py``):
-the master's roster can re-admit a worker mid-run, but something has to
-notice the death and relaunch the process.  :class:`ElasticSupervisor`
-is that something for the single-machine spawn world (the fake-cluster
-pattern, SURVEY §4.2) - the local analogue of a k8s restart policy or a
+The process half of elastic membership (``resilience/membership.py``)
+and of MPMD stage fault tolerance (``parallel/mpmd.py``): a roster or a
+pipeline can re-admit a process mid-run, but something has to notice
+the death and relaunch it.  :class:`RespawnSupervisor` is that
+something for the single-machine spawn world (the fake-cluster pattern,
+SURVEY §4.2) - the local analogue of a k8s restart policy or a
 preemptible-VM instance group:
 
-- each worker slot keeps its stable **worker-id** across respawns: the
-  relaunched process star-joins the transport on the same rank and
-  REGISTERs under the same id, so the master's push-seq watermark and
-  data shard carry over;
-- a worker exiting **0** is terminal (normal completion or a SIGTERM
+- each slot keeps its stable **worker-id** across respawns: the
+  relaunched process re-enters the world under the same identity (a PS
+  worker star-joins and REGISTERs under its id; an MPMD stage re-dials
+  its fixed link ports as the same stage-id), so watermarks, shards,
+  and replay windows carry over;
+- a process exiting **0** is terminal (normal completion or a SIGTERM
   drain) - never respawned;
 - a nonzero/signal exit is a death: respawned with ``rejoin=True`` up
   to ``max_respawns`` times per slot (exponential-free fixed delay -
-  the join protocol itself is cheap; the model rebuild dominates);
+  the join protocols are cheap; the model rebuild dominates);
 - when a slot's respawn budget is exhausted, the supervisor keeps the
-  run alive only while at least ``min_workers`` workers remain live or
+  run alive only while at least ``min_workers`` slots remain live or
   completed - below the floor it tears the world down instead of
-  letting the master idle out its join timeout.
+  letting the survivors idle out their join/link timeouts.
 
 The supervisor is deliberately dumb about *state*: everything a rejoin
-needs to continue correctly (params, watermarks, dedupe) lives in the
-master's STATE_SYNC reply, which is what makes the kill -> respawn ->
-rejoin path drillable with the chaos actions in ``resilience/faults.py``.
+needs to continue correctly lives outside it (the PS master's
+STATE_SYNC reply; an MPMD stage's own crash-safe checkpoint plus its
+neighbors' replay buffers), which is what makes the kill -> respawn ->
+rejoin path drillable with the chaos actions in
+``resilience/faults.py``.  The two deployment flavors -
+:class:`ElasticSupervisor` (PS workers around an unsupervised master)
+and :class:`StageSupervisor` (every pipeline stage supervised, floor =
+the whole pipeline) - share this one implementation; neither forks the
+respawn/min-workers core.
 """
 
 from __future__ import annotations
@@ -38,7 +46,7 @@ log = logging.getLogger(__name__)
 
 @dataclass
 class _Slot:
-    """One supervised worker slot (worker-id == launch rank)."""
+    """One supervised process slot (worker-id == launch rank)."""
 
     worker_id: int
     rank: int
@@ -49,23 +57,23 @@ class _Slot:
     history: list = field(default_factory=list)  # exit codes observed
 
 
-class ElasticSupervisor:
-    """Watches spawned PS worker processes; respawns dead ones with the
-    same worker-id so they rejoin via REGISTER."""
+class RespawnSupervisor:
+    """The respawn/min-workers core: watches spawned processes, reaps
+    exits, respawns deaths into the same slot."""
 
     def __init__(self, spawn_worker, *, min_workers: int = 1,
                  max_respawns: int = 3, respawn_delay_s: float = 0.1,
                  poll_s: float = 0.05, on_event=None):
         """``spawn_worker(rank, worker_id, rejoin) -> process`` launches
-        one worker process (``process`` needs ``is_alive()``,
-        ``exitcode`` and ``terminate()``/``join()``).
+        one process (``process`` needs ``is_alive()``, ``exitcode`` and
+        ``terminate()``/``join()``).
 
         ``on_event(kind, **fields)`` is an optional observer hook fired
         on supervision transitions (``worker_respawn`` / ``worker_lost``
-        / ``pool_collapse``): the runner wires it to the live plane's
-        alert pusher (``obs/live.EventPusher``) so the fleet aggregator
-        sees supervisor actions as structured alerts - the supervisor
-        itself stays transport-agnostic.  Hook failures are swallowed."""
+        / ``pool_collapse``): the PS runner wires it to the live plane's
+        alert pusher (``obs/live.EventPusher``), the MPMD runner to its
+        supervisor sidecar - the supervisor itself stays
+        transport-agnostic.  Hook failures are swallowed."""
         self._spawn_worker = spawn_worker
         self.min_workers = int(min_workers)
         self.max_respawns = int(max_respawns)
@@ -84,7 +92,7 @@ class ElasticSupervisor:
             log.exception(f"supervisor: on_event({kind}) hook failed")
 
     def launch(self, ranks) -> None:
-        """Spawn the initial worker set (worker-id == launch rank)."""
+        """Spawn the initial process set (worker-id == launch rank)."""
         for rank in ranks:
             proc = self._spawn_worker(rank, rank, False)
             self.slots[rank] = _Slot(worker_id=rank, rank=rank,
@@ -100,8 +108,8 @@ class ElasticSupervisor:
 
     def poll(self) -> bool:
         """One supervision pass: reap exits, respawn deaths.  Returns
-        False when the worker pool has fallen below ``min_workers`` with
-        no respawn budget left (the caller should tear down)."""
+        False when the pool has fallen below ``min_workers`` with no
+        respawn budget left (the caller should tear down)."""
         for slot in self.slots.values():
             if slot.completed or slot.failed or slot.process.is_alive():
                 continue
@@ -109,7 +117,7 @@ class ElasticSupervisor:
             slot.history.append(code)
             if code == 0:
                 # normal completion OR a SIGTERM drain: both are
-                # voluntary exits the roster already accounted for
+                # voluntary exits the world already accounted for
                 slot.completed = True
                 log.info(
                     f"supervisor: worker-id {slot.worker_id} exited 0 "
@@ -149,14 +157,27 @@ class ElasticSupervisor:
         return healthy
 
     def supervise(self, until_exit) -> bool:
-        """Supervision loop: poll until ``until_exit()`` returns an exit
-        code (the master process finishing) or the pool collapses below
-        the floor.  Returns True while healthy, False on collapse."""
+        """Supervision loop anchored on an UNSUPERVISED process: poll
+        until ``until_exit()`` returns an exit code (the PS master
+        finishing) or the pool collapses below the floor.  Returns True
+        while healthy, False on collapse."""
         while until_exit() is None:
             if not self.poll():
                 return False
             time.sleep(self.poll_s)
         return True
+
+    def supervise_all(self) -> bool:
+        """Supervision loop with NO external anchor: poll until every
+        slot is terminal (completed or failed) or the pool collapses.
+        Returns True iff every slot completed - the MPMD shape, where
+        all processes are supervised peers."""
+        while True:
+            if not self.poll():
+                return False
+            if all(s.completed or s.failed for s in self.slots.values()):
+                return all(s.completed for s in self.slots.values())
+            time.sleep(self.poll_s)
 
     def shutdown(self, timeout_s: float = 10.0) -> None:
         """Terminate whatever is still running, reap everything, and
@@ -182,3 +203,37 @@ class ElasticSupervisor:
             "failed": sum(1 for s in self.slots.values() if s.failed),
             "respawns": self.total_respawns,
         }
+
+
+class ElasticSupervisor(RespawnSupervisor):
+    """PS flavor: supervises the WORKER processes around an
+    unsupervised master (the master owns the state; its exit anchors
+    :meth:`supervise`).  A respawned worker star-joins the transport on
+    the same rank and REGISTERs under the same worker-id, so the
+    master's push-seq watermark and data shard carry over."""
+
+
+class StageSupervisor(RespawnSupervisor):
+    """MPMD pipeline flavor: EVERY stage process is supervised and the
+    pool floor defaults to the whole pipeline - a pipeline with a hole
+    in it computes nothing, so one permanently-lost stage is a
+    collapse, not a degraded world.  A respawned stage restores from
+    its own per-stage checkpoint and re-dials its neighbors' fixed
+    link ports; use :meth:`supervise_all` (there is no master to
+    anchor on)."""
+
+    def __init__(self, spawn_worker, *, min_workers: int | None = None,
+                 max_respawns: int = 3, respawn_delay_s: float = 0.1,
+                 poll_s: float = 0.05, on_event=None):
+        self._floor_is_all = min_workers is None
+        super().__init__(
+            spawn_worker,
+            min_workers=0 if min_workers is None else min_workers,
+            max_respawns=max_respawns, respawn_delay_s=respawn_delay_s,
+            poll_s=poll_s, on_event=on_event,
+        )
+
+    def launch(self, ranks) -> None:
+        super().launch(ranks)
+        if self._floor_is_all:
+            self.min_workers = len(self.slots)

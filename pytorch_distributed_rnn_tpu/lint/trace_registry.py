@@ -47,6 +47,7 @@ PROVIDER_MODULES = (
     "pytorch_distributed_rnn_tpu.training.zero",
     "pytorch_distributed_rnn_tpu.training.moe",
     "pytorch_distributed_rnn_tpu.serving.engine",
+    "pytorch_distributed_rnn_tpu.parallel.mpmd",
 )
 
 # virtual CPU devices the deep pass guarantees when it owns the jax

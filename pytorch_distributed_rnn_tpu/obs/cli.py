@@ -64,6 +64,9 @@ _SUMMARY_FIELDS = (
     ("member_rejoins", "{:d}"),
     ("member_drains", "{:d}"),
     ("member_deaths", "{:d}"),
+    # MPMD pipelines (None and skipped on non-pipeline runs)
+    ("stage_restarts", "{:d}"),
+    ("replayed_microbatches", "{:d}"),
     ("roster", "{}"),
     ("checkpoint_saves", "{:d}"),
     # serving runs (absent on training sidecars - skipped when None)
@@ -153,7 +156,9 @@ def main(argv=None) -> int:
         help="liveness check: flag ranks whose telemetry went stale "
         "(dead) or whose heartbeats continue without progress (stalled); "
         "a rank that DEREGISTERed (member_drain - the SIGTERM drain "
-        "path) is 'drained' and healthy, not dead",
+        "path) is 'drained' and healthy, not dead, and a respawned MPMD "
+        "stage still restoring/retracing after a stage_restart is "
+        "'recovering', not stalled",
     )
     p.add_argument("files", nargs="+")
     p.add_argument("--stale-after", type=float, default=30.0, metavar="S",

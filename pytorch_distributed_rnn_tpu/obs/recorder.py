@@ -67,6 +67,15 @@ member_dead         worker_id, rank_slot, error + roster counts -
                     REGISTER
 checkpoint_fallback path, reason, chosen - a corrupt checkpoint was
                     skipped during --resume auto and resume fell back
+stage_restart       stage, resume_step, ckpt - a respawned MPMD stage
+                    restored its per-stage checkpoint and is re-dialing
+                    its neighbors (parallel/mpmd.py); pdrnn-metrics
+                    health classifies the rank recovering, not stalled,
+                    until its first post-restart step lands
+replay              stage, link, count, from_seq, to_seq - a surviving
+                    link end replayed buffered microbatch frames to a
+                    restarted neighbor during the watermark handshake
+                    (runtime/stage.py)
 alert               alert (stall | stall_cleared | nan_streak |
                     loss_spike | slo_breach | slo_recovered | straggler
                     | worker_respawn | worker_lost | pool_collapse),

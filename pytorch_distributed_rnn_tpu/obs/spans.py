@@ -50,6 +50,9 @@ SUBSYSTEM_TIDS = {
     # elastic membership lane: member_join/drain/dead instants and
     # state_sync spans (resilience/membership.py roster transitions)
     "member": 10,
+    # MPMD pipeline lane: stage_restart/replay instants (parallel/mpmd.py
+    # + runtime/stage.py link recovery)
+    "stage": 11,
 }
 
 

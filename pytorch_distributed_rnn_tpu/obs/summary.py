@@ -248,6 +248,21 @@ def summarize_events(events: list[dict], path=None) -> dict:
         summary.update(member_counts)
     else:
         summary.update(dict.fromkeys(member_counts))
+    # MPMD pipeline recovery (parallel/mpmd.py): restarts of this stage
+    # plus microbatch frames its links replayed to restarted neighbors.
+    # Same None-not-0 convention as the membership counts above.
+    stage_counts = {
+        "stage_restarts": sum(
+            1 for e in events if e["kind"] == "stage_restart"
+        ),
+        "replayed_microbatches": sum(
+            int(e.get("count", 0)) for e in events if e["kind"] == "replay"
+        ),
+    }
+    if any(stage_counts.values()):
+        summary.update(stage_counts)
+    else:
+        summary.update(dict.fromkeys(stage_counts))
     if run and run.get("roster") is not None:
         summary["roster"] = run["roster"]
     if run:
@@ -325,6 +340,11 @@ def rank_health(events: list[dict], now: float | None = None,
       voluntary leave, not a death - healthy, exit 0;
     - ``dead``     - nothing at all for ``stale_after`` seconds: the
       process stopped flushing (killed, wedged below Python);
+    - ``recovering`` - heartbeats fresh, no progress, but the last
+      thing this rank did was a ``stage_restart`` with no ``step``
+      landed since: a respawned MPMD stage still restoring its
+      checkpoint and retracing its programs.  Expected recovery work,
+      not a stall - healthy, exit 0;
     - ``stalled``  - heartbeats fresh but no progress for
       ``stale_after`` seconds: alive and stuck (the chaos harness's
       ``stall`` fault, a hung collective, a starved loader);
@@ -365,7 +385,22 @@ def rank_health(events: list[dict], now: float | None = None,
     elif now - last_t > stale_after:
         status = "dead"
     elif now - last_progress_t > stale_after:
-        status = "stalled"
+        # a respawned stage restoring + retracing is working, not stuck
+        # - but only until its first post-restart step lands; after
+        # that, silence is an ordinary stall again.  A stage whose
+        # heartbeats also stopped stays DEAD (branch above): respawn
+        # grace never masks a killed process.
+        restart_ts = [
+            float(e["t"]) for e in events if e["kind"] == "stage_restart"
+        ]
+        stepped_since = restart_ts and any(
+            e["kind"] == "step" and float(e["t"]) >= restart_ts[-1]
+            for e in events
+        )
+        if restart_ts and not stepped_since:
+            status = "recovering"
+        else:
+            status = "stalled"
     else:
         status = "ok"
     return {

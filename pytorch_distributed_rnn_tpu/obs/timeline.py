@@ -369,6 +369,10 @@ def build_chrome_trace(by_rank: dict[int, list[dict]],
                     # (state_sync rides in as a span with cat=member)
                     "member_join": "member", "member_drain": "member",
                     "member_dead": "member",
+                    # the MPMD pipeline lane: a stage coming back plus
+                    # the frames its neighbors replayed to it
+                    "stage_restart": "stage", "replay": "stage",
+                    "worker_respawn": "stage", "worker_lost": "stage",
                 }.get(kind, "sys")
                 tb.instant(rank, cat, kind, w, _args(e), scope)
 

@@ -465,6 +465,31 @@ Comm* pdrnn_init_star(const char* master_addr, int master_port, int rank,
   return c;
 }
 
+// Listener-only world: rank 0 with the rendezvous listener bound to a
+// KNOWN port and an empty peer table of `capacity` slots - every peer
+// arrives later through `pdrnn_accept_peer` star joins.  This is the
+// host end of an MPMD pipeline link: stage k listens here, stage k+1
+// star-joins as rank 1, and a respawned downstream re-dials the same
+// port.  Neither existing entry point can serve this role:
+// `pdrnn_init(world=1)` returns without a listener, and the full-mesh
+// accept loop would misread the star handshake's magic word as a peer
+// rank.  The fixed port is the point - respawned dialers must find the
+// listener again without a rendezvous exchange.
+Comm* pdrnn_init_listener(int port, int capacity) {
+  if (port <= 0 || port > 65535 || capacity < 2) return nullptr;
+  auto* c = new Comm();
+  c->rank = 0;
+  c->world = 1;
+  c->peer_fd.assign(capacity, -1);
+  uint16_t p = static_cast<uint16_t>(port);
+  c->listen_fd = make_listener(&p);
+  if (c->listen_fd < 0) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
 }  // extern "C"
 
 namespace {

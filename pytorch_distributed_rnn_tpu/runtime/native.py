@@ -91,6 +91,8 @@ def _load():
         ctypes.c_int,
         ctypes.c_int,
     ]
+    lib.pdrnn_init_listener.restype = ctypes.c_void_p
+    lib.pdrnn_init_listener.argtypes = [ctypes.c_int, ctypes.c_int]
     lib.pdrnn_rank.argtypes = [ctypes.c_void_p]
     lib.pdrnn_world.argtypes = [ctypes.c_void_p]
     lib.pdrnn_reserve.argtypes = [ctypes.c_void_p, ctypes.c_int]
@@ -182,6 +184,28 @@ class Communicator:
         loss_prob = float(os.environ.get("PDRNN_FAULT_LOSS_PROB", "0") or 0)
         if delay_ms or loss_prob:
             self.set_fault(delay_ms, loss_prob)
+
+    @classmethod
+    def listener(cls, port: int, capacity: int = 2) -> "Communicator":
+        """Listener-only world: rank 0 bound to a KNOWN ``port`` with an
+        empty ``capacity``-slot peer table - peers arrive later via
+        :meth:`accept_peer` star joins.  The host end of an MPMD
+        pipeline link (``runtime/stage.py``): the fixed port is what
+        lets a respawned downstream stage re-dial without a rendezvous
+        exchange."""
+        lib = _load()
+        self = cls.__new__(cls)
+        self._lib = lib
+        self._handle = lib.pdrnn_init_listener(int(port), int(capacity))
+        if not self._handle:
+            raise RuntimeError(f"listener world failed to bind port {port}")
+        self.rank = 0
+        self.world_size = 1
+        delay_ms = float(os.environ.get("PDRNN_FAULT_DELAY_MS", "0") or 0)
+        loss_prob = float(os.environ.get("PDRNN_FAULT_LOSS_PROB", "0") or 0)
+        if delay_ms or loss_prob:
+            self.set_fault(delay_ms, loss_prob)
+        return self
 
     # -- fault injection (netem analogue) -----------------------------------
 

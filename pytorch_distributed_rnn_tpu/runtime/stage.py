@@ -1,0 +1,267 @@
+"""MPMD stage links: framed, replayable p2p transport for
+process-per-stage pipelines (``parallel/mpmd.py``).
+
+Each edge of the pipeline chain (stage k <-> k+1) is its OWN tiny
+native-TCP world, not a slice of one big mesh: stage k hosts a
+listener-only world (:meth:`Communicator.listener`, fixed per-link
+port) and stage k+1 star-joins it as rank 1 - the star-accept/reserve
+machinery the elastic PS world added (PR 7), reapplied per link.
+Because no global world exists, a stage death breaks exactly its two
+adjacent links; every other edge - and every surviving stage's
+compiled programs - is untouched.  That is the whole MPMD bet
+(PAPERS.md arxiv 2412.14374): restart means re-dial, never recompile.
+
+Frames and exactly-once replay
+------------------------------
+Every tensor crossing a link is framed ``[seq, nbytes] + payload``
+with ``seq = step * microbatches + mb`` - a dense, deterministic
+sequence per direction.  Each end keeps:
+
+- a SEND BUFFER of the last two steps' frames (a restarted stage
+  resumes at most one step behind its neighbors - it cannot fall
+  further back, because a neighbor needs the dead stage's traffic to
+  finish its own step - so two steps bound the in-flight window);
+- a RECV WATERMARK ``recv_next``: the next fresh sequence number.
+  TCP is FIFO per link, so any frame below the watermark is a replay
+  duplicate and is dropped (counted, never recomputed).
+
+Sender-side replay + receiver-side dedupe = exactly-once delivery to
+the compute loop.  On any transport error the end reconnects (host:
+re-accept on the surviving listener; dialer: re-dial the fixed port)
+under the deadline-budgeted ``resilience/retry.py`` contract - a loud
+error past the budget, never a silent hang - then runs the WATERMARK
+HANDSHAKE: both ends exchange ``recv_next`` and each replays every
+buffered frame the peer has not seen.  A peer watermark older than
+the buffer window is unrecoverable loss and raises
+:class:`LinkBroken` loudly.
+
+A restarted stage derives its watermarks from its own checkpoint
+(``resume_step * microbatches``) instead of persisting transport
+state: the checkpoint already IS the replay cursor.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from pytorch_distributed_rnn_tpu.resilience.retry import retry_transport
+from pytorch_distributed_rnn_tpu.runtime.native import Communicator
+
+log = logging.getLogger(__name__)
+
+
+class LinkBroken(RuntimeError):
+    """The link could not be (re)established within the retry budget,
+    the peer's watermark fell outside the replay window, or the frame
+    stream violated the protocol (shape or sequence mismatch)."""
+
+
+class _TransientLinkError(RuntimeError):
+    """A (re)connection attempt that is worth retrying: no join yet, a
+    refused dial, or a socket that died between establish and
+    handshake.  Distinct from :class:`LinkBroken` (also a RuntimeError)
+    precisely so the retry loop can retry one and not the other."""
+
+
+class LinkEnd:
+    """One end of a stage<->stage pipeline link.
+
+    ``HOST`` (the upstream stage) owns the link's listener world on a
+    fixed port; ``DIAL`` (the downstream stage) star-joins it as rank
+    1.  Both ends speak the same framed protocol; the asymmetry is
+    only in how a broken socket is re-established.  Callers that
+    resume from a checkpoint must set :attr:`recv_next` BEFORE
+    :meth:`connect` so the handshake advertises the true watermark.
+    """
+
+    HOST = "host"
+    DIAL = "dial"
+
+    def __init__(self, mode: str, *, port: int, addr: str = "127.0.0.1",
+                 window: int, name: str = "link", seed: int = 0,
+                 reconnect_deadline_s: float = 120.0, on_event=None,
+                 comm=None):
+        if mode not in (self.HOST, self.DIAL):
+            raise ValueError(f"mode must be 'host' or 'dial', got {mode!r}")
+        self.mode = mode
+        self.addr = addr
+        self.port = int(port)
+        self.window = int(window)
+        self.name = name
+        self.seed = int(seed)
+        self.reconnect_deadline_s = float(reconnect_deadline_s)
+        self.on_event = on_event
+        self.peer = 1 if mode == self.HOST else 0
+        self.recv_next = 0
+        self.stats = {"reconnects": 0, "replayed": 0, "dup_drops": 0}
+        self._buf: dict[int, np.ndarray] = {}
+        self._sent_next = 0  # highest seq handed to send() + 1
+        # the host end binds its listener at construction time, before
+        # any dial can land - a (re)started stage builds its downstream
+        # LinkEnd FIRST so the neighbor's dial retries have a target
+        if comm is not None:
+            self._comm = comm
+        elif mode == self.HOST:
+            self._comm = Communicator.listener(self.port, capacity=2)
+        else:
+            self._comm = None
+
+    # -- connection management -----------------------------------------------
+
+    def _establish(self):
+        """One (re)connection attempt; raises ``RuntimeError`` on a
+        transient miss so ``retry_transport`` owns the backoff."""
+        if self.mode == self.HOST:
+            self._comm.close_peer(1)
+            if self._comm.accept_peer(timeout_s=1.0) is None:
+                raise RuntimeError(
+                    f"{self.name}: no star join on port {self.port} yet"
+                )
+        else:
+            if self._comm is not None:
+                self._comm.close()
+                self._comm = None
+            # the constructor dials with its own bounded retry (~30 s)
+            self._comm = Communicator(
+                self.addr, self.port, rank=1, world_size=2, star=True
+            )
+
+    def connect(self, initial: bool = False) -> int:
+        """(Re)establish the peer socket under the deadline-budgeted
+        retry contract, then run the watermark handshake.  Returns the
+        number of frames replayed to the peer.
+
+        Establish + handshake retry as ONE unit: a dial can land on the
+        half-dead socket of a just-killed peer and only fail at the
+        handshake, so a handshake transport error is the same transient
+        condition as a refused dial.  :class:`LinkBroken` (a protocol
+        violation, not a transient) is never retried."""
+
+        def attempt() -> int:
+            try:
+                self._establish()
+                return self._handshake()
+            except LinkBroken:
+                raise
+            except (RuntimeError, OSError) as exc:
+                raise _TransientLinkError(str(exc)) from exc
+
+        replayed = retry_transport(
+            attempt,
+            retries=512, base_delay=0.05, max_delay=0.5, seed=self.seed,
+            retryable=(_TransientLinkError,),
+            what=f"{self.name} {'connect' if initial else 'reconnect'}",
+            deadline_s=self.reconnect_deadline_s,
+        )
+        if not initial:
+            self.stats["reconnects"] += 1
+        return replayed
+
+    def _handshake(self) -> int:
+        mine = np.array([self.recv_next], dtype=np.int64)
+        self._comm.send(self.peer, mine)
+        peer_next = int(self._comm.recv(self.peer, (1,), np.int64)[0])
+        replay = sorted(s for s in self._buf if s >= peer_next)
+        # every frame in [peer_next, sent_next) must still be buffered;
+        # anything already pruned is unrecoverable loss, so fail loudly
+        expect = peer_next
+        for s in replay:
+            if s != expect:
+                break
+            expect = s + 1
+        if expect < self._sent_next:
+            raise LinkBroken(
+                f"{self.name}: peer watermark {peer_next} is outside the "
+                f"replay window (frame {expect} already pruned; "
+                f"window={self.window})"
+            )
+        for s in replay:
+            self._wire_send(s, self._buf[s])
+        if replay:
+            self.stats["replayed"] += len(replay)
+            if self.on_event is not None:
+                self.on_event(
+                    "replay", link=self.name, count=len(replay),
+                    from_seq=int(replay[0]), to_seq=int(replay[-1]),
+                )
+        return len(replay)
+
+    # -- framed exchange -----------------------------------------------------
+
+    def _wire_send(self, seq: int, array: np.ndarray):
+        header = np.array([seq, array.nbytes], dtype=np.int64)
+        self._comm.send(self.peer, header)
+        self._comm.send(self.peer, array)
+
+    def send(self, seq: int, array: np.ndarray):
+        """Buffer then wire-send frame ``seq``.  On a dead peer the end
+        reconnects; the handshake's replay delivers this frame, so the
+        send never silently vanishes."""
+        array = np.ascontiguousarray(array)
+        self._buf[seq] = array.copy()
+        self._sent_next = max(self._sent_next, seq + 1)
+        try:
+            self._wire_send(seq, array)
+        except RuntimeError:
+            log.warning(
+                f"{self.name}: send({seq}) hit a dead peer; reconnecting"
+            )
+            self.connect()
+
+    def recv(self, shape, dtype=np.float32) -> tuple[int, np.ndarray]:
+        """Next FRESH frame as ``(seq, array)``; replay duplicates are
+        consumed and dropped, transport errors trigger a reconnect."""
+        expected_nbytes = (
+            int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+        )
+        while True:
+            try:
+                header = self._comm.recv(self.peer, (2,), np.int64)
+                seq, nbytes = int(header[0]), int(header[1])
+                if nbytes != expected_nbytes:
+                    raise LinkBroken(
+                        f"{self.name}: frame {seq} carries {nbytes} bytes, "
+                        f"expected {expected_nbytes} - the stages disagree "
+                        "on this link's tensor shape"
+                    )
+                payload = self._comm.recv(self.peer, shape, dtype)
+            except LinkBroken:
+                raise
+            except RuntimeError:
+                log.warning(f"{self.name}: recv hit a dead peer; reconnecting")
+                self.connect()
+                continue
+            if seq < self.recv_next:
+                self.stats["dup_drops"] += 1
+                continue
+            if seq != self.recv_next:
+                raise LinkBroken(
+                    f"{self.name}: got frame {seq} while expecting "
+                    f"{self.recv_next} (sequence gap - sender skipped "
+                    "or replay window desynchronized)"
+                )
+            self.recv_next = seq + 1
+            return seq, payload
+
+    def prune(self, min_seq: int):
+        """Drop buffered frames below ``min_seq`` (the stage calls this
+        at step boundaries with ``(step - 1) * microbatches``, keeping
+        exactly the two-step in-flight window alive)."""
+        for s in [s for s in self._buf if s < min_seq]:
+            del self._buf[s]
+
+    def buffered(self) -> int:
+        return len(self._buf)
+
+    def close(self):
+        if self._comm is not None:
+            self._comm.close()
+            self._comm = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,648 @@
+"""Fault-tolerant MPMD pipelines: per-stage programs, framed link
+transport, stage supervision, and restart-without-recompile.
+
+The spec of ISSUE 11: each pipeline stage is its own process jitting
+only its slice (``parallel/mpmd.py``) and exchanging activations over
+per-link framed TCP worlds (``runtime/stage.py``); a SIGKILLed stage is
+respawned into the same stage-id, restores its per-stage checkpoint,
+re-dials its neighbors, and the watermark handshake replays the
+bounded in-flight window exactly once - while every SURVIVOR keeps its
+compiled programs (trace counters stay at 1) and the run's end state
+is bit-identical to the uninterrupted baseline.
+"""
+
+import json
+import threading
+import time
+from collections import deque
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_rnn_tpu.parallel import mpmd
+from pytorch_distributed_rnn_tpu.parallel.mpmd import (
+    PipelineConfig,
+    batch_for_step,
+    init_stage_params,
+)
+from pytorch_distributed_rnn_tpu.runtime.stage import LinkBroken, LinkEnd
+
+PORT = 29930  # base; keep clear of 29880s (elastic) / 29800 (ps)
+
+
+# ---------------------------------------------------------------------------
+# Pipeline geometry + determinism
+# ---------------------------------------------------------------------------
+
+
+class TestPipelineConfig:
+    def test_layer_partition_is_contiguous_and_complete(self):
+        for stages, layers in [(1, 4), (3, 4), (3, 3), (4, 10)]:
+            cfg = PipelineConfig(stages=stages, layers=layers)
+            ranges = [cfg.layer_range(s) for s in range(stages)]
+            assert ranges[0][0] == 0 and ranges[-1][1] == layers
+            for (_, hi), (lo, _) in zip(ranges, ranges[1:]):
+                assert hi == lo  # contiguous, no gap/overlap
+            sizes = [hi - lo for lo, hi in ranges]
+            assert max(sizes) - min(sizes) <= 1  # balanced
+
+    def test_rejects_more_stages_than_layers(self):
+        with pytest.raises(ValueError):
+            PipelineConfig(stages=5, layers=4)
+
+    def test_link_shapes_and_ports(self):
+        cfg = PipelineConfig(stages=3, feature_dim=6, hidden_dim=16)
+        assert cfg.input_shape(0)[-1] == 6
+        assert cfg.input_shape(1)[-1] == 16
+        assert cfg.act_shape() == cfg.input_shape(1)
+        assert cfg.link_port(2, 29930) == 29932
+
+    def test_stage_init_is_partition_invariant(self):
+        """The same global layer gets the same init under any stage
+        split - the property that makes an S-stage pipeline's math
+        comparable to the single-process composition."""
+        import jax
+
+        whole = PipelineConfig(stages=1, layers=4)
+        split = PipelineConfig(stages=3, layers=4)
+        split_layers = []
+        for s in range(split.stages):
+            split_layers.extend(init_stage_params(split, s)["layers"])
+        whole_params = init_stage_params(whole, 0)
+        for a, b in zip(whole_params["layers"], split_layers):
+            for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+                assert np.array_equal(la, lb)
+        head_split = init_stage_params(split, 2)["head"]
+        assert np.array_equal(whole_params["head"]["wo"],
+                              head_split["wo"])
+
+    def test_batch_for_step_is_deterministic_per_step(self):
+        cfg = PipelineConfig()
+        f1, l1 = batch_for_step(cfg, 3)
+        f2, l2 = batch_for_step(cfg, 3)
+        f3, _ = batch_for_step(cfg, 4)
+        assert np.array_equal(f1, f2) and np.array_equal(l1, l2)
+        assert not np.array_equal(f1, f3)
+        assert f1.shape == (cfg.microbatches, cfg.microbatch_size,
+                            cfg.seq_len, cfg.feature_dim)
+
+    def test_trace_counter_pins_retraces_not_calls(self):
+        import jax
+
+        counts = {}
+        fn = jax.jit(mpmd._counted(lambda x: x * 2, counts, "f"))
+        for _ in range(3):
+            fn(np.ones((2, 2), np.float32))
+        assert counts["f"] == 1  # three calls, one trace
+        fn(np.ones((3, 3), np.float32))
+        assert counts["f"] == 2  # new shape retraces
+
+
+# ---------------------------------------------------------------------------
+# LinkEnd framing, dedupe, replay (fake in-memory comms)
+# ---------------------------------------------------------------------------
+
+
+class _FakeComm:
+    """In-memory comm double: arrays ride deques, errors by script."""
+
+    def __init__(self, inbox, outbox):
+        self.inbox, self.outbox = inbox, outbox
+        self.closed = False
+
+    def send(self, peer, array):
+        self.outbox.append(np.array(array, copy=True))
+
+    def recv(self, peer, shape, dtype=np.float32):
+        if not self.inbox:
+            raise RuntimeError("recv failed (fake: peer gone)")
+        return np.asarray(self.inbox.popleft(), dtype=dtype).reshape(shape)
+
+    def accept_peer(self, timeout_s=0.5):
+        return 1
+
+    def close_peer(self, rank):
+        pass
+
+    def close(self):
+        self.closed = True
+
+
+def _fake_pair(window=4, **kw):
+    a2b, b2a = deque(), deque()
+    la = LinkEnd(LinkEnd.HOST, port=0, window=window,
+                 comm=_FakeComm(b2a, a2b), name="A", **kw)
+    lb = LinkEnd(LinkEnd.DIAL, port=0, window=window,
+                 comm=_FakeComm(a2b, b2a), name="B")
+    return la, lb
+
+
+class TestLinkFraming:
+    def test_send_recv_roundtrip(self):
+        la, lb = _fake_pair()
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        la.send(0, x)
+        seq, got = lb.recv((3, 4))
+        assert seq == 0 and np.array_equal(got, x)
+        assert lb.recv_next == 1 and la.buffered() == 1
+
+    def test_replay_duplicates_dropped_below_watermark(self):
+        la, lb = _fake_pair()
+        x = np.ones((2, 2), np.float32)
+        la.send(0, x)
+        assert lb.recv((2, 2))[0] == 0
+        la._wire_send(0, x)  # a replayed duplicate
+        la.send(1, 3 * x)
+        seq, got = lb.recv((2, 2))
+        assert seq == 1 and np.array_equal(got, 3 * x)
+        assert lb.stats["dup_drops"] == 1
+
+    def test_sequence_gap_is_loud(self):
+        la, lb = _fake_pair()
+        la._wire_send(2, np.ones((2, 2), np.float32))
+        with pytest.raises(LinkBroken, match="sequence gap"):
+            lb.recv((2, 2))
+
+    def test_shape_disagreement_is_loud(self):
+        la, lb = _fake_pair()
+        la._wire_send(0, np.ones((2, 2), np.float32))
+        with pytest.raises(LinkBroken, match="disagree"):
+            lb.recv((4, 4))
+
+    def test_prune_keeps_the_window(self):
+        la, _ = _fake_pair()
+        for s in range(4):
+            la.send(s, np.full((2,), s, np.float32))
+        la.prune(2)
+        assert la.buffered() == 2
+
+    def test_handshake_replays_exactly_the_unseen_frames(self):
+        events = []
+        la, lb = _fake_pair(
+            on_event=lambda kind, **f: events.append({"kind": kind, **f})
+        )
+        frames = [np.full((2, 2), s, np.float32) for s in range(4)]
+        for s, x in enumerate(frames):
+            la.send(s, x)
+        # the peer restarts knowing (from its checkpoint) it consumed
+        # frames 0-1; it advertises recv_next=2 in the handshake
+        lb._comm.inbox.clear()  # in-flight frames died with the peer
+        la._comm.inbox.append(np.array([2], np.int64))
+        assert la._handshake() == 2
+        assert la.stats["replayed"] == 2
+        assert [e for e in events if e["kind"] == "replay"] == [
+            {"kind": "replay", "link": "A", "count": 2,
+             "from_seq": 2, "to_seq": 3}
+        ]
+        lb._comm.inbox.popleft()  # la's own watermark advertisement
+        lb.recv_next = 2
+        for want in (2, 3):
+            seq, got = lb.recv((2, 2))
+            assert seq == want and np.array_equal(got, frames[want])
+
+    def test_watermark_outside_replay_window_is_loud(self):
+        la, _ = _fake_pair()
+        for s in range(4):
+            la.send(s, np.ones((2,), np.float32))
+        la.prune(2)  # frames 0-1 are gone
+        la._comm.inbox.append(np.array([1], np.int64))
+        with pytest.raises(LinkBroken, match="outside the replay window"):
+            la._handshake()
+
+    def test_connect_never_retries_a_broken_link(self):
+        """LinkBroken is a protocol verdict, not a transient: connect()
+        must surface it immediately instead of burning 512 retries."""
+        la, _ = _fake_pair()
+        for s in range(4):
+            la.send(s, np.ones((2,), np.float32))
+        la.prune(2)
+        la._comm.inbox.append(np.array([1], np.int64))
+        t0 = time.monotonic()
+        with pytest.raises(LinkBroken):
+            la.connect()
+        assert time.monotonic() - t0 < 1.0
+
+
+# ---------------------------------------------------------------------------
+# Real TCP links under net faults + the reconnect deadline budget
+# ---------------------------------------------------------------------------
+
+
+class TestLinkTransport:
+    def test_delivery_correct_under_net_delay_and_loss(self, monkeypatch):
+        """The PDRNN_FAULT_* netem bridge: injected delay/loss shows up
+        as latency on the native transport, never as corruption - every
+        frame arrives intact, in order, with zero drops or replays."""
+        from pytorch_distributed_rnn_tpu.resilience.faults import (
+            FaultSchedule,
+        )
+
+        sched = FaultSchedule.parse("net:delay:2,net:loss:0.05")
+        for key, value in sched.network_env().items():
+            monkeypatch.setenv(key, value)
+
+        frames = 6
+        shape = (4, 8)
+        host_got, errors = [], []
+
+        def host_side():
+            try:
+                with LinkEnd(LinkEnd.HOST, port=PORT, window=8,
+                             name="h", reconnect_deadline_s=20.0) as lh:
+                    lh.connect(initial=True)
+                    for s in range(frames):
+                        lh.send(s, np.full(shape, s, np.float32))
+                    for s in range(frames):
+                        host_got.append(lh.recv(shape))
+            except Exception as exc:  # surfaced on the main thread
+                errors.append(exc)
+
+        t = threading.Thread(target=host_side, daemon=True)
+        t.start()
+        with LinkEnd(LinkEnd.DIAL, port=PORT, window=8, name="d",
+                     reconnect_deadline_s=20.0) as ld:
+            ld.connect(initial=True)
+            for s in range(frames):
+                seq, got = ld.recv(shape)
+                assert seq == s
+                assert np.array_equal(got, np.full(shape, s, np.float32))
+                ld.send(s, -got)
+            stats = dict(ld.stats)
+        t.join(timeout=30)
+        assert not t.is_alive() and not errors
+        assert [seq for seq, _ in host_got] == list(range(frames))
+        assert stats == {"reconnects": 0, "replayed": 0, "dup_drops": 0}
+
+    def test_reconnect_past_deadline_budget_is_loud(self):
+        """Nobody ever dials: the deadline-budgeted retry contract must
+        fail loudly within the budget, never hang the stage."""
+        lh = LinkEnd(LinkEnd.HOST, port=PORT + 1, window=2, name="h",
+                     reconnect_deadline_s=2.0, seed=3)
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="no star join"):
+            lh.connect(initial=True)
+        assert time.monotonic() - t0 < 15.0
+        lh.close()
+
+
+# ---------------------------------------------------------------------------
+# StageSupervisor (shared respawn core, pipeline flavor)
+# ---------------------------------------------------------------------------
+
+
+class _FakeProc:
+    def __init__(self):
+        self.exitcode = None
+        self.terminated = False
+
+    def is_alive(self):
+        return self.exitcode is None
+
+    def terminate(self):
+        self.terminated = True
+        if self.exitcode is None:
+            self.exitcode = -15
+
+    def join(self, timeout=None):
+        pass
+
+
+class TestStageSupervisor:
+    def _supervisor(self, **kwargs):
+        from pytorch_distributed_rnn_tpu.launcher.supervisor import (
+            StageSupervisor,
+        )
+
+        spawned = []
+
+        def spawn(rank, worker_id, rejoin):
+            proc = _FakeProc()
+            spawned.append((rank, worker_id, rejoin, proc))
+            return proc
+
+        return StageSupervisor(spawn, respawn_delay_s=0.0, poll_s=0.0,
+                               **kwargs), spawned
+
+    def test_floor_defaults_to_the_whole_pipeline(self):
+        sup, _ = self._supervisor()
+        sup.launch(range(3))
+        assert sup.min_workers == 3  # a pipeline with a hole computes
+        # nothing: one permanently-lost stage is a collapse
+
+    def test_explicit_floor_is_respected(self):
+        sup, _ = self._supervisor(min_workers=2)
+        sup.launch(range(3))
+        assert sup.min_workers == 2
+
+    def test_supervise_all_true_when_every_stage_completes(self):
+        sup, spawned = self._supervisor()
+        sup.launch(range(2))
+        for _, _, _, proc in spawned:
+            proc.exitcode = 0
+        assert sup.supervise_all()
+        assert sup.verdict() == {"workers": 2, "completed": 2,
+                                 "failed": 0, "respawns": 0}
+
+    def test_supervise_all_respawns_then_collapses_past_budget(self):
+        sup, spawned = self._supervisor(max_respawns=1)
+        sup.launch(range(2))
+        spawned[0][3].exitcode = -9
+        assert sup.poll()  # respawn 1/1 into the same stage-id
+        rank, worker_id, rejoin, proc = spawned[2]
+        assert (rank, worker_id, rejoin) == (0, 0, True)
+        proc.exitcode = -9
+        assert not sup.supervise_all()  # budget gone -> below floor
+
+    def test_elastic_supervisor_shares_the_core(self):
+        """Satellite 3's no-fork pin: both deployment flavors are the
+        one RespawnSupervisor implementation."""
+        from pytorch_distributed_rnn_tpu.launcher.supervisor import (
+            ElasticSupervisor,
+            RespawnSupervisor,
+            StageSupervisor,
+        )
+
+        assert issubclass(ElasticSupervisor, RespawnSupervisor)
+        assert issubclass(StageSupervisor, RespawnSupervisor)
+        for cls in (ElasticSupervisor, StageSupervisor):
+            assert "poll" not in vars(cls)
+            assert "supervise_all" not in vars(cls)
+
+
+# ---------------------------------------------------------------------------
+# Observability: recovering health, summarize counts, stage lane
+# ---------------------------------------------------------------------------
+
+
+def _sidecar(path, rank, events):
+    now = time.time()
+    head = {"kind": "meta", "schema": 2, "rank": rank, "t": now - 300,
+            "tm": 0.0, "sample_every": 1}
+    lines = [head] + [
+        {"rank": rank, "t": now - 200, "tm": 100.0, **e} for e in events
+    ]
+    path.write_text("".join(json.dumps(e) + "\n" for e in lines))
+    return now
+
+
+class TestStageObservability:
+    def test_health_respawning_stage_is_recovering_not_stalled(
+        self, tmp_path, capsys
+    ):
+        from pytorch_distributed_rnn_tpu.obs.cli import main as metrics_main
+
+        now = _sidecar(tmp_path / "m.jsonl", 0, [
+            {"kind": "run_summary", "duration_s": 1.0},
+        ])
+        _sidecar(tmp_path / "m-r1.jsonl", 1, [
+            {"kind": "stage_restart", "stage": 1, "resume_step": 2,
+             "t": now - 60},
+            {"kind": "heartbeat", "seq": 9, "t": now - 5},
+        ])
+        rc = metrics_main([
+            "health", str(tmp_path / "m.jsonl"),
+            "--now", str(now), "--stale-after", "30",
+        ])
+        out = capsys.readouterr().out
+        assert rc == 0  # recovery work is healthy - the satellite's pin
+        assert "rank 1: recovering" in out
+
+    def test_health_recovery_grace_ends_at_first_post_restart_step(
+        self, tmp_path
+    ):
+        from pytorch_distributed_rnn_tpu.obs import load_events, rank_health
+
+        # restart 60s ago, a step landed after it 50s ago, heartbeats
+        # fresh -> the silence SINCE the step is an ordinary stall again
+        now = _sidecar(tmp_path / "m.jsonl", 1, [
+            {"kind": "stage_restart", "stage": 1, "resume_step": 2,
+             "t": time.time() - 60},
+            {"kind": "step", "step": 2, "dispatch_s": 0.1,
+             "t": time.time() - 50},
+            {"kind": "heartbeat", "seq": 9, "t": time.time() - 5},
+        ])
+        report = rank_health(load_events(tmp_path / "m.jsonl"), now=now,
+                             stale_after=30)
+        assert report["status"] == "stalled"
+
+    def test_health_dead_stage_stays_dead(self, tmp_path):
+        """Respawn grace never masks a killed process: a stage whose
+        heartbeats ALSO stopped is dead, stage_restart or not."""
+        from pytorch_distributed_rnn_tpu.obs import load_events, rank_health
+
+        now = _sidecar(tmp_path / "m.jsonl", 1, [
+            {"kind": "stage_restart", "stage": 1, "resume_step": 2,
+             "t": time.time() - 60},
+        ])
+        report = rank_health(load_events(tmp_path / "m.jsonl"), now=now,
+                             stale_after=30)
+        assert report["status"] == "dead"
+
+    def test_summarize_counts_restarts_and_replayed_microbatches(
+        self, tmp_path
+    ):
+        from pytorch_distributed_rnn_tpu.obs.summary import summarize_file
+
+        _sidecar(tmp_path / "m.jsonl", 0, [
+            {"kind": "stage_restart", "stage": 0, "resume_step": 2,
+             "ckpt": "c.ckpt"},
+            {"kind": "replay", "stage": 0, "link": "link0:down",
+             "count": 2, "from_seq": 4, "to_seq": 5},
+            {"kind": "replay", "stage": 0, "link": "link0:down",
+             "count": 1, "from_seq": 6, "to_seq": 6},
+            {"kind": "run_summary", "duration_s": 1.0},
+        ])
+        summary = summarize_file(tmp_path / "m.jsonl")
+        assert summary["stage_restarts"] == 1
+        assert summary["replayed_microbatches"] == 3
+
+    def test_summarize_stage_counts_none_on_plain_runs(self, tmp_path):
+        from pytorch_distributed_rnn_tpu.obs.summary import summarize_file
+
+        _sidecar(tmp_path / "m.jsonl", 0, [
+            {"kind": "step", "step": 0, "dispatch_s": 0.001},
+        ])
+        summary = summarize_file(tmp_path / "m.jsonl")
+        assert summary["stage_restarts"] is None
+        assert summary["replayed_microbatches"] is None
+
+    def test_timeline_renders_stage_lane(self, tmp_path):
+        from pytorch_distributed_rnn_tpu.obs import validate_chrome_trace
+        from pytorch_distributed_rnn_tpu.obs.spans import SUBSYSTEM_TIDS
+        from pytorch_distributed_rnn_tpu.obs.timeline import (
+            build_chrome_trace,
+            load_run,
+        )
+
+        _sidecar(tmp_path / "m.jsonl", 0, [
+            {"kind": "stage_restart", "stage": 0, "resume_step": 2,
+             "ckpt": "c.ckpt"},
+            {"kind": "replay", "stage": 0, "link": "link0:down",
+             "count": 2, "from_seq": 4, "to_seq": 5},
+        ])
+        trace = build_chrome_trace(load_run(tmp_path / "m.jsonl"))
+        validate_chrome_trace(trace)
+        stage_events = [
+            e for e in trace["traceEvents"] if e.get("cat") == "stage"
+        ]
+        assert {e["name"] for e in stage_events} == {
+            "stage_restart", "replay",
+        }
+        assert all(e["tid"] == SUBSYSTEM_TIDS["stage"]
+                   for e in stage_events)
+
+
+# ---------------------------------------------------------------------------
+# CLI surface + single-stage (linkless) pipeline
+# ---------------------------------------------------------------------------
+
+
+def test_mpmd_cli_flags_parse():
+    args = mpmd.build_parser().parse_args([
+        "--stages", "4", "--layers", "8", "--microbatches", "3",
+        "--master-port", "29990", "--faults", "step:2:kill@1",
+        "--link-timeout", "45",
+    ])
+    assert args.stages == 4 and args.layers == 8
+    assert args.microbatches == 3 and args.master_port == 29990
+    assert args.faults == "step:2:kill@1"
+    assert args.link_timeout == 45.0
+
+
+def test_single_stage_pipeline_runs_linkless(tmp_path):
+    """stages=1 degenerates to plain training: no links, one fused
+    program - the in-process anchor for the spawn-world drills."""
+    args = mpmd.build_parser().parse_args([
+        "--stages", "1", "--layers", "2", "--steps", "2",
+        "--hidden-dim", "8", "--seq-len", "4", "--feature-dim", "4",
+        "--num-classes", "3", "--microbatch-size", "2",
+        "--checkpoint-directory", str(tmp_path / "ckpt"),
+    ])
+    mpmd.run_stage(args, 0)
+    result = json.loads(
+        (tmp_path / "ckpt" / "result-stage0.json").read_text()
+    )
+    assert result["steps"] == 2 and result["resumed_from_step"] == 0
+    assert np.isfinite(result["final_loss"])
+    assert result["trace_counts"] == {"last_step": 1, "update": 1}
+    assert result["reconnects"] == 0
+
+
+# ---------------------------------------------------------------------------
+# The acceptance drill: kill a middle stage, end bit-identical
+# ---------------------------------------------------------------------------
+
+
+def _mpmd_args(tmp_path, port, **kw):
+    argv = [
+        "--stages", "3", "--layers", "3", "--steps", "3",
+        "--feature-dim", "4", "--hidden-dim", "8", "--num-classes", "3",
+        "--seq-len", "4", "--microbatch-size", "2", "--microbatches", "2",
+        "--master-port", str(port),
+        "--checkpoint-directory", str(tmp_path),
+        "--metrics", str(tmp_path / "m.jsonl"),
+        "--log", "WARNING",
+    ]
+    for flag, value in kw.items():
+        argv += [f"--{flag.replace('_', '-')}", str(value)]
+    return mpmd.build_parser().parse_args(argv)
+
+
+def _results(tmp_path):
+    return {
+        s: json.loads((tmp_path / f"result-stage{s}.json").read_text())
+        for s in range(3)
+    }
+
+
+def _events(path):
+    return [json.loads(line)
+            for line in Path(path).read_text().splitlines() if line.strip()]
+
+
+@pytest.mark.chaos
+class TestMpmdChaosDrill:
+    def test_kill_middle_stage_respawns_replays_and_matches_baseline(
+        self, tmp_path
+    ):
+        """SIGKILL stage 1 at step 1: the supervisor respawns it into
+        the same stage-id, it restores its step-0 checkpoint and
+        re-dials; neighbors replay the in-flight window exactly once;
+        stages 0 and 2 SURVIVE IN PLACE with trace counts still 1; the
+        final loss and every stage's params are bit-identical to the
+        uninterrupted baseline."""
+        base_dir = tmp_path / "base"
+        chaos_dir = tmp_path / "chaos"
+        base_dir.mkdir()
+        chaos_dir.mkdir()
+        mpmd.run(_mpmd_args(base_dir, PORT + 10))
+        mpmd.run(_mpmd_args(chaos_dir, PORT + 20,
+                            faults="step:1:kill@1"))
+        base, chaos = _results(base_dir), _results(chaos_dir)
+
+        # bitwise end-state parity, the exactly-once proof
+        assert chaos[2]["final_loss"] == base[2]["final_loss"]
+        for s in range(3):
+            assert chaos[s]["params_crc"] == base[s]["params_crc"]
+
+        # the killed stage restored + resumed; the survivors never left
+        assert chaos[1]["resumed_from_step"] == 1
+        assert chaos[0]["resumed_from_step"] == 0
+        assert chaos[2]["resumed_from_step"] == 0
+
+        # restart-without-recompile: every program of every stage
+        # (including the respawned one, post-restore) traced exactly once
+        for s in range(3):
+            assert set(chaos[s]["trace_counts"].values()) == {1}
+
+        # the survivors reconnected and stage 0 replayed its window
+        assert chaos[0]["reconnects"] >= 1 and chaos[2]["reconnects"] >= 1
+        assert chaos[0]["replayed"] >= 1
+
+        # sidecars: supervisor respawned exactly stage 1; the restarted
+        # stage carries stage_restart, the survivors none; a replay
+        # event landed on stage 0's stream
+        sup = _events(chaos_dir / "m-r3.jsonl")
+        respawns = [e for e in sup if e["kind"] == "worker_respawn"]
+        assert len(respawns) == 1 and respawns[0]["rank"] == 1
+        assert any(e["kind"] == "stage_restart"
+                   for e in _events(chaos_dir / "m-r1.jsonl"))
+        assert not any(e["kind"] == "stage_restart"
+                       for e in _events(chaos_dir / "m-r2.jsonl"))
+        stage0 = _events(chaos_dir / "m.jsonl")
+        replays = [e for e in stage0 if e["kind"] == "replay"]
+        assert sum(e["count"] for e in replays) == chaos[0]["replayed"]
+
+        # pdrnn-metrics summarize reads the drill's own sidecars
+        from pytorch_distributed_rnn_tpu.obs.summary import (
+            summarize_file,
+            summarize_run,
+        )
+
+        assert summarize_file(
+            chaos_dir / "m-r1.jsonl"
+        )["stage_restarts"] == 1
+        assert summarize_file(
+            chaos_dir / "m.jsonl"
+        )["replayed_microbatches"] == chaos[0]["replayed"]
+        assert len(summarize_run(chaos_dir / "m.jsonl")) == 4
+
+        # and the timeline exporter renders the run validator-clean,
+        # with the recovery story on the stage lane
+        from pytorch_distributed_rnn_tpu.obs import validate_chrome_trace
+        from pytorch_distributed_rnn_tpu.obs.spans import SUBSYSTEM_TIDS
+        from pytorch_distributed_rnn_tpu.obs.timeline import (
+            build_chrome_trace,
+            load_run,
+        )
+
+        trace = build_chrome_trace(load_run(chaos_dir / "m.jsonl"))
+        validate_chrome_trace(trace)
+        stage_lane = [e for e in trace["traceEvents"]
+                      if e.get("cat") == "stage"]
+        assert {"stage_restart", "replay"} <= {
+            e["name"] for e in stage_lane
+        }
+        assert all(e["tid"] == SUBSYSTEM_TIDS["stage"]
+                   for e in stage_lane)
