@@ -98,6 +98,24 @@ class RespawnSupervisor:
             self.slots[rank] = _Slot(worker_id=rank, rank=rank,
                                      process=proc)
 
+    def adopt(self, rank: int, worker_id: int | None = None):
+        """Elastic JOIN: spawn a brand-new slot mid-run (a worker that
+        did not exist at launch) and supervise it like the rest - the
+        process half of a roster ``join``.  The new slot gets the full
+        respawn budget; ``min_workers`` is unchanged (joining must
+        never make an already-healthy pool collapsible)."""
+        worker_id = rank if worker_id is None else int(worker_id)
+        if worker_id in self.slots:
+            raise ValueError(
+                f"worker-id {worker_id} already supervised; a respawn "
+                f"reuses its slot, only a NEW identity can be adopted"
+            )
+        proc = self._spawn_worker(rank, worker_id, False)
+        self.slots[worker_id] = _Slot(worker_id=worker_id, rank=rank,
+                                      process=proc)
+        self._emit("worker_join", worker_id=worker_id, rank=rank)
+        return proc
+
     # -- monitoring ----------------------------------------------------------
 
     def _live_or_completed(self) -> int:
@@ -205,6 +223,34 @@ class RespawnSupervisor:
         }
 
 
+def supervision_alert_hook(recorder=None, push=None):
+    """The ONE ``on_event`` wiring for every supervisor flavor, so PS,
+    stage and actor supervisors emit ``worker_respawn`` /
+    ``worker_lost`` / ``pool_collapse`` (and elastic ``worker_join``)
+    alerts uniformly instead of each runner hand-rolling the plumbing:
+
+    - ``recorder`` (a :class:`~..obs.recorder.MetricsRecorder`): each
+      event lands in the supervisor's sidecar and is flushed
+      immediately - supervision events are rare and must survive a
+      teardown;
+    - ``push`` (the live plane's ``EventPusher.push``): the same event
+      goes to the fleet aggregator as an alert.
+
+    Returns ``None`` when there is nothing to wire (the supervisor then
+    skips hook dispatch entirely)."""
+    if recorder is None and push is None:
+        return None
+
+    def on_event(kind, **fields):
+        if recorder is not None and recorder.enabled:
+            recorder.record(kind, **fields)
+            recorder.flush()
+        if push is not None:
+            push(kind, **fields)
+
+    return on_event
+
+
 class ElasticSupervisor(RespawnSupervisor):
     """PS flavor: supervises the WORKER processes around an
     unsupervised master (the master owns the state; its exit anchors
@@ -237,3 +283,15 @@ class StageSupervisor(RespawnSupervisor):
         super().launch(ranks)
         if self._floor_is_all:
             self.min_workers = len(self.slots)
+
+
+class ActorSupervisor(RespawnSupervisor):
+    """Streaming actor/learner flavor (``streaming/runner.py``): the
+    actor FLEET is supervised around a separately-watched learner.  A
+    respawned actor star-joins the learner's listener on its old rank
+    and REGISTERs under its stable worker-id, so its experience-push
+    watermark carries over (a retried or post-respawn push dedupes
+    instead of training on the same batch twice); :meth:`adopt` covers
+    the elastic-join drill (a brand-new actor entering mid-run).  The
+    floor is the minimum actor count that keeps the learner fed -
+    losing actors degrades throughput, never correctness."""

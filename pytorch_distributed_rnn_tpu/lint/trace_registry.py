@@ -48,6 +48,7 @@ PROVIDER_MODULES = (
     "pytorch_distributed_rnn_tpu.training.moe",
     "pytorch_distributed_rnn_tpu.serving.engine",
     "pytorch_distributed_rnn_tpu.parallel.mpmd",
+    "pytorch_distributed_rnn_tpu.streaming.runner",
 )
 
 # virtual CPU devices the deep pass guarantees when it owns the jax

@@ -84,6 +84,20 @@ _SUMMARY_FIELDS = (
     ("queue_depth_p50", "{:.0f}"),
     ("queue_depth_p95", "{:.0f}"),
     ("queue_depth_max", "{:.0f}"),
+    # streaming actor/learner runs (absent on everything else - the
+    # summary only carries these keys off a streaming learner's
+    # run_summary, so None-means-skip keeps other runs noise-free)
+    ("experience_batches", "{:d}"),
+    ("experience_per_s", "{:.1f}"),
+    ("updates_per_s", "{:.1f}"),
+    ("stale_rejected", "{:d}"),
+    ("queue_sheds", "{:d}"),
+    ("duplicates", "{:d}"),
+    ("poisoned", "{:d}"),
+    ("staleness_p50", "{:.0f}"),
+    ("staleness_p95", "{:.0f}"),
+    ("final_version", "{:d}"),
+    ("rejoins", "{:d}"),
 )
 
 
@@ -157,8 +171,9 @@ def main(argv=None) -> int:
         "(dead) or whose heartbeats continue without progress (stalled); "
         "a rank that DEREGISTERed (member_drain - the SIGTERM drain "
         "path) is 'drained' and healthy, not dead, and a respawned MPMD "
-        "stage still restoring/retracing after a stage_restart is "
-        "'recovering', not stalled",
+        "stage still restoring/retracing after a stage_restart - or a "
+        "streaming actor registered with the learner but not yet "
+        "pushing - is 'recovering', not stalled",
     )
     p.add_argument("files", nargs="+")
     p.add_argument("--stale-after", type=float, default=30.0, metavar="S",

@@ -511,6 +511,31 @@ class EventPusher:
         })
 
 
+def resolve_event_push(args, *, role: str = "supervisor",
+                       wait_s: float = 2.0):
+    """The supervisor-parent push wiring, shared by every supervised
+    runner (elastic PS, MPMD stages, streaming actors): an
+    :class:`EventPusher` ``push`` bound to the run's aggregator, or
+    ``None`` when the live plane is off.  Gated on BOTH ``--live`` and
+    ``--metrics`` (matching LivePlane.resolve: live rides the metrics
+    writer thread, so live-without-metrics is rejected there too).  The
+    sink is lazy: with ``--live 0`` the anchor child binds its
+    ephemeral port after the supervisor constructs the pusher, so the
+    port file is only readable at push time."""
+    from pytorch_distributed_rnn_tpu.obs.recorder import METRICS_ENV
+
+    live_spec = getattr(args, "live", None) or os.environ.get(LIVE_ENV)
+    if not live_spec:
+        return None
+    if not (getattr(args, "metrics", None) or os.environ.get(METRICS_ENV)):
+        return None
+    host, port = parse_live_spec(live_spec)
+    return EventPusher(
+        lambda: resolve_push_url(args, host, port, wait_s=wait_s),
+        role=role,
+    ).push
+
+
 class LivePlane:
     """The wired-together live plane of ONE process: exporter (+local
     aggregator HTTP server when this process is the rank-0/master

@@ -87,13 +87,36 @@ alert               alert (stall | stall_cleared | nan_streak |
                     plane's /events and the Prometheus exposition in
                     obs/aggregator.py mirror this stream)
 profile             dir, start, stop, captured
+experience_reject   worker_id, seq, reason (duplicate | stale |
+                    backoff | stale_at_apply | poisoned) + verdict
+                    fields - one EXPERIENCE push the streaming learner
+                    refused, counted never silently dropped
+                    (streaming/learner.py)
+params_refresh      worker_id, from_version, to_version - an actor
+                    pulled fresh params (PARAMS_AT) after a STALE
+                    verdict or on its proactive refresh cadence
+actor_reconnect     worker_id, attempts, seq, version - an actor
+                    re-registered with a (reincarnated) learner and
+                    resumes pushing above its seq watermark;
+                    pdrnn-metrics health treats a registered actor
+                    with no push since as recovering, not stalled
+learner_summary     updates, final_version, rejoins + ingest counters
+                    - the streaming learner's verdict line
 run_summary         memory_mb, duration_s, device_peaks_mb, steps,
                     nan_skipped, faults_fired; the PS master's variant
-                    carries roster counts + rejoins + degraded_rounds
+                    carries roster counts + rejoins + degraded_rounds;
+                    the streaming learner's adds experience_batches,
+                    experience_per_s, updates_per_s, stale_rejected,
+                    queue_sheds, duplicates, poisoned,
+                    staleness_p50/p95, final_version
 =================== =======================================================
 
 Span names on the ``member`` lane: ``state_sync`` (REGISTER -> params
-adoption, emitted by both master and the joining worker).
+adoption, emitted by both master and the joining worker - the
+streaming actor/learner pair reuses it with the learner version in the
+step slot).  Span names on the ``actor`` lane: ``experience_push``
+(actor-side push exchange incl. retries/backoffs) and
+``learner_update`` (one applied update with its staleness).
 """
 
 from __future__ import annotations

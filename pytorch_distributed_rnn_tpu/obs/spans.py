@@ -53,6 +53,9 @@ SUBSYSTEM_TIDS = {
     # MPMD pipeline lane: stage_restart/replay instants (parallel/mpmd.py
     # + runtime/stage.py link recovery)
     "stage": 11,
+    # streaming actor/learner lane: experience pushes, params refreshes,
+    # staleness rejections (streaming/actor.py + streaming/learner.py)
+    "actor": 12,
 }
 
 

@@ -112,6 +112,19 @@ SERVING_SUMMARY_KEYS = (
 )
 
 
+# streaming actor/learner metrics the learner folds into its
+# run_summary event (streaming/learner.py): experience ingest rate,
+# applied-update rate, and the bounded-staleness / exactly-once /
+# backpressure rejection counters.  Same verbatim-passthrough contract
+# as the serving keys: present on a streaming learner's sidecar, absent
+# (None, not 0) on every other run.
+STREAMING_SUMMARY_KEYS = (
+    "experience_batches", "experience_per_s", "updates_per_s",
+    "stale_rejected", "queue_sheds", "duplicates", "poisoned",
+    "staleness_p50", "staleness_p95", "final_version", "rejoins",
+)
+
+
 def summarize_events(events: list[dict], path=None) -> dict:
     """One rank's summary: the numbers ``pdrnn-metrics summarize`` prints
     and ``evaluation/analysis.py`` folds into the measurement dataframe."""
@@ -266,7 +279,7 @@ def summarize_events(events: list[dict], path=None) -> dict:
     if run and run.get("roster") is not None:
         summary["roster"] = run["roster"]
     if run:
-        for key in SERVING_SUMMARY_KEYS:
+        for key in SERVING_SUMMARY_KEYS + STREAMING_SUMMARY_KEYS:
             if key in run:
                 summary[key] = run[key]
     return summary
@@ -343,8 +356,12 @@ def rank_health(events: list[dict], now: float | None = None,
     - ``recovering`` - heartbeats fresh, no progress, but the last
       thing this rank did was a ``stage_restart`` with no ``step``
       landed since: a respawned MPMD stage still restoring its
-      checkpoint and retracing its programs.  Expected recovery work,
-      not a stall - healthy, exit 0;
+      checkpoint and retracing its programs.  The same verdict covers a
+      streaming actor (``role: actor`` in its meta head) that
+      registered with the learner - a ``state_sync`` span or
+      ``actor_reconnect`` landed - but has not pushed a batch since:
+      it is compiling its rollout or riding out backpressure, not
+      wedged.  Expected recovery work, not a stall - healthy, exit 0;
     - ``stalled``  - heartbeats fresh but no progress for
       ``stale_after`` seconds: alive and stuck (the chaos harness's
       ``stall`` fault, a hung collective, a starved loader);
@@ -393,6 +410,18 @@ def rank_health(events: list[dict], now: float | None = None,
         restart_ts = [
             float(e["t"]) for e in events if e["kind"] == "stage_restart"
         ]
+        # a streaming actor's registration witnesses play the same role
+        # as a stage_restart: joined/rejoined the learner, first push
+        # still pending.  Gated on the actor role so a PS/streaming
+        # MASTER's sidecar (which carries state_sync spans for its
+        # members' joins) can never launder its own stall as recovery.
+        if events[0].get("role") == "actor":
+            restart_ts += [
+                float(e["t"]) for e in events
+                if e["kind"] == "actor_reconnect"
+                or (e["kind"] == "span" and e.get("name") == "state_sync")
+            ]
+            restart_ts.sort()
         stepped_since = restart_ts and any(
             e["kind"] == "step" and float(e["t"]) >= restart_ts[-1]
             for e in events

@@ -373,6 +373,14 @@ def build_chrome_trace(by_rank: dict[int, list[dict]],
                     # the frames its neighbors replayed to it
                     "stage_restart": "stage", "replay": "stage",
                     "worker_respawn": "stage", "worker_lost": "stage",
+                    # the streaming actor lane: ingest verdicts and
+                    # param refreshes flash next to the experience_push
+                    # / learner_update spans (cat=actor); a reconnect
+                    # is a membership story and lands on that row
+                    "experience_reject": "actor",
+                    "params_refresh": "actor",
+                    "actor_reconnect": "member",
+                    "learner_summary": "run",
                 }.get(kind, "sys")
                 tb.instant(rank, cat, kind, w, _args(e), scope)
 

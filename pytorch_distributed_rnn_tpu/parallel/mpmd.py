@@ -567,7 +567,9 @@ def run(args) -> None:
     re-dialing its fixed link ports."""
     from pytorch_distributed_rnn_tpu.launcher.supervisor import (
         StageSupervisor,
+        supervision_alert_hook,
     )
+    from pytorch_distributed_rnn_tpu.obs.live import resolve_event_push
     from pytorch_distributed_rnn_tpu.obs.recorder import MetricsRecorder
     from pytorch_distributed_rnn_tpu.resilience.faults import FaultSchedule
 
@@ -586,10 +588,9 @@ def run(args) -> None:
         meta={"role": "stage-supervisor", "stages": cfg.stages},
     )
 
-    def on_event(kind, **fields):
-        if recorder.enabled:
-            recorder.record(kind, **fields)
-            recorder.flush()
+    on_event = supervision_alert_hook(
+        recorder=recorder, push=resolve_event_push(args, role="stage-sup"),
+    )
 
     ctx = mp.get_context("spawn")
 

@@ -39,6 +39,31 @@ format with three membership ops:
                the roster without burning quorum budget
   STATE_SYNC - reserved for symmetry (the reply to REGISTER; never sent
                worker -> master)
+
+The streaming actor/learner runner (``streaming/``) adds two ops on the
+same wire so experience traffic composes with the membership machinery
+above instead of needing a second transport:
+
+  EXPERIENCE - an actor pushes one version-stamped experience batch:
+               the 2-float request header ``[opcode, seq]`` is followed
+               by an extension header ``[params_version, payload_len]``
+               and then the float32 payload.  The learner ALWAYS
+               replies with a fixed 3-float verdict
+               ``[status, learner_version, throttle_hint_s]`` so the
+               wire never stalls: OK (enqueued, watermark advanced),
+               DUPLICATE (seq at-or-below the actor's watermark -
+               acknowledged but not re-applied), STALE (generated more
+               than ``--max-staleness`` versions ago - actor must
+               refresh params and re-send under a fresh version) or
+               BACKOFF (learner queue full - actor sleeps the throttle
+               hint and retries the SAME seq).
+  PARAMS_AT  - an actor asks for current params; the learner replies
+               ``[params_version]`` + the flat vector.  Unlike PULL
+               this reply is version-stamped, which is what lets the
+               actor stamp the batches it generates.
+
+float32 carries seq/version counts exactly up to 2^24, same budget as
+the PUSH seq header.
 """
 
 from __future__ import annotations
@@ -51,9 +76,19 @@ OP_DONE = 3
 OP_REGISTER = 4
 OP_DEREGISTER = 5
 OP_STATE_SYNC = 6
+OP_EXPERIENCE = 7
+OP_PARAMS_AT = 8
+
+# EXPERIENCE reply statuses (the first float of the verdict header)
+EXP_OK = 0
+EXP_DUPLICATE = 1
+EXP_STALE = 2
+EXP_BACKOFF = 3
 
 _HEADER_DTYPE = np.float32
 _HEADER_LEN = 2  # [opcode, seq]  (seq doubles as worker-id for REGISTER)
+_EXP_EXT_LEN = 2  # [params_version, payload_len]
+_EXP_REPLY_LEN = 3  # [status, learner_version, throttle_hint_s]
 
 
 def send_request(comm, opcode: int, grads: np.ndarray = None,
@@ -106,3 +141,56 @@ def recv_state_sync(comm, num_params: int):
         )
     flat = recv_params(comm, num_params)
     return flat, int(header[1]), int(header[2])
+
+
+def send_experience(comm, seq: int, version: int, payload: np.ndarray):
+    """Actor side: push one experience batch stamped with the params
+    version it was generated under."""
+    send_request(comm, OP_EXPERIENCE, seq=seq)
+    flat = np.asarray(payload, dtype=np.float32).reshape(-1)
+    ext = np.array([float(version), float(flat.size)], dtype=_HEADER_DTYPE)
+    comm.send(0, ext)
+    comm.send(0, flat)
+
+
+def recv_experience_ext(comm, worker: int):
+    """Learner side: after ``recv_request`` returned OP_EXPERIENCE,
+    receive the extension header + payload.
+    Returns (params_version, payload)."""
+    ext = comm.recv(worker, (_EXP_EXT_LEN,), np.float32)
+    version = int(ext[0])
+    payload_len = int(ext[1])
+    payload = comm.recv(worker, (payload_len,), np.float32)
+    return version, payload
+
+
+def send_experience_reply(comm, worker: int, status: int, version: int,
+                          throttle_hint_s: float = 0.0):
+    """Learner side: the fixed verdict reply to every EXPERIENCE push."""
+    header = np.array(
+        [float(status), float(version), float(throttle_hint_s)],
+        dtype=_HEADER_DTYPE,
+    )
+    comm.send(worker, header)
+
+
+def recv_experience_reply(comm):
+    """Actor side: receive the verdict.
+    Returns (status, learner_version, throttle_hint_s)."""
+    header = comm.recv(0, (_EXP_REPLY_LEN,), np.float32)
+    return int(header[0]), int(header[1]), float(header[2])
+
+
+def send_params_at(comm, worker: int, version: int,
+                   flat_params: np.ndarray):
+    """Learner side: the PARAMS_AT reply - [version] + current params."""
+    comm.send(worker, np.array([float(version)], dtype=_HEADER_DTYPE))
+    send_params(comm, worker, flat_params)
+
+
+def recv_params_at(comm, num_params: int):
+    """Actor side: receive the PARAMS_AT reply.
+    Returns (flat_params, version)."""
+    header = comm.recv(0, (1,), np.float32)
+    flat = recv_params(comm, num_params)
+    return flat, int(header[0])

@@ -32,8 +32,6 @@ import numpy as np
 import optax
 from jax.flatten_util import ravel_pytree
 
-from pytorch_distributed_rnn_tpu.obs.live import LIVE_ENV
-from pytorch_distributed_rnn_tpu.obs.recorder import METRICS_ENV
 from pytorch_distributed_rnn_tpu.runtime import Communicator
 
 log = logging.getLogger(__name__)
@@ -386,7 +384,9 @@ def _run_elastic(args, ctx):
     budget runs out, a drain/completion (exit 0) is terminal."""
     from pytorch_distributed_rnn_tpu.launcher.supervisor import (
         ElasticSupervisor,
+        supervision_alert_hook,
     )
+    from pytorch_distributed_rnn_tpu.obs.live import resolve_event_push
 
     master = ctx.Process(target=_spawn_entry, args=(args, 0))
     master.start()
@@ -402,28 +402,11 @@ def _run_elastic(args, ctx):
     # recorder (rank 0's sidecar belongs to the master child), so
     # respawn/collapse findings go straight to the aggregator over the
     # live plane's push contract
-    on_event = None
-    live_spec = getattr(args, "live", None) or os.environ.get(LIVE_ENV)
-    if live_spec and (
-        getattr(args, "metrics", None) or os.environ.get(METRICS_ENV)
-    ):
-        from pytorch_distributed_rnn_tpu.obs.live import (
-            EventPusher,
-            parse_live_spec,
-            resolve_push_url,
-        )
-
-        host, port = parse_live_spec(live_spec)
-        # lazy sink: with --live 0 the master CHILD binds the port after
-        # this point - the port file is only readable at push time
-        on_event = EventPusher(
-            lambda: resolve_push_url(args, host, port, wait_s=2.0)
-        ).push
     supervisor = ElasticSupervisor(
         spawn_worker,
         min_workers=int(getattr(args, "min_workers", 1) or 1),
         max_respawns=int(getattr(args, "ps_max_respawns", 3)),
-        on_event=on_event,
+        on_event=supervision_alert_hook(push=resolve_event_push(args)),
     )
     supervisor.launch(range(1, args.world_size))
     healthy = supervisor.supervise(lambda: master.exitcode)

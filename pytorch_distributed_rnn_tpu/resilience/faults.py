@@ -24,6 +24,14 @@ Spec grammar (``--faults`` flag / ``PDRNN_CHAOS`` env)::
                                             (non-finite grads; pairs with the
                                             NonFiniteGuard skip path)
             | stall[:<seconds>]             data-loader stall (default 0.25 s)
+            | slow[:<frac>]                 SUSTAINED straggler: from the
+                                            addressed step/epoch on, every
+                                            producer item is delayed by frac x
+                                            the time since the previous one
+                                            (default 0.5) - a degraded node,
+                                            not a hung one; fires (and is
+                                            counted/recorded) once, at
+                                            activation
             | exc                           data-loader exception (ChaosError)
             | kill                          SIGKILL this process (simulated
                                             preemption; pairs with --resume auto)
@@ -64,9 +72,13 @@ CHAOS_ENV = "PDRNN_CHAOS"
 FAULT_DELAY_ENV = "PDRNN_FAULT_DELAY_MS"
 FAULT_LOSS_ENV = "PDRNN_FAULT_LOSS_PROB"
 
-_ACTIONS = ("nan", "stall", "exc", "kill", "respawn", "preempt")
+_ACTIONS = ("nan", "stall", "slow", "exc", "kill", "respawn", "preempt")
 _TRIGGERS = ("step", "epoch", "prob")
 _DEFAULT_STALL_S = 0.25
+_DEFAULT_SLOW_FRAC = 0.5
+# a sustained-slow delay is proportional to the inter-item gap; cap it so
+# a one-off long gap (checkpoint, compile) cannot snowball into a stall
+_SLOW_DELAY_CAP_S = 1.0
 # process-lifetime actions (maybe_kill handles all three): how each dies
 _LIFETIME_ACTIONS = ("kill", "respawn", "preempt")
 # the respawn action's abrupt-crash exit code: nonzero so a supervisor
@@ -140,6 +152,11 @@ class FaultSchedule:
         # late attribute (not a constructor arg) so resilience stays
         # importable without the obs package in the picture
         self.recorder = None
+        # sustained-straggler state (`slow` action): 0.0 = inactive;
+        # once an event's address matches, the fraction sticks for the
+        # rest of this incarnation
+        self._slow_frac = 0.0
+        self._slow_prev_tm: float | None = None
 
     # -- construction --------------------------------------------------------
 
@@ -169,6 +186,8 @@ class FaultSchedule:
                     arg = float(fields[3]) if len(fields) > 3 else None
                     if action == "stall" and arg is None:
                         arg = _DEFAULT_STALL_S
+                    if action == "slow" and arg is None:
+                        arg = _DEFAULT_SLOW_FRAC
                     events.append(FaultEvent(kind, at, action, arg, rank))
                 else:
                     raise ValueError(f"unknown trigger {kind!r}")
@@ -325,11 +344,43 @@ class FaultSchedule:
             if e.action == "stall":
                 self._fire(e, f"loader step {step}")
                 self._timed_stall(e, step=step)
+            elif e.action == "slow":
+                self._activate_slow(e, f"loader step {step}")
             elif e.action == "exc":
                 self._fire(e, f"loader step {step}")
                 raise ChaosError(
                     f"injected data-loader failure at step {step} ({e})"
                 )
+        self._apply_slow()
+
+    def _activate_slow(self, event: FaultEvent, where: str):
+        """Latch a sustained-straggler fraction.  Fires (counter +
+        telemetry) once per activation, not per delayed item - the
+        degradation is continuous, the event marks its onset."""
+        frac = float(event.arg or _DEFAULT_SLOW_FRAC)
+        if frac > self._slow_frac:
+            self._fire(event, where)
+            self._slow_frac = frac
+            self._slow_prev_tm = time.perf_counter()
+
+    def _apply_slow(self):
+        """Delay this producer item by ``frac`` x the inter-item gap -
+        a node running at 1/(1+frac) speed, not a one-shot hang."""
+        if not self._slow_frac:
+            return
+        now = time.perf_counter()
+        if self._slow_prev_tm is not None:
+            delay = min(self._slow_frac * (now - self._slow_prev_tm),
+                        _SLOW_DELAY_CAP_S)
+            if delay > 0:
+                time.sleep(delay)
+        self._slow_prev_tm = time.perf_counter()
+
+    @property
+    def slow_active(self) -> bool:
+        """Whether a sustained ``slow`` fault has latched (observability
+        for drills asserting the straggler actually degraded)."""
+        return self._slow_frac > 0
 
     def corrupt_batch(self, step: int, batch):
         """Non-finite-gradient injection: replace step ``step``'s features
@@ -386,6 +437,8 @@ class FaultSchedule:
             if e.action == "stall":
                 self._fire(e, f"epoch {epoch}")
                 self._timed_stall(e, epoch=epoch)
+            elif e.action == "slow":
+                self._activate_slow(e, f"epoch {epoch}")
             elif e.action == "exc":
                 self._fire(e, f"epoch {epoch}")
                 raise ChaosError(f"injected failure at epoch {epoch} ({e})")

@@ -193,6 +193,31 @@ class Roster:
             member.synced = True
             return True
 
+    def watermarks(self) -> dict[int, int]:
+        """Per-worker-id push-seq watermark snapshot - what a streaming
+        learner persists alongside its params so the exactly-once
+        guarantee survives ITS OWN restart, not just the pushers'."""
+        with self._lock:
+            return {m.worker_id: m.push_seq for m in self._members.values()}
+
+    def restore_watermarks(self, watermarks: dict) -> None:
+        """Re-seed watermarks from a checkpoint (the learner-failover
+        inverse of :meth:`watermarks`).  Known members only RAISE their
+        mark; unknown worker-ids are pre-rostered as ``dead`` (rankless)
+        so they re-enter only via REGISTER - and their first post-restart
+        push dedupes against the restored mark instead of re-applying
+        experience the dead incarnation already trained on."""
+        now = time.perf_counter()
+        with self._lock:
+            for worker_id, seq in watermarks.items():
+                worker_id, seq = int(worker_id), int(seq)
+                member = self._members.get(worker_id)
+                if member is None:
+                    member = Member(worker_id=worker_id, rank=-1,
+                                    state=DEAD, synced=False, died_tm=now)
+                    self._members[worker_id] = member
+                member.push_seq = max(member.push_seq, seq)
+
     # -- queries -------------------------------------------------------------
 
     def member_for_rank(self, rank: int) -> Member | None:

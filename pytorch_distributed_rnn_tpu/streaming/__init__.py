@@ -1,0 +1,48 @@
+"""Streaming actor/learner training (Podracer-style, `pdrnn-stream`).
+
+The repo's first ASYNCHRONOUS workload: N actor processes continuously
+roll out the motion/char model on their data shard and push
+version-stamped experience batches over the parameter-server wire
+(``param_server/protocol.py`` EXPERIENCE/PARAMS_AT ops); ONE learner
+ingests them through a bounded queue and applies jitted updates off the
+actors' cadence - the Anakin/Sedna split from the Podracer
+architectures paper (PAPERS.md), built on the elastic-membership /
+chaos machinery of PRs 2/7/11.
+
+Robustness is the headline:
+
+- **bounded staleness** - every batch carries the params version it was
+  generated under; the learner rejects batches older than
+  ``--max-staleness`` (counted, never silently dropped) and actors
+  refresh params on rejection;
+- **exactly-once ingest** - per-actor push-seq watermarks on the
+  elastic roster, persisted WITH the params in every learner
+  checkpoint, so a retried / post-respawn / post-failover push is never
+  applied twice;
+- **elastic actor fleet** - actors REGISTER/STATE_SYNC mid-run, drain
+  on SIGTERM, and are respawned under stable worker-ids by an
+  :class:`~..launcher.supervisor.ActorSupervisor`;
+- **backpressure** - a full learner queue NACKs with a throttle hint
+  instead of stalling the wire;
+- **learner failover** - crash-safe checkpoints of
+  params+optimizer+version+watermarks; a ``--resume auto`` restart
+  re-listens on the same port and live actors reconnect and resume
+  above their watermark.
+"""
+
+from pytorch_distributed_rnn_tpu.streaming.actor import StreamingActor, run_actor
+from pytorch_distributed_rnn_tpu.streaming.learner import (
+    ExperienceLearner,
+    run_learner,
+)
+from pytorch_distributed_rnn_tpu.streaming.runner import build_parser, main, run
+
+__all__ = [
+    "ExperienceLearner",
+    "StreamingActor",
+    "build_parser",
+    "main",
+    "run",
+    "run_actor",
+    "run_learner",
+]

@@ -1,0 +1,4 @@
+from pytorch_distributed_rnn_tpu.streaming.runner import main
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,709 @@
+"""Streaming learner: bounded-staleness experience ingest + failover.
+
+The learner half of the Podracer-style actor/learner split
+(``streaming/__init__.py``).  One process owns the authoritative
+params + optimizer, listens on the PS wire, and serves an elastic actor
+fleet; unlike the PS master its update cadence is DECOUPLED from the
+pushers' - experience lands in a bounded queue and a single apply loop
+drains it, so a burst of actors never serializes behind one optimizer
+step and a slow optimizer step never stalls the wire.
+
+Ingest verdicts (the EXPERIENCE reply contract, ``protocol.py``):
+
+  DUPLICATE  seq at-or-below the actor's push-seq watermark - a retried
+             push whose original landed, or a respawned/reconnected
+             actor's stale in-flight push.  Acknowledged (the actor
+             moves on) but never applied twice: EXACTLY-ONCE ingest.
+  STALE      generated more than ``max_staleness`` versions ago.
+             Counted and refused - never silently dropped - and the
+             actor refreshes params before re-sending: BOUNDED
+             STALENESS.  Staleness is also re-checked at APPLY time
+             (the version advances while a batch queues), so the bound
+             holds on what is applied, not just on what is accepted.
+  BACKOFF    the bounded queue is full.  The reply carries a throttle
+             hint and the watermark does NOT advance, so the actor
+             re-sends the same seq after a sleep: BACKPRESSURE without
+             stalling the wire or dropping work.
+  OK         watermark advanced, batch enqueued.
+
+Failover: every ``checkpoint_updates`` applied updates the learner
+snapshots params + optimizer + its params version + the per-actor
+watermarks into ONE crash-safe checkpoint (``training/checkpoint.py``
+``extra`` header - atomic with the params, so a crash can never leave
+new params with stale watermarks).  A ``--resume auto`` restart
+re-listens on the same port; live actors' transport retries reconnect
+(star re-join + REGISTER) and their restored watermarks dedupe any
+re-sent experience the dead incarnation already applied.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue as queue_mod
+import threading
+import time
+
+import numpy as np
+
+from pytorch_distributed_rnn_tpu.param_server import protocol
+from pytorch_distributed_rnn_tpu.resilience import membership
+
+log = logging.getLogger(__name__)
+
+# staleness samples kept for the p50/p95 summary: bounded so a
+# long-running learner cannot grow host memory with telemetry
+_MAX_STALENESS_SAMPLES = 100_000
+
+
+class ExperienceLearner:
+    """Owns params/optimizer/version/watermarks; serves the actor fleet.
+
+    ``update_fn(flat_params, opt_state, flat_grads) -> (flat, opt)`` is
+    the jitted optimizer step (the caller closes over optax + unravel);
+    ``checkpoint_cb(version, flat, opt, watermarks, counters)``, when
+    given, is invoked every ``checkpoint_updates`` applied updates and
+    once more synchronously at the end of :meth:`serve`.
+    """
+
+    def __init__(self, comm, flat_params: np.ndarray, opt_state,
+                 update_fn, *, max_staleness: int = 4,
+                 queue_depth: int = 8, throttle_hint_s: float = 0.05,
+                 join_timeout: float = 30.0, max_world: int = 16,
+                 version: int = 0, watermarks: dict | None = None,
+                 checkpoint_cb=None, checkpoint_updates: int = 0,
+                 recorder=None, faults=None):
+        from pytorch_distributed_rnn_tpu.obs.recorder import NULL_RECORDER
+
+        self.recorder = recorder if recorder is not None else NULL_RECORDER
+        self.comm = comm
+        self.params = np.asarray(flat_params, np.float32)
+        self.opt_state = opt_state
+        self.update_fn = update_fn
+        self.num_params = int(self.params.size)
+        self.max_staleness = int(max_staleness)
+        self.throttle_hint_s = float(throttle_hint_s)
+        self.join_timeout = float(join_timeout)
+        self.max_world = int(max_world)
+        self.checkpoint_cb = checkpoint_cb
+        self.checkpoint_updates = int(checkpoint_updates)
+        self.faults = faults
+        # params + version are one atomic pair under this lock: every
+        # reply that quotes the version (STATE_SYNC, PARAMS_AT, verdicts)
+        # reads both together, so an actor can never stamp new params
+        # with an old version number
+        self.lock = threading.Lock()
+        self.version = int(version)
+        # the bounded ingest queue - the backpressure boundary.  Service
+        # threads put_nowait; only the apply loop gets.
+        self.queue: queue_mod.Queue = queue_mod.Queue(
+            maxsize=max(1, int(queue_depth))
+        )
+        # membership: same roster as the PS master, but NEVER
+        # bootstrapped - every actor (launch-time or late) enters via
+        # star-join + REGISTER, so the learner is elastic by
+        # construction and a restart needs no rendezvous arithmetic
+        self.roster = membership.Roster(recorder=self.recorder)
+        if watermarks:
+            # failover restore: dead incarnation's exactly-once state
+            self.roster.restore_watermarks(watermarks)
+        # counters (reported in run_summary; None-vs-0 semantics are the
+        # summary's job - here they are honest zeros)
+        self.updates_applied = 0
+        self.accepted = 0
+        self.duplicates = 0
+        self.stale_rejected = 0
+        self.queue_sheds = 0
+        self.poisoned = 0
+        self.duration_s = 0.0
+        self._staleness_samples: list[int] = []
+        # elastic service-thread bookkeeping (master.py idiom): a stale
+        # thread dying after its rank was re-accepted must not mark the
+        # NEW incarnation dead
+        self._thread_gen: dict[int, int] = {}
+        self._gen_lock = threading.Lock()
+        self._tolerated: dict[int, BaseException] = {}
+        self._member_cv = threading.Condition()
+
+    # -- ingest verdict ------------------------------------------------------
+
+    def ingest(self, rank: int, seq: int, version: int,
+               payload: np.ndarray):
+        """Verdict one EXPERIENCE push.  Returns ``(status,
+        learner_version, throttle_hint_s)`` - the exact reply triple.
+
+        Check order matters: DUPLICATE before STALE (a retried push
+        whose original applied must be ACKed as applied even if it
+        would fail the staleness gate by now - the actor treats
+        DUPLICATE as success and moves on); the watermark advances only
+        after the enqueue succeeded, so a BACKOFF or STALE refusal
+        leaves the actor free to re-send the same seq."""
+        member = self.roster.member_for_rank(rank)
+        if member is None:
+            raise RuntimeError(
+                f"experience push from unrostered rank {rank} without "
+                "REGISTER; actor-fleet entry requires the join protocol"
+            )
+        if member.state == membership.DEAD:
+            raise RuntimeError(
+                f"experience push from dead member (worker-id "
+                f"{member.worker_id}, rank {rank}) without REGISTER; "
+                "membership re-entry requires the join protocol"
+            )
+        with self.lock:
+            current = self.version
+        if seq <= member.push_seq:
+            self.duplicates += 1
+            self._reject("duplicate", member, seq, version, current)
+            return protocol.EXP_DUPLICATE, current, 0.0
+        if version < current - self.max_staleness:
+            self.stale_rejected += 1
+            self._reject("stale", member, seq, version, current)
+            return protocol.EXP_STALE, current, 0.0
+        item = (member.worker_id, seq, version,
+                np.asarray(payload, np.float32))
+        try:
+            self.queue.put_nowait(item)
+        except queue_mod.Full:
+            self.queue_sheds += 1
+            self._reject("backoff", member, seq, version, current)
+            return protocol.EXP_BACKOFF, current, self.throttle_hint_s
+        self.roster.note_push(rank, seq)
+        self.accepted += 1
+        return protocol.EXP_OK, current, 0.0
+
+    def _reject(self, reason: str, member, seq: int, version: int,
+                current: int):
+        log.warning(
+            f"experience {reason}: worker-id {member.worker_id} seq "
+            f"{seq} version {version} (learner @ {current})"
+        )
+        if self.recorder.enabled:
+            self.recorder.record(
+                "experience_reject", reason=reason,
+                worker_id=member.worker_id, seq=seq,
+                batch_version=version, learner_version=current,
+            )
+
+    # -- apply loop ----------------------------------------------------------
+
+    def _apply(self, item) -> None:
+        worker_id, seq, batch_version, payload = item
+        with self.lock:
+            current = self.version
+        if batch_version < current - self.max_staleness:
+            # the version advanced while the batch queued: the bound is
+            # on what is APPLIED, so refuse here too - counted, and the
+            # watermark already covers the seq so the actor (correctly)
+            # does not re-send this batch
+            self.stale_rejected += 1
+            if self.recorder.enabled:
+                self.recorder.record(
+                    "experience_reject", reason="stale_at_apply",
+                    worker_id=worker_id, seq=seq,
+                    batch_version=batch_version, learner_version=current,
+                )
+            return
+        if payload.size != self.num_params + 1 or not np.isfinite(
+            payload
+        ).all():
+            # a poisoned batch (chaos nan injection, torn payload) must
+            # not kill the learner mid-fleet: count and drop, loudly
+            self.poisoned += 1
+            log.warning(
+                f"dropping poisoned experience batch: worker-id "
+                f"{worker_id} seq {seq} (size {payload.size}, "
+                f"finite={bool(np.isfinite(payload).all())})"
+            )
+            if self.recorder.enabled:
+                self.recorder.record(
+                    "experience_reject", reason="poisoned",
+                    worker_id=worker_id, seq=seq,
+                    batch_version=batch_version,
+                )
+            return
+        loss = float(payload[0])
+        t0 = time.perf_counter()
+        with self.lock:
+            new_flat, new_opt = self.update_fn(
+                self.params, self.opt_state, payload[1:]
+            )
+            self.params = np.asarray(new_flat, np.float32)
+            self.opt_state = new_opt
+            self.version += 1  # strictly monotone, one bump per update
+            applied_version = self.version
+        self.updates_applied += 1
+        staleness = applied_version - 1 - batch_version
+        if len(self._staleness_samples) < _MAX_STALENESS_SAMPLES:
+            self._staleness_samples.append(staleness)
+        self.recorder.note_progress(self.updates_applied)
+        if self.recorder.enabled and self.recorder.is_sample_step(
+            self.updates_applied
+        ):
+            # the learner's "step" is one applied update: the standard
+            # step event keeps summarize/health/timeline progress
+            # semantics; the span lands on the actor lane with the
+            # async-specific attrs
+            self.recorder.record(
+                "step", step=self.updates_applied, loss=loss,
+            )
+            self.recorder.emit_span(
+                "learner_update", t0, time.perf_counter() - t0,
+                cat="actor", version=applied_version,
+                staleness=staleness, worker_id=worker_id,
+                queue_depth=self.queue.qsize(),
+            )
+        if (
+            self.checkpoint_cb is not None
+            and self.checkpoint_updates
+            and self.updates_applied % self.checkpoint_updates == 0
+        ):
+            self._submit_checkpoint()
+        if self.faults is not None:
+            # learner-side chaos (the failover drill): kill/respawn
+            # addressed at the learner fires between applied updates,
+            # never mid-update
+            self.faults.maybe_kill(step=self.updates_applied)
+
+    def _submit_checkpoint(self) -> None:
+        # params/opt are REPLACED per update (never mutated), so the
+        # reference pair grabbed under the lock is consistent; the
+        # watermark snapshot may run AHEAD of the applied state (a batch
+        # enqueued but not yet applied) - the safe direction: a restart
+        # can lose bounded enqueued work but can never re-apply
+        with self.lock:
+            flat, opt, version = self.params, self.opt_state, self.version
+        self.checkpoint_cb(
+            version, flat, opt, self.roster.watermarks(), self.counters()
+        )
+
+    def counters(self) -> dict:
+        return {
+            "accepted": self.accepted,
+            "duplicates": self.duplicates,
+            "stale_rejected": self.stale_rejected,
+            "queue_sheds": self.queue_sheds,
+            "poisoned": self.poisoned,
+        }
+
+    # -- wire service --------------------------------------------------------
+
+    def _register_actor(self, rank: int, worker_id: int) -> None:
+        """REGISTER -> STATE_SYNC: roster the (re)join and reply with
+        the current params, the learner's params VERSION (the step slot
+        of the PS state-sync header - what the actor stamps its batches
+        with) and the actor's push-seq watermark (where its experience
+        numbering resumes)."""
+        member = self.roster.join(worker_id, rank)
+        self._tolerated.pop(rank, None)
+        with self.lock:
+            # the span window lives entirely inside the params lock:
+            # concurrent join threads serialize here, so the member-lane
+            # state_sync spans can never partially overlap on the
+            # learner's timeline row (the trace validator forbids it)
+            t0 = time.perf_counter()
+            version = self.version
+            seq_watermark = member.push_seq
+            protocol.send_state_sync(
+                self.comm, rank, self.params, version, seq_watermark
+            )
+            if self.recorder.enabled:
+                self.recorder.emit_span(
+                    "state_sync", t0, time.perf_counter() - t0,
+                    cat="member", worker_id=worker_id, rank_slot=rank,
+                    incarnation=member.incarnation, step=version,
+                    seq=seq_watermark,
+                )
+        log.info(
+            f"state sync: actor worker-id {worker_id} (rank {rank}, "
+            f"incarnation {member.incarnation}) <- {self.num_params} "
+            f"params @ version {version}, push-seq watermark "
+            f"{seq_watermark}"
+        )
+        with self._member_cv:
+            self._member_cv.notify_all()
+
+    def _serve_actor(self, rank: int, gen: int) -> None:
+        while True:
+            if self._thread_gen.get(rank) != gen:
+                # the rank's socket slot was re-accepted: the new fd
+                # belongs to the replacement thread
+                return
+            opcode, _, seq = protocol.recv_request(
+                self.comm, rank, self.num_params
+            )
+            if opcode == protocol.OP_DONE:
+                self.roster.complete(rank)
+                with self._member_cv:
+                    self._member_cv.notify_all()
+                return
+            if opcode == protocol.OP_REGISTER:
+                self._register_actor(rank, worker_id=seq or rank)
+                continue
+            if opcode == protocol.OP_DEREGISTER:
+                self.roster.drain(rank, seq=seq)
+                with self._member_cv:
+                    self._member_cv.notify_all()
+                return
+            if opcode == protocol.OP_PARAMS_AT:
+                with self.lock:
+                    protocol.send_params_at(
+                        self.comm, rank, self.version, self.params
+                    )
+                continue
+            if opcode == protocol.OP_EXPERIENCE:
+                version, payload = protocol.recv_experience_ext(
+                    self.comm, rank
+                )
+                status, current, throttle = self.ingest(
+                    rank, seq, version, payload
+                )
+                protocol.send_experience_reply(
+                    self.comm, rank, status, current, throttle
+                )
+                continue
+            raise RuntimeError(
+                f"learner received unsupported opcode {opcode} from "
+                f"rank {rank} (the streaming wire speaks REGISTER/"
+                "DEREGISTER/DONE/PARAMS_AT/EXPERIENCE)"
+            )
+
+    def _mark_dead(self, rank: int, exc: BaseException) -> None:
+        log.warning(
+            f"actor rank {rank} dropped from the fleet "
+            f"({type(exc).__name__}: {exc}); awaiting rejoin"
+        )
+        self.roster.mark_dead(
+            rank, error=f"{type(exc).__name__}: {str(exc)[:200]}"
+        )
+
+    # -- serve ---------------------------------------------------------------
+
+    def serve(self) -> np.ndarray:
+        """Accept actors, ingest experience, apply updates; block until
+        the fleet reaches a terminal state - every rostered actor done
+        or drained, no dead actor still inside its rejoin window, and
+        the queue drained.  An empty roster waits ``join_timeout`` for
+        the first actor (a restarted learner's roster is pre-seeded
+        DEAD from the checkpoint watermarks, so it waits for the live
+        fleet to reconnect)."""
+        serve_tm0 = time.perf_counter()
+        stop_accept = threading.Event()
+        threads: list[threading.Thread] = []
+
+        def guarded(rank, gen):
+            try:
+                self._serve_actor(rank, gen)
+            except BaseException as exc:  # noqa: BLE001 - fleet-tolerated
+                with self._gen_lock:
+                    if self._thread_gen.get(rank) != gen:
+                        log.info(
+                            f"stale service thread for rank {rank} "
+                            f"exited ({type(exc).__name__}); rank re-owned"
+                        )
+                    else:
+                        self._tolerated[rank] = exc
+                        self._mark_dead(rank, exc)
+            finally:
+                with self._member_cv:
+                    self._member_cv.notify_all()
+
+        def spawn(rank):
+            with self._gen_lock:
+                gen = self._thread_gen.get(rank, 0) + 1
+                self._thread_gen[rank] = gen
+            t = threading.Thread(
+                target=guarded, args=(rank, gen), daemon=True
+            )
+            t.start()
+            threads.append(t)
+
+        # BEFORE the acceptor: the reserve reallocates the peer table
+        self.comm.reserve(self.max_world)
+
+        def accept_loop():
+            while not stop_accept.is_set():
+                rank = self.comm.accept_peer(timeout_s=0.25)
+                if rank is not None:
+                    log.info(
+                        f"actor accept: rank {rank} connected; awaiting "
+                        "REGISTER"
+                    )
+                    spawn(rank)
+
+        acceptor = threading.Thread(target=accept_loop, daemon=True)
+        acceptor.start()
+
+        try:
+            while True:
+                try:
+                    item = self.queue.get(timeout=0.2)
+                except queue_mod.Empty:
+                    if self._fleet_terminal(serve_tm0):
+                        break
+                    continue
+                self._apply(item)
+        finally:
+            stop_accept.set()
+            acceptor.join(timeout=5.0)
+            for t in list(threads):
+                t.join(timeout=5.0)
+
+        if self.updates_applied == 0 and self._tolerated:
+            rank, exc = next(iter(self._tolerated.items()))
+            raise RuntimeError(
+                f"streaming learner applied no updates and actor "
+                f"rank(s) {sorted(self._tolerated)} died (first: rank "
+                f"{rank})"
+            ) from exc
+        if self.checkpoint_cb is not None:
+            # the authoritative final state, written synchronously
+            self._submit_checkpoint()
+        self._summarize(serve_tm0)
+        return self.params
+
+    def _fleet_terminal(self, serve_tm0: float) -> bool:
+        members = self.roster.members()
+        now = time.perf_counter()
+        if not members:
+            # nobody ever joined: give the fleet one join window
+            return now - serve_tm0 > self.join_timeout
+        joined = any(m.state == membership.JOINED for m in members)
+        awaiting = any(
+            m.state == membership.DEAD and m.died_tm is not None
+            and now - m.died_tm < self.join_timeout
+            for m in members
+        )
+        return not joined and not awaiting and self.queue.empty()
+
+    def _summarize(self, serve_tm0: float) -> None:
+        duration = time.perf_counter() - serve_tm0
+        self.duration_s = duration
+        counts = self.roster.counts()
+        samples = sorted(self._staleness_samples)
+
+        def pct(q):
+            if not samples:
+                return None
+            return int(samples[min(len(samples) - 1,
+                                   int(q * len(samples)))])
+
+        log.info(
+            f"streaming learner done: {self.updates_applied} updates "
+            f"(version {self.version}), {self.accepted} batches "
+            f"accepted, {self.duplicates} duplicate(s), "
+            f"{self.stale_rejected} stale-rejected, {self.queue_sheds} "
+            f"queue shed(s), roster {counts}"
+        )
+        if not self.recorder.enabled:
+            return
+        self.recorder.record(
+            "learner_summary", updates=self.updates_applied,
+            final_version=self.version, rejoins=self.roster.rejoins,
+            **self.counters(),
+        )
+        # the run_summary carries the streaming verdict so
+        # `pdrnn-metrics summarize`/`health` read experience rates and
+        # rejection counters off the learner's sidecar like any other
+        # run outcome (None-vs-0 on non-streaming runs is the summary's
+        # gate on these keys being PRESENT at all)
+        self.recorder.record(
+            "run_summary",
+            duration_s=duration,
+            steps=self.updates_applied,
+            roster=counts, rejoins=self.roster.rejoins,
+            experience_batches=self.accepted,
+            experience_per_s=(
+                self.accepted / duration if duration > 0 else 0.0
+            ),
+            updates_per_s=(
+                self.updates_applied / duration if duration > 0 else 0.0
+            ),
+            stale_rejected=self.stale_rejected,
+            queue_sheds=self.queue_sheds,
+            duplicates=self.duplicates,
+            poisoned=self.poisoned,
+            staleness_p50=pct(0.50),
+            staleness_p95=pct(0.95),
+            final_version=self.version,
+        )
+        self.recorder.flush()
+
+
+def run_learner(args):
+    """The learner process (rank 0 of the streaming world).
+
+    Listener-only transport: the learner never performs a rendezvous -
+    actors star-join whenever they come up, which is exactly what makes
+    RESTART cheap (a ``--resume auto`` reincarnation re-listens on the
+    same port and the live fleet's transport retries find it)."""
+    import jax
+    import optax
+    from jax.flatten_util import ravel_pytree
+
+    from pytorch_distributed_rnn_tpu.obs import MetricsRecorder
+    from pytorch_distributed_rnn_tpu.param_server.runner import (
+        AsyncCheckpointWriter,
+        _build_model_and_flat_params,
+        _load_datasets,
+    )
+    from pytorch_distributed_rnn_tpu.resilience import FaultSchedule
+    from pytorch_distributed_rnn_tpu.runtime import Communicator
+    from pytorch_distributed_rnn_tpu.training import families
+
+    logging.basicConfig(level=args.log)
+    families.require_family(args, ("rnn", "char"), "streaming")
+    training_set, _, _ = _load_datasets(args)
+    _, flat, unravel = _build_model_and_flat_params(
+        args, training_set, args.seed
+    )
+    optimizer = optax.adam(args.learning_rate)
+    opt_state = optimizer.init(unravel(flat))
+
+    # failover bootstrap: restore params + optimizer + version +
+    # watermarks from the newest VALID checkpoint (corrupt files are
+    # skipped by the loader) - the whole exactly-once state, because
+    # it was written as one atomic file
+    version = 0
+    watermarks: dict | None = None
+    ckpt_dir = getattr(args, "checkpoint_directory", None)
+    if getattr(args, "resume", None) is not None and ckpt_dir:
+        from pytorch_distributed_rnn_tpu.training.checkpoint import (
+            find_latest_checkpoint,
+            load_checkpoint,
+        )
+
+        latest = find_latest_checkpoint(ckpt_dir)
+        if latest is not None:
+            params, opt_state, meta = load_checkpoint(
+                latest, unravel(flat), opt_state
+            )
+            flat = np.asarray(ravel_pytree(params)[0], np.float32)
+            extra = meta.get("extra") or {}
+            version = int(extra.get("version", meta["epoch"]))
+            watermarks = extra.get("watermarks")
+            log.info(
+                f"learner bootstrap: restored {latest} @ version "
+                f"{version}, {len(watermarks or {})} actor watermark(s)"
+            )
+
+    @jax.jit
+    def _update(flat_params, opt_state, flat_grads):
+        params = unravel(flat_params)
+        grads = unravel(flat_grads)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        new_flat, _ = ravel_pytree(new_params)
+        return new_flat, opt_state
+
+    recorder = MetricsRecorder.resolve(
+        args, rank=0, meta={"role": "learner"}
+    )
+    plane = None
+    if recorder.enabled:
+        from pytorch_distributed_rnn_tpu.obs.live import LivePlane
+        from pytorch_distributed_rnn_tpu.obs.watchdog import (
+            install_stack_dump_handler,
+        )
+
+        install_stack_dump_handler(recorder.path)
+        plane = LivePlane.resolve(args, recorder, rank=0, role="learner")
+
+    faults = FaultSchedule.resolve(args, rank=0)
+    if faults is not None and getattr(args, "stream_rejoin", False):
+        # a reincarnated learner must not replay the deterministic
+        # lifetime fault that killed its predecessor
+        faults = faults.for_rejoin()
+
+    ckpt_writer = None
+    save_version = [version]
+
+    def _save_learner_checkpoint(version_now, flat_now, opt_now,
+                                 watermarks_now, counters_now):
+        from pytorch_distributed_rnn_tpu.training.checkpoint import (
+            save_checkpoint,
+        )
+
+        path = save_checkpoint(
+            ckpt_dir, int(version_now) - 1, unravel(flat_now), opt_now,
+            loss=0.0,
+            extra={
+                "version": int(version_now),
+                "watermarks": {
+                    str(k): int(v) for k, v in watermarks_now.items()
+                },
+                "counters": counters_now,
+            },
+        )
+        save_version[0] = int(version_now)
+        log.info(f"learner checkpoint: {path} @ version {version_now}")
+
+    checkpoint_updates = int(
+        getattr(args, "checkpoint_updates", 0) or 0
+    )
+    if ckpt_dir and checkpoint_updates:
+        ckpt_writer = AsyncCheckpointWriter(_save_learner_checkpoint)
+
+    comm = Communicator.listener(
+        int(args.master_port), 1 + int(args.actors) + 8
+    )
+    try:
+        learner = ExperienceLearner(
+            comm, flat, opt_state, _update,
+            max_staleness=int(args.max_staleness),
+            queue_depth=int(args.queue_depth),
+            throttle_hint_s=float(
+                getattr(args, "throttle_hint_s", 0.05)
+            ),
+            join_timeout=float(getattr(args, "join_timeout", 30.0)),
+            max_world=1 + int(args.actors) + 8,
+            version=version,
+            watermarks=watermarks,
+            checkpoint_cb=(
+                ckpt_writer.submit if ckpt_writer is not None else None
+            ),
+            checkpoint_updates=checkpoint_updates,
+            recorder=recorder,
+            faults=faults,
+        )
+        final = learner.serve()
+        if getattr(args, "results", None):
+            # the CI assertion gate reads these: the final incarnation
+            # (failover drill included) owns the file
+            import json
+
+            duration = learner.duration_s or 1e-9
+            with open(args.results, "w") as f:
+                json.dump(
+                    {
+                        "updates": learner.updates_applied,
+                        "final_version": learner.version,
+                        "duration_s": learner.duration_s,
+                        "updates_per_s": (
+                            learner.updates_applied / duration
+                        ),
+                        "rejoins": learner.roster.rejoins,
+                        "roster": learner.roster.counts(),
+                        "watermarks": {
+                            str(k): int(v) for k, v in
+                            learner.roster.watermarks().items()
+                        },
+                        **learner.counters(),
+                    },
+                    f,
+                )
+        if ckpt_writer is not None:
+            # drain the coalescing writer, then persist the
+            # authoritative final state synchronously (no lock held)
+            ckpt_writer.close()
+            _save_learner_checkpoint(
+                learner.version, learner.params, learner.opt_state,
+                learner.roster.watermarks(), learner.counters(),
+            )
+    finally:
+        if ckpt_writer is not None:
+            ckpt_writer.close()
+        comm.close()
+        recorder.close()
+        if plane is not None:
+            plane.close()
+    return final

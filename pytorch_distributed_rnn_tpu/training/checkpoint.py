@@ -52,9 +52,18 @@ def _to_host(tree):
 
 
 def save_checkpoint(
-    checkpoint_dir, epoch: int, params, opt_state, loss: float, best: bool = False
+    checkpoint_dir, epoch: int, params, opt_state, loss: float,
+    best: bool = False, extra: dict | None = None,
 ) -> Path:
-    """Write a checkpoint atomically; returns the path."""
+    """Write a checkpoint atomically; returns the path.
+
+    ``extra`` is an optional JSON-serializable dict stored in the header
+    line - state that must be crash-consistent WITH the params/optimizer
+    sections (the streaming learner's params version and per-actor
+    push-seq watermarks: persisting them in a second file would open a
+    window where a crash leaves new params with stale watermarks, and a
+    restarted learner would re-apply experience it already trained on).
+    """
     checkpoint_dir = Path(checkpoint_dir)
     checkpoint_dir.mkdir(parents=True, exist_ok=True)
     name = "best-model.ckpt" if best else f"checkpoint-epoch-{epoch + 1}.ckpt"
@@ -62,18 +71,19 @@ def save_checkpoint(
 
     model_bytes = serialization.to_bytes(_to_host(params))
     opt_bytes = serialization.to_bytes(_to_host(opt_state))
-    header = json.dumps(
-        {
-            "epoch": epoch + 1,
-            "loss": float(loss),
-            "model_len": len(model_bytes),
-            "opt_len": len(opt_bytes),
-            "crcs": {
-                "model": zlib.crc32(model_bytes),
-                "opt": zlib.crc32(opt_bytes),
-            },
-        }
-    ).encode()
+    header_fields = {
+        "epoch": epoch + 1,
+        "loss": float(loss),
+        "model_len": len(model_bytes),
+        "opt_len": len(opt_bytes),
+        "crcs": {
+            "model": zlib.crc32(model_bytes),
+            "opt": zlib.crc32(opt_bytes),
+        },
+    }
+    if extra is not None:
+        header_fields["extra"] = extra
+    header = json.dumps(header_fields).encode()
     # temp-write + fsync + atomic rename: a crash at ANY point leaves
     # either the previous complete file or no file - never a truncated
     # one under the checkpoint name.  pid-suffixed temp so concurrent
@@ -178,7 +188,10 @@ def load_checkpoint(path, params_template, opt_state_template):
             f"{path}: sections verified but failed to deserialize into "
             f"the trainer's state templates ({exc})"
         ) from exc
-    return params, opt_state, {"epoch": header["epoch"], "loss": header["loss"]}
+    meta = {"epoch": header["epoch"], "loss": header["loss"]}
+    if "extra" in header:
+        meta["extra"] = header["extra"]
+    return params, opt_state, meta
 
 
 def load_model_params(path, params_template):

@@ -83,6 +83,37 @@ class TestFaultSchedule:
         s = FaultSchedule.parse("step:1:stall")
         assert s.events[0].arg == pytest.approx(0.25)
 
+    def test_slow_default_frac(self):
+        s = FaultSchedule.parse("step:1:slow")
+        assert s.events[0].arg == pytest.approx(0.5)
+
+    def test_slow_latches_once_and_degrades_every_item(self):
+        """`slow` is a SUSTAINED straggler, not a one-shot stall: the
+        onset fires the counter once, then every later producer item is
+        delayed by frac x its inter-item gap."""
+        import time
+
+        s = FaultSchedule.parse("step:2:slow:0.5")
+        s.on_producer_item(1)
+        assert not s.slow_active and "slow" not in s.fired
+        s.on_producer_item(2)  # onset: latches, ~zero gap so far
+        assert s.slow_active
+        assert s.fired.get("slow") == 1
+        time.sleep(0.05)  # 50ms of simulated work between items
+        t0 = time.perf_counter()
+        s.on_producer_item(3)
+        waited = time.perf_counter() - t0
+        assert waited >= 0.02  # ~0.5 x the 50ms gap
+        s.on_producer_item(4)
+        assert s.fired.get("slow") == 1  # the onset fired ONCE
+
+    def test_slow_bigger_fraction_wins_smaller_ignored(self):
+        s = FaultSchedule.parse("step:1:slow:0.5,step:2:slow:0.25")
+        s.on_producer_item(1)
+        s.on_producer_item(2)  # weaker latch must not relax the frac
+        assert s._slow_frac == pytest.approx(0.5)
+        assert s.fired.get("slow") == 1
+
     @pytest.mark.parametrize("bad", [
         "step:1:frobnicate",          # unknown action
         "wibble:1:nan",               # unknown trigger

@@ -1,0 +1,690 @@
+"""Streaming actor/learner training: ingest verdicts, exactly-once
+watermarks, bounded staleness, backpressure, failover state, obs wiring,
+and the supervised chaos drill.
+
+The spec of ISSUE 12: N actors push version-stamped experience over the
+PS wire, one learner applies jitted updates off their cadence.  These
+tests pin the five robustness guarantees - bounded staleness (rejected
+batches are counted, never silently dropped, at INGEST and again at
+APPLY), exactly-once ingest (per-actor seq watermarks dedupe retries,
+respawn replays and post-failover re-sends), elastic fleet entry
+(REGISTER/STATE_SYNC mid-run under stable worker-ids), backpressure
+(full queue NACKs with a throttle hint), and learner failover (one
+atomic checkpoint of params + version + watermarks).
+"""
+
+import json
+import random
+import time
+from argparse import Namespace
+from collections import deque
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_rnn_tpu.param_server import protocol
+from pytorch_distributed_rnn_tpu.resilience.membership import Roster
+from pytorch_distributed_rnn_tpu.streaming.learner import ExperienceLearner
+
+PORT = 30010
+
+
+def _sgd(flat, opt, grads):
+    """The minimal update_fn stand-in: plain SGD, opt state untouched."""
+    return flat - 0.1 * grads, opt
+
+
+def _learner(n=4, **kw):
+    kw.setdefault("max_staleness", 4)
+    return ExperienceLearner(
+        None, np.zeros(n, np.float32), None, _sgd, **kw
+    )
+
+
+def _payload(n=4, loss=1.0, grad=1.0):
+    return np.concatenate(
+        [[loss], np.full(n, grad)]
+    ).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# Wire framing (protocol.py EXPERIENCE / PARAMS_AT extensions)
+# ---------------------------------------------------------------------------
+
+
+class _Loopback:
+    """Both wire ends in one object: sends land in a deque the receive
+    side pops - framing tests need byte discipline, not sockets."""
+
+    def __init__(self):
+        self.msgs = deque()
+
+    def send(self, dst, arr):
+        self.msgs.append(np.asarray(arr, np.float32).reshape(-1))
+
+    def recv(self, src, shape, dtype=np.float32):
+        return self.msgs.popleft().reshape(shape)
+
+
+class TestProtocol:
+    def test_experience_roundtrip(self):
+        comm = _Loopback()
+        payload = _payload(6, loss=0.5, grad=2.0)
+        protocol.send_experience(comm, seq=9, version=3, payload=payload)
+        opcode, grads, seq = protocol.recv_request(comm, 1, 6)
+        assert opcode == protocol.OP_EXPERIENCE and seq == 9
+        assert grads is None  # payload rides the extension, not PUSH
+        version, got = protocol.recv_experience_ext(comm, 1)
+        assert version == 3
+        np.testing.assert_array_equal(got, payload)
+
+    def test_experience_reply_roundtrip(self):
+        comm = _Loopback()
+        protocol.send_experience_reply(
+            comm, 1, protocol.EXP_BACKOFF, 17, 0.25
+        )
+        status, version, hint = protocol.recv_experience_reply(comm)
+        assert status == protocol.EXP_BACKOFF
+        assert version == 17
+        assert hint == pytest.approx(0.25)
+
+    def test_params_at_roundtrip_is_version_stamped(self):
+        comm = _Loopback()
+        flat = np.arange(5, dtype=np.float32)
+        protocol.send_params_at(comm, 1, 11, flat)
+        got, version = protocol.recv_params_at(comm, 5)
+        assert version == 11
+        np.testing.assert_array_equal(got, flat)
+
+
+# ---------------------------------------------------------------------------
+# Ingest verdicts (the EXPERIENCE reply contract, comm-free)
+# ---------------------------------------------------------------------------
+
+
+class TestIngest:
+    def test_unrostered_push_is_loud(self):
+        lrn = _learner()
+        with pytest.raises(RuntimeError, match="REGISTER"):
+            lrn.ingest(1, 1, 0, _payload())
+
+    def test_dead_member_push_requires_rejoin(self):
+        lrn = _learner()
+        lrn.roster.join(1, 1)
+        lrn.roster.mark_dead(1, error="chaos")
+        with pytest.raises(RuntimeError, match="join protocol"):
+            lrn.ingest(1, 1, 0, _payload())
+
+    def test_ok_advances_watermark_and_enqueues(self):
+        lrn = _learner()
+        lrn.roster.join(1, 1)
+        status, version, hint = lrn.ingest(1, 1, 0, _payload())
+        assert status == protocol.EXP_OK and version == 0 and hint == 0.0
+        assert lrn.roster.member_for_rank(1).push_seq == 1
+        assert lrn.accepted == 1 and lrn.queue.qsize() == 1
+
+    def test_duplicate_checked_before_stale(self):
+        """A retried push whose original applied must be ACKed as a
+        DUPLICATE even if it would now fail the staleness gate - the
+        actor treats DUPLICATE as success and moves on; STALE would
+        make it recompute a batch the learner already trained on."""
+        lrn = _learner(max_staleness=2)
+        lrn.roster.join(1, 1)
+        assert lrn.ingest(1, 1, 0, _payload())[0] == protocol.EXP_OK
+        lrn.version = 50  # the world moved on while the reply was lost
+        status, version, _ = lrn.ingest(1, 1, 0, _payload())
+        assert status == protocol.EXP_DUPLICATE and version == 50
+        assert lrn.duplicates == 1
+        assert lrn.queue.qsize() == 1  # never enqueued twice
+
+    def test_stale_is_counted_and_resendable_after_refresh(self):
+        lrn = _learner(max_staleness=4)
+        lrn.roster.join(1, 1)
+        lrn.version = 10
+        status, version, _ = lrn.ingest(1, 1, 5, _payload())
+        assert status == protocol.EXP_STALE and version == 10
+        assert lrn.stale_rejected == 1
+        # the watermark did NOT advance: the same seq re-sent under a
+        # fresh version (post params_refresh) is accepted, not deduped
+        assert lrn.roster.member_for_rank(1).push_seq == 0
+        assert lrn.ingest(1, 1, 10, _payload())[0] == protocol.EXP_OK
+
+    def test_staleness_boundary_is_inclusive(self):
+        lrn = _learner(max_staleness=4)
+        lrn.roster.join(1, 1)
+        lrn.version = 4
+        assert lrn.ingest(1, 1, 0, _payload())[0] == protocol.EXP_OK
+        lrn.version = 5
+        assert lrn.ingest(1, 2, 0, _payload())[0] == protocol.EXP_STALE
+
+    def test_backpressure_nacks_with_hint_and_no_watermark(self):
+        lrn = _learner(queue_depth=1, throttle_hint_s=0.2)
+        lrn.roster.join(1, 1)
+        assert lrn.ingest(1, 1, 0, _payload())[0] == protocol.EXP_OK
+        status, _, hint = lrn.ingest(1, 2, 0, _payload())
+        assert status == protocol.EXP_BACKOFF
+        assert hint == pytest.approx(0.2)
+        assert lrn.queue_sheds == 1
+        assert lrn.roster.member_for_rank(1).push_seq == 1
+        # the queue drained -> the SAME seq is accepted (not a dupe)
+        lrn._apply(lrn.queue.get_nowait())
+        assert lrn.ingest(1, 2, 0, _payload())[0] == protocol.EXP_OK
+
+    def test_apply_advances_params_and_version(self):
+        lrn = _learner(n=4)
+        lrn.roster.join(1, 1)
+        lrn.ingest(1, 1, 0, _payload(4, loss=0.7, grad=2.0))
+        lrn._apply(lrn.queue.get_nowait())
+        assert lrn.updates_applied == 1 and lrn.version == 1
+        np.testing.assert_allclose(lrn.params, -0.2 * np.ones(4),
+                                   rtol=1e-6)
+
+    def test_staleness_rechecked_at_apply_time(self):
+        """The bound holds on what is APPLIED: a batch that aged past
+        the bound while queued is refused at apply, counted, and its
+        seq stays covered by the watermark (no re-send loop)."""
+        lrn = _learner(max_staleness=2)
+        lrn.roster.join(1, 1)
+        lrn.ingest(1, 1, 0, _payload())
+        lrn.version = 10  # other actors' updates applied meanwhile
+        lrn._apply(lrn.queue.get_nowait())
+        assert lrn.updates_applied == 0
+        assert lrn.stale_rejected == 1
+        assert lrn.roster.member_for_rank(1).push_seq == 1
+
+    @pytest.mark.parametrize("payload", [
+        np.full(5, np.nan, np.float32),          # non-finite
+        np.ones(3, np.float32),                  # wrong size
+    ])
+    def test_poisoned_batch_dropped_not_fatal(self, payload):
+        lrn = _learner(n=4)
+        lrn.roster.join(1, 1)
+        lrn.ingest(1, 1, 0, payload)
+        lrn._apply(lrn.queue.get_nowait())
+        assert lrn.poisoned == 1
+        assert lrn.updates_applied == 0 and lrn.version == 0
+
+
+# ---------------------------------------------------------------------------
+# The watermark-dedupe PROPERTY: one randomized interleaving driver,
+# two sinks - the PS gradient-push path and the streaming experience
+# path share the exactly-once mechanism and must share its proof
+# ---------------------------------------------------------------------------
+
+
+def _watermark_dedupe_property(rng, make_sink, workers=(1, 2, 3),
+                               stream_len=12):
+    """Drive randomized retry / respawn-replay / reorder interleavings
+    of per-worker seq streams into a sink and assert exactly-once.
+
+    ``make_sink() -> (push, applied, respawn)``:
+
+    - ``push(worker_id, seq) -> bool``: attempt one delivery; True iff
+      the sink APPLIED it (first delivery), False when deduped;
+    - ``applied() -> {worker_id: [seq, ...]}``: what actually landed;
+    - ``respawn(worker_id)``: the worker dies and rejoins (stable id).
+    """
+    push, applied, respawn = make_sink()
+    next_seq = dict.fromkeys(workers, 1)
+    sent = {w: [] for w in workers}
+    while any(next_seq[w] <= stream_len for w in workers):
+        w = rng.choice(workers)
+        r = rng.random()
+        if r < 0.15 and sent[w]:
+            # crash + respawn under the same worker-id: the replacement
+            # replays a window of in-flight pushes its dead predecessor
+            # already delivered - every one must dedupe
+            respawn(w)
+            for seq in sent[w][-rng.randint(1, 3):]:
+                assert not push(w, seq)
+        elif r < 0.35 and sent[w]:
+            # lost-reply retry / reordered duplicate of any old seq
+            assert not push(w, rng.choice(sent[w]))
+        elif next_seq[w] <= stream_len:
+            seq = next_seq[w]
+            assert push(w, seq)
+            sent[w].append(seq)
+            next_seq[w] = seq + 1
+            if rng.random() < 0.3:
+                assert not push(w, seq)  # immediate duplicate retry
+    for w in workers:
+        assert applied()[w] == list(range(1, stream_len + 1))
+
+
+class TestWatermarkExactlyOnceProperty:
+    def test_ps_gradient_push_path(self):
+        """Call site 1: the PS master's dedupe - Roster.note_push is the
+        gate ``master._serve_worker`` applies gradients through."""
+
+        def make_sink():
+            roster = Roster()
+            landed = {}
+
+            def push(w, seq):
+                if roster.member_for_rank(w) is None:
+                    roster.join(w, w)
+                ok = roster.note_push(w, seq)
+                if ok:
+                    landed.setdefault(w, []).append(seq)
+                return ok
+
+            def respawn(w):
+                roster.mark_dead(w, error="chaos")
+                roster.join(w, w)
+
+            return push, lambda: landed, respawn
+
+        _watermark_dedupe_property(random.Random(0xA5), make_sink)
+
+    def test_streaming_experience_ingest_path(self):
+        """Call site 2: the streaming learner's full ingest verdict
+        (staleness + backpressure gates live, watermark behind the
+        enqueue) - what actually lands in the apply queue is the
+        exactly-once surface."""
+
+        def make_sink():
+            lrn = _learner(queue_depth=4096)
+            landed = {}
+
+            def push(w, seq):
+                if lrn.roster.member_for_rank(w) is None:
+                    lrn.roster.join(w, w)
+                status, _, _ = lrn.ingest(
+                    w, seq, lrn.version, _payload()
+                )
+                if status != protocol.EXP_OK:
+                    assert status == protocol.EXP_DUPLICATE
+                    return False
+                worker_id, got_seq, _, _ = lrn.queue.get_nowait()
+                assert (worker_id, got_seq) == (w, seq)
+                landed.setdefault(w, []).append(got_seq)
+                return True
+
+            def respawn(w):
+                lrn.roster.mark_dead(w, error="chaos")
+                lrn.roster.join(w, w)
+
+            return push, lambda: landed, respawn
+
+        _watermark_dedupe_property(random.Random(0x5A), make_sink)
+
+
+# ---------------------------------------------------------------------------
+# Failover state: the atomic params+version+watermarks checkpoint
+# ---------------------------------------------------------------------------
+
+
+class TestFailoverState:
+    def test_checkpoint_cb_snapshots_version_and_watermarks(self):
+        snaps = []
+        lrn = _learner(
+            checkpoint_cb=lambda *s: snaps.append(s),
+            checkpoint_updates=2,
+        )
+        lrn.roster.join(1, 1)
+        for seq in (1, 2, 3):
+            lrn.ingest(1, seq, lrn.version, _payload())
+        for _ in range(3):
+            lrn._apply(lrn.queue.get_nowait())
+        assert len(snaps) == 1  # cadence 2: after the 2nd applied update
+        version, flat, _opt, watermarks, counters = snaps[0]
+        assert version == 2
+        # the watermark may run AHEAD of the applied state (enqueued
+        # but unapplied work) - never behind it
+        assert watermarks == {1: 3}
+        assert counters["accepted"] == 3
+
+    def test_restored_watermarks_dedupe_after_failover(self):
+        """The reincarnation proof, comm-free: a learner restored from
+        (version, watermarks) refuses the re-sent pushes its dead
+        predecessor applied, and resumes above them."""
+        lrn = _learner(version=7, watermarks={1: 5, 2: 3})
+        lrn.roster.join(1, 1)  # live actors re-REGISTER after restart
+        assert lrn.ingest(1, 5, 7, _payload())[0] == protocol.EXP_DUPLICATE
+        assert lrn.ingest(1, 4, 7, _payload())[0] == protocol.EXP_DUPLICATE
+        assert lrn.ingest(1, 6, 7, _payload())[0] == protocol.EXP_OK
+        member = lrn.roster.join(2, 2)
+        assert member.push_seq == 3
+
+    def test_checkpoint_extra_survives_the_file_round_trip(self, tmp_path):
+        """version + watermarks ride the checkpoint HEADER atomically
+        with the params sections (training/checkpoint.py ``extra``)."""
+        from pytorch_distributed_rnn_tpu.training.checkpoint import (
+            load_checkpoint,
+            save_checkpoint,
+        )
+
+        flat = np.arange(6, dtype=np.float32)
+        opt = {"m": np.zeros(6, np.float32)}
+        extra = {"version": 41, "watermarks": {"1": 25, "2": 24}}
+        path = save_checkpoint(tmp_path, 40, flat, opt, 0.5, extra=extra)
+        got_flat, got_opt, meta = load_checkpoint(
+            path, np.zeros_like(flat), {"m": np.zeros(6, np.float32)}
+        )
+        np.testing.assert_array_equal(got_flat, flat)
+        assert meta["extra"] == extra
+
+
+# ---------------------------------------------------------------------------
+# Supervision: the actor flavor shares the respawn core + alert hook
+# ---------------------------------------------------------------------------
+
+
+class TestActorSupervision:
+    def test_actor_supervisor_shares_the_respawn_core(self):
+        from pytorch_distributed_rnn_tpu.launcher.supervisor import (
+            ActorSupervisor,
+            ElasticSupervisor,
+            RespawnSupervisor,
+            StageSupervisor,
+        )
+
+        for cls in (ActorSupervisor, ElasticSupervisor, StageSupervisor):
+            assert issubclass(cls, RespawnSupervisor)
+        # flavors customize POLICY (floors, docs), never the
+        # respawn/adopt/reap mechanics - one implementation to trust
+        for method in ("poll", "adopt", "shutdown", "launch", "__init__"):
+            assert method not in vars(ActorSupervisor)
+            assert method not in vars(ElasticSupervisor)
+
+    def test_adopt_emits_worker_join_through_the_shared_hook(self):
+        from pytorch_distributed_rnn_tpu.launcher.supervisor import (
+            ActorSupervisor,
+            supervision_alert_hook,
+        )
+
+        class _Proc:
+            exitcode = None
+            pid = 123
+
+        events = []
+        rec = type("R", (), {
+            "enabled": True,
+            "record": lambda self, kind, **f: events.append(
+                {"kind": kind, **f}
+            ),
+            "flush": lambda self: None,
+        })()
+        sup = ActorSupervisor(
+            lambda rank, worker_id, rejoin: _Proc(),
+            min_workers=1, max_respawns=0,
+            on_event=supervision_alert_hook(recorder=rec),
+        )
+        sup.adopt(4)
+        assert 4 in sup.slots
+        assert events == [{"kind": "worker_join", "worker_id": 4,
+                           "rank": 4}]
+
+    def test_hook_returns_none_with_nothing_to_wire(self):
+        from pytorch_distributed_rnn_tpu.launcher.supervisor import (
+            supervision_alert_hook,
+        )
+
+        assert supervision_alert_hook() is None
+
+
+# ---------------------------------------------------------------------------
+# Observability wiring: summarize fields, actor health, actor lane
+# ---------------------------------------------------------------------------
+
+
+def _sidecar(path, rank, events, role=None):
+    now = time.time()
+    head = {"kind": "meta", "schema": 2, "rank": rank, "t": now - 300,
+            "tm": 0.0, "sample_every": 1}
+    if role is not None:
+        head["role"] = role
+    lines = [head] + [
+        {"rank": rank, "t": now - 200, "tm": 100.0, **e} for e in events
+    ]
+    path.write_text("".join(json.dumps(e) + "\n" for e in lines))
+    return now
+
+
+class TestStreamingObservability:
+    def test_summarize_passes_streaming_fields_through(self, tmp_path):
+        from pytorch_distributed_rnn_tpu.obs.summary import summarize_file
+
+        _sidecar(tmp_path / "m.jsonl", 0, [
+            {"kind": "run_summary", "duration_s": 2.0, "steps": 40,
+             "experience_batches": 44, "experience_per_s": 22.0,
+             "updates_per_s": 20.0, "stale_rejected": 3,
+             "queue_sheds": 1, "duplicates": 2, "poisoned": 0,
+             "staleness_p50": 1, "staleness_p95": 3,
+             "final_version": 40, "rejoins": 1},
+        ], role="learner")
+        summary = summarize_file(tmp_path / "m.jsonl")
+        assert summary["experience_batches"] == 44
+        assert summary["updates_per_s"] == pytest.approx(20.0)
+        assert summary["stale_rejected"] == 3
+        assert summary["queue_sheds"] == 1
+        assert summary["staleness_p95"] == 3
+        assert summary["final_version"] == 40
+
+    def test_summarize_streaming_fields_absent_on_plain_runs(
+        self, tmp_path
+    ):
+        """None-not-0: a non-streaming run's summary must not invent
+        zero rejection counters (the text summary stays noise-free)."""
+        from pytorch_distributed_rnn_tpu.obs.summary import summarize_file
+
+        _sidecar(tmp_path / "m.jsonl", 0, [
+            {"kind": "step", "step": 1, "dispatch_s": 0.001},
+            {"kind": "run_summary", "duration_s": 1.0},
+        ])
+        summary = summarize_file(tmp_path / "m.jsonl")
+        for key in ("experience_batches", "stale_rejected",
+                    "queue_sheds", "staleness_p95"):
+            assert summary.get(key) is None
+
+    def test_health_registered_not_pushing_actor_is_recovering(
+        self, tmp_path, capsys
+    ):
+        from pytorch_distributed_rnn_tpu.obs.cli import main as metrics_main
+
+        now = _sidecar(tmp_path / "m.jsonl", 0, [
+            {"kind": "run_summary", "duration_s": 1.0},
+        ], role="learner")
+        _sidecar(tmp_path / "m-r1.jsonl", 1, [
+            {"kind": "span", "name": "state_sync", "cat": "member",
+             "dur_s": 0.01, "t": now - 60},
+            {"kind": "heartbeat", "seq": 9, "t": now - 5},
+        ], role="actor")
+        rc = metrics_main([
+            "health", str(tmp_path / "m.jsonl"),
+            "--now", str(now), "--stale-after", "30",
+        ])
+        out = capsys.readouterr().out
+        assert rc == 0  # recovery work is healthy
+        assert "rank 1: recovering" in out
+
+    def test_health_actor_grace_ends_at_first_push(self, tmp_path):
+        from pytorch_distributed_rnn_tpu.obs import load_events, rank_health
+
+        now = _sidecar(tmp_path / "m.jsonl", 1, [
+            {"kind": "actor_reconnect", "worker_id": 1, "attempts": 1,
+             "t": time.time() - 60},
+            {"kind": "step", "step": 5, "loss": 1.0,
+             "t": time.time() - 50},
+            {"kind": "heartbeat", "seq": 9, "t": time.time() - 5},
+        ], role="actor")
+        report = rank_health(load_events(tmp_path / "m.jsonl"), now=now,
+                             stale_after=30)
+        assert report["status"] == "stalled"
+
+    def test_health_state_sync_grace_is_actor_only(self, tmp_path):
+        """The learner's sidecar carries state_sync spans for its
+        MEMBERS' joins - they must never launder the learner's own
+        stall as recovery."""
+        from pytorch_distributed_rnn_tpu.obs import load_events, rank_health
+
+        now = _sidecar(tmp_path / "m.jsonl", 0, [
+            {"kind": "span", "name": "state_sync", "cat": "member",
+             "dur_s": 0.01, "t": time.time() - 60},
+            {"kind": "heartbeat", "seq": 9, "t": time.time() - 5},
+        ], role="learner")
+        report = rank_health(load_events(tmp_path / "m.jsonl"), now=now,
+                             stale_after=30)
+        assert report["status"] == "stalled"
+
+    def test_timeline_renders_actor_lane(self, tmp_path):
+        from pytorch_distributed_rnn_tpu.obs import validate_chrome_trace
+        from pytorch_distributed_rnn_tpu.obs.spans import SUBSYSTEM_TIDS
+        from pytorch_distributed_rnn_tpu.obs.timeline import (
+            build_chrome_trace,
+            load_run,
+        )
+
+        _sidecar(tmp_path / "m.jsonl", 0, [
+            {"kind": "span", "name": "learner_update", "cat": "actor",
+             "dur_s": 0.002, "version": 3, "staleness": 1},
+            {"kind": "experience_reject", "reason": "stale",
+             "worker_id": 1, "seq": 4, "batch_version": 0,
+             "learner_version": 9},
+            {"kind": "params_refresh", "worker_id": 1,
+             "from_version": 0, "to_version": 9},
+        ], role="learner")
+        trace = build_chrome_trace(load_run(tmp_path / "m.jsonl"))
+        validate_chrome_trace(trace)
+        actor_events = [
+            e for e in trace["traceEvents"] if e.get("cat") == "actor"
+        ]
+        assert {e["name"] for e in actor_events} == {
+            "learner_update", "experience_reject", "params_refresh",
+        }
+        assert all(e["tid"] == SUBSYSTEM_TIDS["actor"]
+                   for e in actor_events)
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+
+def test_stream_cli_flags_parse():
+    from pytorch_distributed_rnn_tpu.streaming import build_parser
+
+    args = build_parser().parse_args([
+        "--actors", "4", "--actor-steps", "50", "--max-staleness", "2",
+        "--queue-depth", "16", "--master-port", "30099",
+        "--faults", "step:5:respawn@2", "--join-after", "1.5",
+        "--join-actors", "2", "--resume", "auto",
+    ])
+    assert args.actors == 4 and args.actor_steps == 50
+    assert args.max_staleness == 2 and args.queue_depth == 16
+    assert args.join_after == 1.5 and args.join_actors == 2
+    assert args.resume == "auto"
+
+
+def test_streaming_requires_a_pushable_family():
+    from pytorch_distributed_rnn_tpu.streaming.actor import run_actor
+
+    args = Namespace(model="moe", log="WARNING")
+    with pytest.raises(SystemExit, match="streaming"):
+        run_actor(args, 1)
+
+
+# ---------------------------------------------------------------------------
+# The acceptance drill: slow straggler + actor respawn + learner
+# failover + elastic mid-run join, one supervised spawn world
+# ---------------------------------------------------------------------------
+
+
+def _stream_args(tmp_path, port, **kw):
+    from pytorch_distributed_rnn_tpu.streaming import build_parser
+
+    argv = [
+        "--dataset-path", str(tmp_path / "har"),
+        "--output-path", str(tmp_path / "cache"),
+        "--actors", "2", "--actor-steps", "12", "--batch-size", "16",
+        "--hidden-units", "8", "--stacked-layer", "1",
+        "--master-port", str(port),
+        "--checkpoint-directory", str(tmp_path / "ckpt"),
+        "--checkpoint-updates", "5",
+        "--results", str(tmp_path / "results.json"),
+        "--metrics", str(tmp_path / "m.jsonl"),
+        "--log", "WARNING",
+    ]
+    for flag, value in kw.items():
+        argv += [f"--{flag.replace('_', '-')}", str(value)]
+    return build_parser().parse_args(argv)
+
+
+@pytest.mark.chaos
+class TestStreamingChaosDrill:
+    def test_fleet_survives_straggler_respawns_and_failover(
+        self, tmp_path
+    ):
+        """One run, every guarantee: actor 1 runs sustained-slow, actor
+        2 is killed and respawned into its worker-id, the learner is
+        killed mid-stream and fails over from its checkpoint, and a
+        third actor joins mid-run.  Every stream still completes to
+        exactly --actor-steps (the watermarks), nothing is applied
+        twice, and the staleness bound holds on what was applied."""
+        from pytorch_distributed_rnn_tpu.data.synthetic import (
+            write_synthetic_har_dataset,
+        )
+        from pytorch_distributed_rnn_tpu.streaming import runner
+
+        write_synthetic_har_dataset(
+            tmp_path / "har", num_train=120, num_test=16, seq_length=12
+        )
+        args = _stream_args(
+            tmp_path, PORT,
+            faults="step:3:slow:0.5@1,step:4:respawn@2,step:10:respawn@0",
+            join_after="1.0", join_actors="1", max_staleness="4",
+        )
+        assert runner.run(args) == 0
+
+        results = json.loads((tmp_path / "results.json").read_text())
+        # exactly-once completion: every stream (launch actors 1-2 and
+        # the mid-run joiner 3) reached its full length, not a step more
+        assert results["watermarks"] == {"1": 12, "2": 12, "3": 12}
+        assert results["roster"]["done"] == 3
+        assert results["updates"] >= 1
+        assert results["final_version"] >= results["updates"]
+        # the respawned actor and the failover re-registrations all
+        # entered as REJOINS of known worker-ids
+        assert results["rejoins"] >= 1
+        assert results["poisoned"] == 0
+
+        # the learner failed over: a checkpoint family exists and the
+        # supervisor sidecar recorded both respawns through the shared
+        # alert hook
+        assert list((tmp_path / "ckpt").glob("checkpoint-epoch-*.ckpt"))
+        sup_rank = 1 + 2 + 1  # actors + joiner slots, then the runner
+        sup = [
+            json.loads(line) for line in
+            (tmp_path / f"m-r{sup_rank}.jsonl").read_text().splitlines()
+        ]
+        respawned = {e["rank"] for e in sup
+                     if e["kind"] == "worker_respawn"}
+        assert respawned == {0, 2}
+        assert any(e["kind"] == "worker_join" and e["rank"] == 3
+                   for e in sup)
+
+        # bounded staleness held on what was APPLIED (run_summary off
+        # the learner's final incarnation)
+        from pytorch_distributed_rnn_tpu.obs.summary import summarize_file
+
+        summary = summarize_file(tmp_path / "m.jsonl")
+        if summary["staleness_p95"] is not None:
+            assert summary["staleness_p95"] <= 4
+        assert summary["experience_batches"] >= 1
+
+        # the whole family exports validator-clean with the actor lane
+        from pytorch_distributed_rnn_tpu.obs import validate_chrome_trace
+        from pytorch_distributed_rnn_tpu.obs.spans import SUBSYSTEM_TIDS
+        from pytorch_distributed_rnn_tpu.obs.timeline import (
+            build_chrome_trace,
+            load_run,
+        )
+
+        trace = build_chrome_trace(load_run(tmp_path / "m.jsonl"))
+        validate_chrome_trace(trace)
+        assert any(
+            e.get("cat") == "actor"
+            and e.get("tid") == SUBSYSTEM_TIDS["actor"]
+            for e in trace["traceEvents"]
+        )
