@@ -152,6 +152,48 @@ def motion_throughput(impl: str, cell: str = "lstm",
     return epochs * NUM_SEQUENCES / duration
 
 
+def dp_sharded_ab_row(epochs: int = 2):
+    """--sharded-update on/off A/B for the motion-LSTM DP trainer
+    (2004.13336): same dp mesh, same data and seed, steady-state seq/s
+    per flavor.  On one real chip both flavors share the HBM and the
+    number is mostly the smaller update program; the wire-traffic half
+    of the claim is gated separately (lint/collective_check.py)."""
+    import jax
+
+    n = jax.device_count()
+    if n < 2:
+        return (f"skipped: {n} device(s) - a dp mesh needs >= 2 "
+                "(set PDRNN_NUM_CPU_DEVICES off-chip)")
+    from pytorch_distributed_rnn_tpu.data import MotionDataset
+    from pytorch_distributed_rnn_tpu.data.synthetic import generate_har_arrays
+    from pytorch_distributed_rnn_tpu.models import MotionModel
+    from pytorch_distributed_rnn_tpu.parallel import make_mesh
+    from pytorch_distributed_rnn_tpu.training import DDPTrainer
+
+    world = 4 if n >= 4 else 2
+    X, y = generate_har_arrays(NUM_SEQUENCES, SEQ_LEN, NUM_FEATURES, seed=0)
+    train_set = MotionDataset(X, y)
+    row: dict = {"world": world}
+    for key, sharded in (("sharded_seq_per_sec", True),
+                         ("replicated_seq_per_sec", False)):
+        trainer = DDPTrainer(
+            MotionModel(input_dim=NUM_FEATURES, hidden_dim=32, layer_dim=2,
+                        output_dim=6),
+            train_set, batch_size=BATCH_SIZE, learning_rate=0.0025,
+            seed=SEED, mesh=make_mesh({"dp": world}),
+            sharded_update=sharded,
+        )
+        trainer.train(epochs=1)  # warm-up: compile
+        start = time.perf_counter()
+        for _ in range(epochs):
+            trainer.train(epochs=1)
+        row[key] = round(epochs * NUM_SEQUENCES
+                         / (time.perf_counter() - start), 1)
+    row["sharded_vs_replicated"] = round(
+        row["sharded_seq_per_sec"] / row["replicated_seq_per_sec"], 3)
+    return row
+
+
 def lstm_lm_flops_per_token(model) -> float:
     """Training FLOPs per token for a stacked-LSTM LM: 2*MACs for the
     input + recurrent matmuls per layer, plus the vocab head; backward
@@ -700,6 +742,10 @@ def main():
             return curve
 
         attempt("motion_batch_curve_seq_per_sec", _batch_curve)
+
+        # sharded-vs-replicated weight update on the dp mesh
+        # (2004.13336); off-chip the row self-skips below 2 devices
+        attempt("motion_dp_sharded_update_ab", dp_sharded_ab_row)
 
         # the MoE family's throughput evidence: all three routers on the
         # dispatched path + the dense-exact A/B.  Runs on every backend

@@ -99,7 +99,8 @@ _COLLECTIVE_PRIMS = {
     "ppermute": "collective-permute",
     "all_to_all": "all-to-all",
     "all_gather": "all-gather",
-    "psum_scatter": "reduce-scatter",
+    # jax.lax.psum_scatter traces as the reduce_scatter primitive
+    "reduce_scatter": "reduce-scatter",
 }
 
 

@@ -1,0 +1,198 @@
+"""CI gate for the per-entry collective-traffic artifact (deep lint).
+
+``pdrnn-lint --deep`` emits per-entry traced collective traffic into
+``lint-deep-report.json``.  This checker diffs the data-parallel
+entries against the checked-in ``lint/collective_expectations.json``
+so the sharded weight update's traffic shape (2004.13336) is a gated
+contract, not a one-off claim:
+
+- every expected entry is present with EXACTLY the expected per-op
+  counts and bytes (any regrowth of update-phase traffic fails CI);
+- relational invariants that must hold by construction:
+
+  * a sharded SPMD entry moves gradients by reduce-scatter and params
+    by allgather - per-device OUTPUT bytes (the artifact's convention)
+    satisfy ``reduce_scatter.bytes * N == all_gather.bytes`` on the
+    N-way lint mesh;
+  * the matching replicated entry's gradient all-reduce carries the
+    full parameter vector: ``all_reduce.bytes >= reduce_scatter.bytes
+    * N`` (equality up to the loss/metric scalar all-reduces), i.e.
+    the update-phase per-device bytes really dropped ~N/2-fold;
+  * the native sharded update program has NO traced collectives (the
+    ring runs on the host) and is a strictly smaller program than the
+    replicated one (shard-sized operands).
+
+Usage::
+
+    python -m pytorch_distributed_rnn_tpu.lint.collective_check \
+        lint-deep-report.json            # diff (CI gate; exit 1 on drift)
+    python -m ... lint-deep-report.json --write   # regenerate expectations
+
+Intentional traffic changes regenerate with ``--write`` and commit the
+diff - exactly the lint-baseline workflow.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+EXPECTATIONS_PATH = Path(__file__).parent / "collective_expectations.json"
+
+# the pure-DP surface this PR's contract covers; other families' traffic
+# is tracked by the ordinary artifact diff in review
+GATED_ENTRIES = (
+    "dp.spmd_train_step",
+    "dp.spmd_train_step_sharded",
+    "dp.spmd_train_step_sharded_hvd",
+    "dp.spmd_epoch_fn",
+    "dp.spmd_epoch_fn_sharded",
+    "native_ddp.apply_update",
+    "native_ddp.apply_update_sharded",
+)
+
+# sharded entry -> its replicated twin (for the bytes-drop relation)
+SHARDED_TO_REPLICATED = {
+    "dp.spmd_train_step_sharded": "dp.spmd_train_step",
+    "dp.spmd_train_step_sharded_hvd": "dp.spmd_train_step",
+    "dp.spmd_epoch_fn_sharded": "dp.spmd_epoch_fn",
+}
+
+# loss + metrics scalar all-reduces ride both flavors; the grad/update
+# relation holds up to that slack per traced step
+SCALAR_SLACK_BYTES = 64
+
+
+def load_entries(report_path) -> dict:
+    """entry name -> {"collectives": {...}, "eqns": int} from a deep
+    report (the artifact CI uploads)."""
+    report = json.loads(Path(report_path).read_text())
+    deep = report.get("deep") or {}
+    rows = deep.get("entries") or []
+    out = {}
+    for row in rows:
+        out[row["entry"]] = {
+            "collectives": row.get("collectives") or {},
+            "eqns": int(row.get("eqns", 0)),
+        }
+    return out
+
+
+def check(entries: dict, expectations: dict, mesh_n: int = 2) -> list[str]:
+    """All contract violations (empty = gate passes)."""
+    problems = []
+    expected_entries = expectations.get("entries", {})
+    for name in expectations.get("gated", GATED_ENTRIES):
+        if name not in entries:
+            problems.append(f"{name}: missing from the deep report "
+                            "(entry unregistered or failed to trace)")
+            continue
+        got = entries[name]["collectives"]
+        want = expected_entries.get(name, {}).get("collectives", {})
+        if got != want:
+            problems.append(
+                f"{name}: collective traffic drifted\n"
+                f"  expected: {json.dumps(want, sort_keys=True)}\n"
+                f"  got:      {json.dumps(got, sort_keys=True)}\n"
+                "  (intentional? regenerate with collective_check --write)"
+            )
+
+    # relational invariants - independent of the stored numbers, so a
+    # --write can never silently launder a broken traffic shape
+    for sharded, replicated in SHARDED_TO_REPLICATED.items():
+        if sharded not in entries or replicated not in entries:
+            continue
+        sh = entries[sharded]["collectives"]
+        rep = entries[replicated]["collectives"]
+        rs = sh.get("reduce-scatter", {}).get("bytes", 0)
+        ag = sh.get("all-gather", {}).get("bytes", 0)
+        ar = rep.get("all-reduce", {}).get("bytes", 0)
+        if not rs or not ag:
+            problems.append(
+                f"{sharded}: expected reduce-scatter + all-gather update "
+                f"phase, got {json.dumps(sh, sort_keys=True)}"
+            )
+            continue
+        if rs * mesh_n != ag:
+            problems.append(
+                f"{sharded}: reduce-scatter bytes ({rs}) x N ({mesh_n}) "
+                f"!= all-gather bytes ({ag}) - the update phase no "
+                "longer moves 1/N gradient shards against full params"
+            )
+        if not (0 <= ar - rs * mesh_n <= SCALAR_SLACK_BYTES * max(
+                1, sh.get("reduce-scatter", {}).get("count", 1))):
+            problems.append(
+                f"{sharded} vs {replicated}: replicated grad all-reduce "
+                f"({ar} B) should equal reduce-scatter x N ({rs * mesh_n} "
+                "B) up to the loss/metric scalars - the per-device "
+                "update-phase bytes did not drop as sharding promises"
+            )
+
+    sh_native = entries.get("native_ddp.apply_update_sharded")
+    rep_native = entries.get("native_ddp.apply_update")
+    if sh_native and rep_native:
+        if sh_native["collectives"]:
+            problems.append(
+                "native_ddp.apply_update_sharded: traced collectives "
+                f"{json.dumps(sh_native['collectives'])} - the native "
+                "update program must stay collective-free (the ring "
+                "reduce-scatter/allgather are host-side)"
+            )
+        if sh_native["eqns"] >= rep_native["eqns"]:
+            problems.append(
+                "native_ddp.apply_update_sharded: program not smaller "
+                f"than the replicated update ({sh_native['eqns']} vs "
+                f"{rep_native['eqns']} eqns) - shard-sized operands "
+                "should shrink it"
+            )
+    return problems
+
+
+def write_expectations(entries: dict, path=EXPECTATIONS_PATH) -> None:
+    payload = {
+        "comment": "checked-in per-entry collective traffic for the "
+                   "pure-DP entries; regenerate with "
+                   "python -m pytorch_distributed_rnn_tpu.lint."
+                   "collective_check <report> --write",
+        "gated": list(GATED_ENTRIES),
+        "entries": {
+            name: {"collectives": entries[name]["collectives"]}
+            for name in GATED_ENTRIES if name in entries
+        },
+    }
+    Path(path).write_text(json.dumps(payload, indent=2, sort_keys=True)
+                          + "\n")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="collective_check",
+        description="diff the deep-lint per-entry collective artifact "
+                    "against lint/collective_expectations.json",
+    )
+    ap.add_argument("report", help="lint-deep-report.json from "
+                                   "pdrnn-lint --deep --format json")
+    ap.add_argument("--write", action="store_true",
+                    help="regenerate the expectation file from the report")
+    ap.add_argument("--expectations", default=str(EXPECTATIONS_PATH))
+    args = ap.parse_args(argv)
+
+    entries = load_entries(args.report)
+    if args.write:
+        write_expectations(entries, args.expectations)
+        print(f"wrote {args.expectations}")
+        return 0
+    expectations = json.loads(Path(args.expectations).read_text())
+    problems = check(entries, expectations)
+    for p in problems:
+        print(f"collective-check: {p}", file=sys.stderr)
+    if not problems:
+        print(f"collective-check: {len(expectations.get('entries', {}))} "
+              "entries match; sharded-update invariants hold")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

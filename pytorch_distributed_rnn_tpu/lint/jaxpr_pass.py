@@ -114,12 +114,15 @@ def _has_real_compute(eqn) -> bool:
         for inner in sub.eqns
     )
 
-_REDUCING_COLLECTIVES = {"psum", "pmin", "pmax"}
+# reduce_scatter (jax.lax.psum_scatter's primitive) reduces like psum -
+# its output is a slice of the sum - so a step whose gradients flow
+# through it IS synchronized (PD201)
+_REDUCING_COLLECTIVES = {"psum", "pmin", "pmax", "reduce_scatter"}
 # primitive -> params key carrying the axis name(s)
 _AXIS_PARAM = {
     "psum": "axes", "pmin": "axes", "pmax": "axes",
     "ppermute": "axis_name", "all_gather": "axis_name",
-    "all_to_all": "axis_name", "psum_scatter": "axis_name",
+    "all_to_all": "axis_name", "reduce_scatter": "axis_name",
     "axis_index": "axis_name",
 }
 

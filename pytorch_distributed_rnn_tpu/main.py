@@ -153,6 +153,18 @@ def build_parser() -> argparse.ArgumentParser:
         "activation-memory lever for batches that do not fit HBM",
     )
     parser.add_argument(
+        "--sharded-update", default=True,
+        action=argparse.BooleanOptionalAction,
+        help="cross-replica sharded weight update (2004.13336) on the "
+        "pure data-parallel strategies (distributed / horovod / "
+        "distributed-native): reduce-scatter the gradient, apply a "
+        "1/world-sharded optimizer update, allgather fresh params - "
+        "~2x less update-phase collective bytes and 1/world the "
+        "optimizer-state memory, bitwise-identical results.  Default "
+        "on; --no-sharded-update restores the replicated full apply.  "
+        "Inert on strategies that already shard the update (fsdp/mesh)",
+    )
+    parser.add_argument(
         "--precision", default="f32", choices=["f32", "bf16"],
         help="bf16: bfloat16 compute (full MXU rate, half the HBM "
         "traffic) with f32 parameters and optimizer state",

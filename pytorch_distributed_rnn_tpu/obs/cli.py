@@ -50,6 +50,10 @@ _SUMMARY_FIELDS = (
     ("step_s_p95", "{:.6f}"),
     ("data_wait_frac", "{:.4f}"),
     ("collective_bytes_per_step", "{:,d}"),
+    # phase split: gradient = all-reduce; update = reduce-scatter +
+    # all-gather (the sharded weight update's ~2x drop shows up here)
+    ("collective_grad_bytes_per_step", "{:,d}"),
+    ("collective_update_bytes_per_step", "{:,d}"),
     ("duration_s", "{:.3f}"),
     ("memory_mb", "{:.1f}"),
     ("device_peak_mb", "{:.1f}"),

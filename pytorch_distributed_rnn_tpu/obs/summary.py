@@ -125,6 +125,16 @@ STREAMING_SUMMARY_KEYS = (
 )
 
 
+def _phase_bytes(collectives, op_kinds):
+    """Per-step bytes of the named traced collective op kinds, or None
+    when the run has no per-op breakdown (host-loop steps record the
+    collectives event with ``ops=None``)."""
+    if not collectives or not collectives.get("ops"):
+        return None
+    ops = collectives["ops"]
+    return int(sum(ops[k]["bytes"] for k in op_kinds if k in ops))
+
+
 def summarize_events(events: list[dict], path=None) -> dict:
     """One rank's summary: the numbers ``pdrnn-metrics summarize`` prints
     and ``evaluation/analysis.py`` folds into the measurement dataframe."""
@@ -187,6 +197,16 @@ def summarize_events(events: list[dict], path=None) -> dict:
             collectives.get("bytes_per_step") if collectives else None
         ),
         "collective_ops": collectives.get("ops") if collectives else None,
+        # per-phase split of the traced collective traffic: gradient
+        # phase = all-reduce; update phase = reduce-scatter + all-gather
+        # (the sharded weight update's signature, 2004.13336) - so the
+        # ~2x update-bytes drop is a diffable, gateable number
+        "collective_grad_bytes_per_step": _phase_bytes(
+            collectives, ("all-reduce",)
+        ),
+        "collective_update_bytes_per_step": _phase_bytes(
+            collectives, ("reduce-scatter", "all-gather")
+        ),
         # .get: a run_summary is not obliged to carry every field (the
         # serving engine has no memory_profiler wrap, for one); absent
         # optional metrics are None, never a loader error
@@ -300,10 +320,16 @@ def summarize_run(path) -> list[dict]:
     )
 
 
-# metrics where "bigger" is a regression, diffed by pdrnn-metrics diff
+# metrics where "bigger" is a regression, diffed by pdrnn-metrics diff.
+# The per-phase collective bytes gate the sharded-update win: a change
+# that re-inflates update-phase traffic (or gradient-phase traffic)
+# trips the diff exit contract.  Replicated baselines report update
+# bytes of 0, which the <= 0 guard in diff_summaries skips - turning
+# sharding ON can never read as a regression against them.
 REGRESSION_METRICS = (
     "step_s_mean", "step_s_p95", "duration_s", "memory_mb",
     "device_peak_mb", "data_wait_frac",
+    "collective_grad_bytes_per_step", "collective_update_bytes_per_step",
 )
 
 

@@ -73,7 +73,8 @@ def distributed_optimizer(optimizer, axis: str = "dp"):
     return optax.GradientTransformation(init, update)
 
 
-def _make_grad_step(loss_and_metrics, optimizer, axis: str, sync: str):
+def _make_grad_step(loss_and_metrics, optimizer, axis: str, sync: str,
+                    sharded=None):
     """The one grad+sync+update body every SPMD factory shares.
 
     ``sync="backward"`` (DDP flavor) allreduces gradients right after the
@@ -84,9 +85,29 @@ def _make_grad_step(loss_and_metrics, optimizer, axis: str, sync: str):
     ``step(params, opt_state, batch, *extra) -> (params, opt_state,
     local_loss, local_metrics)``; ``*extra`` is forwarded to the loss fn
     (the weighted-run path's mask).
+
+    ``sharded`` (a :class:`~..parallel.sharded_update.ShardedUpdate` bound
+    to ``optimizer`` and ``axis``) replaces the allreduce + replicated
+    full apply with reduce-scatter + 1/world optimizer apply + params
+    allgather (PAPERS.md 2004.13336).  Both sync flavors share the one
+    sharded body: ``psum_scatter(g)/world`` IS the matching slice of the
+    pmean both flavors converge to, so the flavors differ only in where
+    the replicated path hooks its allreduce - a distinction the sharded
+    schedule dissolves by construction.  ``opt_state`` must then be in
+    the sharded flat layout (``ShardedUpdate.init_opt_state``).
     """
     if sync not in ("backward", "step"):
         raise ValueError(f"sync must be 'backward' or 'step', got {sync!r}")
+    if sharded is not None:
+
+        def step(params, opt_state, batch, *extra):
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_and_metrics, has_aux=True
+            )(params, batch, *extra)
+            params, opt_state = sharded.apply(params, grads, opt_state)
+            return params, opt_state, loss, metrics
+
+        return step
     opt = distributed_optimizer(optimizer, axis) if sync == "step" else optimizer
 
     def step(params, opt_state, batch, *extra):
@@ -110,6 +131,7 @@ def make_spmd_train_step(
     sync: str = "backward",
     donate: bool = True,
     with_key: bool = False,
+    sharded=None,
 ):
     """Build a jitted SPMD data-parallel train step.
 
@@ -123,16 +145,22 @@ def make_spmd_train_step(
     ``with_key=True`` adds a trailing replicated per-step PRNG key argument
     forwarded to the loss fn (train-mode dropout; the loss fn folds the
     rank in so each shard draws an independent mask).
+
+    ``sharded`` switches the update to the reduce-scatter / sharded-apply /
+    allgather schedule; ``opt_state`` must then be in the sharded flat
+    layout and stays sharded along ``axis`` across steps.
     """
-    grad_step = _make_grad_step(loss_and_metrics, optimizer, axis, sync)
+    grad_step = _make_grad_step(loss_and_metrics, optimizer, axis, sync,
+                                sharded=sharded)
     rep = P()
+    st = sharded.opt_state_specs() if sharded is not None else rep
     key_specs = (rep,) if with_key else ()
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(rep, rep, P(axis)) + key_specs,
-        out_specs=(rep, rep, rep, rep),
+        in_specs=(rep, st, P(axis)) + key_specs,
+        out_specs=(rep, st, rep, rep),
         check_vma=False,
     )
     def _step(params, opt_state, batch, *extra):
@@ -157,6 +185,7 @@ def make_spmd_idx_train_step(
     sync: str = "backward",
     donate: bool = True,
     with_key: bool = False,
+    sharded=None,
 ):
     """Like :func:`make_spmd_train_step` but the batch is selected ON
     DEVICE: ``step(params, opt_state, features, labels, idx)`` gathers
@@ -169,15 +198,17 @@ def make_spmd_idx_train_step(
     host link starves the accelerator.  ``idx`` is sharded along ``axis``
     (rank-major), so each shard gathers exactly its rank's micro-batch.
     """
-    grad_step = _make_grad_step(loss_and_metrics, optimizer, axis, sync)
+    grad_step = _make_grad_step(loss_and_metrics, optimizer, axis, sync,
+                                sharded=sharded)
     rep = P()
+    st = sharded.opt_state_specs() if sharded is not None else rep
     key_specs = (rep,) if with_key else ()
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(rep, rep, rep, rep, P(axis)) + key_specs,
-        out_specs=(rep, rep, rep, rep),
+        in_specs=(rep, st, rep, rep, P(axis)) + key_specs,
+        out_specs=(rep, st, rep, rep),
         check_vma=False,
     )
     def _step(params, opt_state, features, labels, idx, *extra):
@@ -203,6 +234,7 @@ def make_spmd_epoch_fn(
     sync: str = "backward",
     donate: bool = True,
     with_key: bool = False,
+    sharded=None,
 ):
     """Whole-epoch SPMD program: ``lax.scan`` over the epoch's batch-index
     matrix, one device dispatch per epoch.
@@ -219,15 +251,17 @@ def make_spmd_epoch_fn(
     ``with_key=True`` adds a trailing replicated (num_batches, 2) per-step
     key matrix riding the scan (train-mode dropout).
     """
-    grad_step = _make_grad_step(loss_and_metrics, optimizer, axis, sync)
+    grad_step = _make_grad_step(loss_and_metrics, optimizer, axis, sync,
+                                sharded=sharded)
     rep = P()
+    st = sharded.opt_state_specs() if sharded is not None else rep
     key_specs = (P(None),) if with_key else ()
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(rep, rep, rep, rep, P(None, axis)) + key_specs,
-        out_specs=(rep, rep, rep, rep),
+        in_specs=(rep, st, rep, rep, P(None, axis)) + key_specs,
+        out_specs=(rep, st, rep, rep),
         check_vma=False,
     )
     def _epoch(params, opt_state, features, labels, idx_mat, *key_mat):
@@ -264,6 +298,7 @@ def make_spmd_run_fn(
     sync: str = "backward",
     donate: bool = True,
     with_key: bool = False,
+    sharded=None,
 ):
     """The whole multi-epoch training run as ONE SPMD program: scan over
     every (weight-masked) batch of every epoch.
@@ -276,16 +311,18 @@ def make_spmd_run_fn(
     live examples (the sampler pads shards to equal length, and batch
     padding is per-rank-equal by construction).
     """
-    grad_step = _make_grad_step(weighted_loss_and_metrics, optimizer, axis, sync)
+    grad_step = _make_grad_step(weighted_loss_and_metrics, optimizer, axis,
+                                sync, sharded=sharded)
     rep = P()
+    st = sharded.opt_state_specs() if sharded is not None else rep
     key_specs = (P(None),) if with_key else ()
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(rep, rep, rep, rep, P(None, axis), P(None, axis))
+        in_specs=(rep, st, rep, rep, P(None, axis), P(None, axis))
         + key_specs,
-        out_specs=(rep, rep, rep, rep),
+        out_specs=(rep, st, rep, rep),
         check_vma=False,
     )
     def _run(params, opt_state, features, labels, idx_mat, w_mat, *key_mat):
@@ -381,5 +418,58 @@ def declare_trace_entries(register):
     register(
         name="dp.spmd_epoch_fn", family="ddp", path=path,
         build=build_epoch, mesh_axes={"dp": 2}, data_axis="dp",
+        donate=(0, 1),
+    )
+
+    # Sharded-update variants (PAPERS.md 2004.13336): the same programs
+    # with the update-phase allreduce replaced by reduce-scatter +
+    # 1/world apply + allgather.  The per-entry collective artifact diffs
+    # these against the replicated entries above (see
+    # lint/collective_check.py).
+    def _sharded(sync):
+        from pytorch_distributed_rnn_tpu.parallel.sharded_update import (
+            ShardedUpdate,
+        )
+
+        mesh, opt, loss, params, _, sds = _lint_motion_program()
+        sharded = ShardedUpdate(opt, params, mesh.shape["dp"])
+        return mesh, opt, loss, params, sharded.abstract_opt_state(), sds, sharded
+
+    def build_step_sharded():
+        mesh, opt, loss, params, opt_state, sds, sharded = _sharded("backward")
+        step = make_spmd_train_step(loss, opt, mesh, sharded=sharded)
+        batch = (sds((4, 16, 9), jnp.float32), sds((4,), jnp.int32))
+        return step, (params, opt_state, batch)
+
+    register(
+        name="dp.spmd_train_step_sharded", family="ddp", path=path,
+        build=build_step_sharded, mesh_axes={"dp": 2}, data_axis="dp",
+        donate=(0, 1),
+    )
+
+    def build_step_sharded_hvd():
+        mesh, opt, loss, params, opt_state, sds, sharded = _sharded("step")
+        step = make_spmd_train_step(loss, opt, mesh, sync="step",
+                                    sharded=sharded)
+        batch = (sds((4, 16, 9), jnp.float32), sds((4,), jnp.int32))
+        return step, (params, opt_state, batch)
+
+    register(
+        name="dp.spmd_train_step_sharded_hvd", family="horovod", path=path,
+        build=build_step_sharded_hvd, mesh_axes={"dp": 2}, data_axis="dp",
+        donate=(0, 1),
+    )
+
+    def build_epoch_sharded():
+        mesh, opt, loss, params, opt_state, sds, sharded = _sharded("backward")
+        epoch = make_spmd_epoch_fn(loss, opt, mesh, sharded=sharded)
+        features = sds((8, 16, 9), jnp.float32)
+        labels = sds((8,), jnp.int32)
+        idx_mat = sds((3, 4), jnp.int32)
+        return epoch, (params, opt_state, features, labels, idx_mat)
+
+    register(
+        name="dp.spmd_epoch_fn_sharded", family="ddp", path=path,
+        build=build_epoch_sharded, mesh_axes={"dp": 2}, data_axis="dp",
         donate=(0, 1),
     )

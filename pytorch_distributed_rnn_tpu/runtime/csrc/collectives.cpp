@@ -550,6 +550,61 @@ int ring_allreduce(Comm* c, T* data, int64_t count, int op) {
   return 0;
 }
 
+// Ring reduce-scatter: rank r returns chunk r of the elementwise
+// reduction in `out`; `data` is scratch (clobbered in place).  Equal
+// chunks only (count % world == 0; the Python layer pads) - the sharded
+// weight update owes every rank an equal optimizer shard anyway.
+//
+// The reduce phase is BIT-IDENTICAL to ring_allreduce's: same indices,
+// same per-chunk accumulation order, so a sharded update's reduced
+// gradient shard equals the corresponding slice of a full allreduce
+// exactly (the bitwise-parity bar of the sharded-update tests).  That
+// phase leaves rank r holding chunk (r+1) mod world; one extra ring
+// hop hands each chunk to its owner.
+template <typename T>
+int ring_reduce_scatter(Comm* c, T* data, int64_t count, int op, T* out) {
+  const int world = c->world;
+  if (count % world != 0) return -1;
+  const int64_t shard = count / world;
+  if (world == 1) {
+    std::memcpy(out, data, static_cast<size_t>(shard) * sizeof(T));
+    return 0;
+  }
+  const int next = (c->rank + 1) % world;
+  const int prev = (c->rank - 1 + world) % world;
+
+  std::vector<T> inbox(static_cast<size_t>(shard));
+  for (int step = 0; step < world - 1; ++step) {
+    const int send_idx = (c->rank - step + world) % world;
+    const int recv_idx = (c->rank - step - 1 + world) % world;
+    bool ok_send = false;
+    std::thread sender([&] {
+      ok_send = send_all(c, c->peer_fd[next], data + send_idx * shard,
+                         static_cast<size_t>(shard) * sizeof(T));
+    });
+    bool ok_recv = recv_all(c->peer_fd[prev], inbox.data(),
+                            static_cast<size_t>(shard) * sizeof(T));
+    sender.join();
+    if (!ok_send || !ok_recv) return -1;
+    Elem<T>::accumulate(data + recv_idx * shard, inbox.data(), shard);
+  }
+
+  // rotation hop: rank r holds reduced chunk (r+1) mod world; sending it
+  // to `next` delivers chunk r to every rank directly into `out`
+  const int held = (c->rank + 1) % world;
+  bool ok_send = false;
+  std::thread sender([&] {
+    ok_send = send_all(c, c->peer_fd[next], data + held * shard,
+                       static_cast<size_t>(shard) * sizeof(T));
+  });
+  bool ok_recv = recv_all(c->peer_fd[prev], out,
+                          static_cast<size_t>(shard) * sizeof(T));
+  sender.join();
+  if (!ok_send || !ok_recv) return -1;
+  if (op == 1) Elem<T>::scale(out, shard, 1.0 / world);
+  return 0;
+}
+
 }  // namespace
 
 extern "C" {
@@ -570,6 +625,25 @@ int pdrnn_allreduce(Comm* c, void* data, int64_t count, int dtype, int op) {
 // kept for ABI stability with existing callers
 int pdrnn_allreduce_f32(Comm* c, float* data, int64_t count, int op) {
   return pdrnn_allreduce(c, data, count, 0, op);
+}
+
+// Reduce-scatter: `output` receives rank's count/world-element chunk of
+// the reduction; `data` is scratch (clobbered).  count % world must be 0.
+// dtype/op codes as pdrnn_allreduce.
+int pdrnn_reduce_scatter(Comm* c, void* data, int64_t count, int dtype,
+                         int op, void* output) {
+  switch (dtype) {
+    case 0:
+      return ring_reduce_scatter(c, static_cast<float*>(data), count, op,
+                                 static_cast<float*>(output));
+    case 1:
+      return ring_reduce_scatter(c, static_cast<double*>(data), count, op,
+                                 static_cast<double*>(output));
+    case 2:
+      return ring_reduce_scatter(c, static_cast<Bf16*>(data), count, op,
+                                 static_cast<Bf16*>(output));
+  }
+  return -1;
 }
 
 int pdrnn_allgather(Comm* c, const void* input, int64_t nbytes, void* output) {

@@ -130,6 +130,14 @@ def _load():
         ctypes.c_int,
         ctypes.c_int,
     ]
+    lib.pdrnn_reduce_scatter.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_void_p,
+    ]
     lib.pdrnn_allgather.argtypes = [
         ctypes.c_void_p,
         ctypes.c_void_p,
@@ -291,6 +299,40 @@ class Communicator:
             "allreduce",
         )
         return array
+
+    def reduce_scatter(self, array: np.ndarray, op: str = "sum") -> np.ndarray:
+        """Ring reduce-scatter: returns this rank's ``size // world_size``
+        chunk (chunk ``rank``) of the elementwise reduction as a 1-D
+        array.  ``array.size`` must divide evenly by ``world_size`` -
+        callers pad (the sharded weight update's padded-ravel
+        bookkeeping).  The input is treated as scratch: a private copy is
+        reduced in place, the caller's array is never mutated.
+
+        The reduce phase reuses the allreduce ring's exact accumulation
+        order, so each chunk is bitwise-equal to the same slice of
+        :meth:`allreduce` - the property the sharded-vs-replicated
+        update-parity tests pin."""
+        dtype_code = _ALLREDUCE_DTYPES.get(array.dtype.name)
+        if dtype_code is None:
+            raise TypeError(
+                f"reduce_scatter supports {sorted(_ALLREDUCE_DTYPES)}, "
+                f"got {array.dtype.name}"
+            )
+        if array.size % self.world_size:
+            raise ValueError(
+                f"reduce_scatter needs size % world == 0, got "
+                f"{array.size} % {self.world_size}"
+            )
+        scratch = np.ascontiguousarray(array).reshape(-1).copy()
+        out = np.empty(array.size // self.world_size, dtype=array.dtype)
+        self._check(
+            self._lib.pdrnn_reduce_scatter(
+                self._handle, scratch.ctypes.data, scratch.size,
+                dtype_code, {"sum": 0, "mean": 1}[op], out.ctypes.data,
+            ),
+            "reduce_scatter",
+        )
+        return out
 
     def allgather(self, array: np.ndarray) -> np.ndarray:
         array = np.ascontiguousarray(array)

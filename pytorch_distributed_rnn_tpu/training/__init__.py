@@ -200,6 +200,7 @@ def _run_trainer(args, trainer_class, model, datasets):
         keep_checkpoints=getattr(args, "keep_checkpoints", 0),
         recorder=recorder,
         profile_steps=profile_steps,
+        sharded_update=getattr(args, "sharded_update", True),
     )
 
     resume = getattr(args, "resume", None)

@@ -84,6 +84,13 @@ class Trainer:
     # (SPMD pmean steps, native-TCP DDP, PS workers) flip this off until
     # they implement microbatch accumulation themselves
     SUPPORTS_GRAD_ACCUM = True
+    # pure-DP strategies that can run the cross-replica sharded weight
+    # update (reduce-scatter + 1/world optax apply + allgather,
+    # parallel/sharded_update.py) flip this on; everywhere else the
+    # --sharded-update flag is accepted and inert (world of 1, or the
+    # optimizer state is already sharded by the strategy itself - ZeRO,
+    # mesh layouts)
+    SUPPORTS_SHARDED_UPDATE = False
 
     def __init__(
         self,
@@ -106,6 +113,7 @@ class Trainer:
         keep_checkpoints: int = 0,
         recorder=None,
         profile_steps=None,
+        sharded_update: bool = True,
     ):
         self.model = model
         # structured telemetry (obs/recorder.py): NULL_RECORDER when off -
@@ -201,11 +209,17 @@ class Trainer:
                 f"grad_accum {self.grad_accum}"
             )
 
+        # --sharded-update (default on): strategies with
+        # SUPPORTS_SHARDED_UPDATE use it in _init_opt_state to lay the
+        # optimizer state out 1/world-sharded; stored before the init
+        # hook runs so the hook can read it
+        self.sharded_update = bool(sharded_update)
+
         self.params = model.init(jax.random.PRNGKey(seed if seed is not None else 0))
         self.optimizer = self._get_optimizer(learning_rate)
         if self.guard is not None:
             self.optimizer = self.guard.wrap(self.optimizer)
-        self.opt_state = self.optimizer.init(self.params)
+        self.opt_state = self._init_opt_state()
 
         # train-mode dropout: real here, unlike the reference's dead
         # --dropout flag (/root/reference/src/motion/main.py:26 - parsed,
@@ -237,6 +251,14 @@ class Trainer:
 
     def _get_optimizer(self, lr: float):
         return optax.adam(lr)  # torch Adam defaults: b1=.9 b2=.999 eps=1e-8
+
+    def _init_opt_state(self):
+        """Hook: build the initial optimizer state.  Strategies with
+        SUPPORTS_SHARDED_UPDATE override to initialize it ALREADY in the
+        1/world sharded flat layout (parallel/sharded_update.py) when
+        ``self.sharded_update`` is on - the full-size state then never
+        materializes per device."""
+        return self.optimizer.init(self.params)
 
     def _get_formatter(self, epochs: int) -> TrainingMessageFormatter:
         return TrainingMessageFormatter(epochs)
@@ -1208,6 +1230,20 @@ class Trainer:
         strategies restrict to rank 0)."""
         return True
 
+    def _checkpoint_template_state(self):
+        """Hook: the (params, opt_state) TEMPLATE a gathered checkpoint
+        deserializes into.  Sharded-update strategies return the
+        standard unsharded layout (flax ``from_bytes`` only reads the
+        tree structure, so abstract leaves are fine); everyone else
+        restores straight into the live state."""
+        return self.params, self.opt_state
+
+    def _adopt_restored_state(self, params, opt_state):
+        """Hook: install state restored in the UNSHARDED checkpoint
+        layout.  Sharded-update strategies convert ``opt_state`` back to
+        their live sharded layout here."""
+        self.params, self.opt_state = params, opt_state
+
     def _save_checkpoint(self, epoch, loss, best=False):
         if self.checkpoint_dir is None:
             return
@@ -1292,9 +1328,11 @@ class Trainer:
                 "the .ckpt file (gathered)"
             )
         else:
-            self.params, self.opt_state, meta = load_checkpoint(
-                checkpoint_path, self.params, self.opt_state
+            template_p, template_st = self._checkpoint_template_state()
+            params, opt_state, meta = load_checkpoint(
+                checkpoint_path, template_p, template_st
             )
+            self._adopt_restored_state(params, opt_state)
         self._resume_best_loss = meta["loss"]
         if advance_epoch:
             self._start_epoch = int(meta["epoch"])

@@ -34,6 +34,7 @@ from pytorch_distributed_rnn_tpu.parallel.dp import (
     make_spmd_train_step,
 )
 from pytorch_distributed_rnn_tpu.parallel.mesh import make_mesh
+from pytorch_distributed_rnn_tpu.parallel.sharded_update import ShardedUpdate
 from pytorch_distributed_rnn_tpu.training.base import Trainer
 from pytorch_distributed_rnn_tpu.training.formatter import TrainingMessageFormatter
 
@@ -45,6 +46,9 @@ class SpmdTrainer(Trainer):
     # (parallel/dp.py) bypass it, so reject the flag instead of silently
     # ignoring it
     SUPPORTS_GRAD_ACCUM = False
+    # pure-DP: the whole optimizer state is redundantly replicated, so
+    # the cross-replica sharded update (2004.13336) applies verbatim
+    SUPPORTS_SHARDED_UPDATE = True
 
     SYNC = "backward"
 
@@ -122,6 +126,48 @@ class SpmdTrainer(Trainer):
         # stream per rank); the grad pmean keeps params identical anyway
         return jax.random.fold_in(key, jax.lax.axis_index(self.axis))
 
+    def _init_opt_state(self):
+        # --sharded-update (2004.13336): optimizer state as ONE flat
+        # padded vector sharded along the dp axis, initialized in place
+        # on the mesh so the full mu/nu never materialize per device.
+        # The guard-wrapped optimizer needs the cross-shard poison psum
+        # (see ShardedUpdate) so its skip decision stays global.
+        self._shard_update = None
+        if self.sharded_update and self.SUPPORTS_SHARDED_UPDATE:
+            self._shard_update = ShardedUpdate(
+                self.optimizer,
+                self.params,
+                self.mesh.shape[self.axis],
+                axis=self.axis,
+                poison_nonfinite=self.guard is not None,
+            )
+            return self._shard_update.init_opt_state(self.params,
+                                                     mesh=self.mesh)
+        return super()._init_opt_state()
+
+    def _checkpoint_state(self):
+        # checkpoints always carry the UNSHARDED layout so --resume,
+        # the PS, serving, and streaming consumers are layout-agnostic
+        if self._shard_update is not None:
+            return self.params, self._shard_update.replicated_opt_state(
+                self.opt_state
+            )
+        return super()._checkpoint_state()
+
+    def _checkpoint_template_state(self):
+        if self._shard_update is not None:
+            return self.params, jax.eval_shape(
+                self.optimizer.init, self.params
+            )
+        return super()._checkpoint_template_state()
+
+    def _adopt_restored_state(self, params, opt_state):
+        if self._shard_update is not None:
+            self.params = params
+            self.opt_state = self._shard_update.flat_opt_state(opt_state)
+        else:
+            super()._adopt_restored_state(params, opt_state)
+
     def _build_train_step(self):
         return make_spmd_train_step(
             self._loss_and_metrics,
@@ -130,6 +176,7 @@ class SpmdTrainer(Trainer):
             axis=self.axis,
             sync=self.SYNC,
             with_key=self._dropout > 0.0,
+            sharded=self._shard_update,
         )
 
     def _build_idx_train_step(self):
@@ -140,6 +187,7 @@ class SpmdTrainer(Trainer):
             axis=self.axis,
             sync=self.SYNC,
             with_key=self._dropout > 0.0,
+            sharded=self._shard_update,
         )
 
     def _build_epoch_fn(self):
@@ -150,6 +198,7 @@ class SpmdTrainer(Trainer):
             axis=self.axis,
             sync=self.SYNC,
             with_key=self._dropout > 0.0,
+            sharded=self._shard_update,
         )
 
     def _build_run_fn(self):
@@ -160,6 +209,7 @@ class SpmdTrainer(Trainer):
             axis=self.axis,
             sync=self.SYNC,
             with_key=self._dropout > 0.0,
+            sharded=self._shard_update,
         )
 
     def _data_sharding(self):

@@ -35,6 +35,11 @@ from pytorch_distributed_rnn_tpu.training.distributed import SpmdTrainer
 class MeshTrainer(SpmdTrainer):
     """Composed-mesh training strategy for the motion model."""
 
+    # composed meshes mix model axes into the update (TP/SP/PP/EP
+    # layouts shard parameters themselves); the pure-DP flat-ravel
+    # sharded update does not apply, so --sharded-update is inert
+    SUPPORTS_SHARDED_UPDATE = False
+
     def __init__(self, *, mesh_axes, schedule: str = "wavefront",
                  num_microbatches: int = 4, pp_schedule: str = "gpipe",
                  pp_chunks: int = 2, **kwargs):

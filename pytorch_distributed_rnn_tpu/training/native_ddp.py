@@ -39,16 +39,33 @@ import optax
 from jax.flatten_util import ravel_pytree
 
 from pytorch_distributed_rnn_tpu.data.sampler import DistributedSampler
+from pytorch_distributed_rnn_tpu.parallel.sharded_update import ShardedUpdate
 from pytorch_distributed_rnn_tpu.training.base import Trainer
 from pytorch_distributed_rnn_tpu.training.formatter import TrainingMessageFormatter
 
 log = logging.getLogger(__name__)
 
 
+def _wire_dtype(dtype):
+    """The dtype gradients/params ride the TCP ring in: the params' OWN
+    dtype when the native collectives support it (f32/f64/bf16 - bf16
+    halves wire bytes vs the old unconditional f32 upcast), else f32."""
+    from pytorch_distributed_rnn_tpu.runtime.native import _ALLREDUCE_DTYPES
+
+    if np.dtype(dtype).name in _ALLREDUCE_DTYPES:
+        return np.dtype(dtype)
+    return np.dtype(np.float32)
+
+
 class NativeDDPTrainer(Trainer):
     """One rank of a process-per-rank DDP world."""
 
     SUPPORTS_GRAD_ACCUM = False  # builds its step around the TCP allreduce
+    # pure-DP ring: the sharded weight update (2004.13336) applies - each
+    # rank reduce-scatters gradients, updates only its 1/world slice of
+    # the params (holding only that slice's optimizer state), and
+    # allgathers the fresh params
+    SUPPORTS_SHARDED_UPDATE = True
 
     # gradients cross the host TCP transport every step, so the host must
     # act per batch (no scanned device-resident epoch program)
@@ -90,6 +107,15 @@ class NativeDDPTrainer(Trainer):
             )
         rank = comm.rank
         world = comm.world_size
+        # set before super(): base's _init_opt_state hook runs inside
+        # __init__ (before base assigns self.rank/world_size) and the
+        # sharded layout needs the comm's rank/world
+        self.comm = comm
+        # whether the WORLD checkpoints (the pre-rank-gating arg): the
+        # epoch-end opt-state gather is a collective, so every rank must
+        # take the same decision even though only rank 0 keeps
+        # checkpoint_dir set
+        self._ckpt_world = checkpoint_dir is not None
         sampler = DistributedSampler(
             len(training_set), num_replicas=world, rank=rank, seed=seed or 0
         )
@@ -111,18 +137,36 @@ class NativeDDPTrainer(Trainer):
             fuse_run=fuse_run,
             **kwargs,
         )
-        self.comm = comm
         self.rank = rank
         self.world_size = world
 
         # parameter broadcast from rank 0: the DDP-construction broadcast
         # (reference example_ddp.py:46) - afterwards every replica is
-        # bit-identical and stays so via identical averaged updates
+        # bit-identical and stays so via identical averaged updates.
+        # Rides the params' native dtype (bf16 params broadcast at
+        # 2 bytes/elem; the old unconditional f32 doubled their wire
+        # bytes AND rounded the non-root replicas through f32).
         flat, self._unravel = ravel_pytree(self.params)
-        flat = self.comm.broadcast(
-            np.asarray(flat, np.float32).copy(), root=0
+        wire = _wire_dtype(flat.dtype)
+        bcast = self.comm.broadcast(np.asarray(flat, wire).copy(), root=0)
+        self.params = self._unravel(
+            jnp.asarray(bcast).astype(jnp.asarray(flat).dtype)
         )
-        self.params = self._unravel(jnp.asarray(flat))
+
+    def _init_opt_state(self):
+        # --sharded-update: each rank initializes ONLY its 1/world slice
+        # of the optimizer state (parallel/sharded_update.py) - the
+        # memory half of 2004.13336 on the process-per-rank ring
+        self._shard_update = None
+        self._ckpt_cache = None
+        if self.sharded_update:
+            self._shard_update = ShardedUpdate(
+                self.optimizer, self.params, self.comm.world_size
+            )
+            return self._shard_update.init_shard_opt_state(
+                self.params, self.comm.rank
+            )
+        return super()._init_opt_state()
 
     def _get_formatter(self, epochs):
         return TrainingMessageFormatter(epochs, self.rank)
@@ -133,6 +177,8 @@ class NativeDDPTrainer(Trainer):
         return jax.random.fold_in(key, self.rank)
 
     def _build_train_step(self):
+        if self._shard_update is not None:
+            return self._build_sharded_train_step()
         grad_fn = jax.jit(
             jax.value_and_grad(self._loss_and_metrics, has_aux=True)
         )
@@ -150,18 +196,120 @@ class NativeDDPTrainer(Trainer):
         def step(params, opt_state, batch, *extra):
             (loss, metrics), grads = grad_fn(params, batch, *extra)
             flat, unravel = ravel_pytree(grads)
-            # the DDP reducer analogue: one averaged allreduce over TCP.
+            # the DDP reducer analogue: one averaged allreduce over TCP
+            # in the gradients' native dtype (no silent f32 upcast).
             # .copy() is load-bearing: on CPU np.asarray is a zero-copy
             # view of the XLA buffer and the native allreduce writes
             # in place through a raw pointer
             summed = self.comm.allreduce(
-                np.asarray(flat, np.float32).copy()
+                np.asarray(flat, _wire_dtype(flat.dtype)).copy()
             )
             grads = unravel(jnp.asarray(summed / self.world_size))
             params, opt_state = apply_update(params, opt_state, grads)
             return params, opt_state, loss, metrics
 
         return step
+
+    def _build_sharded_train_step(self):
+        """Sharded weight update over the ring (2004.13336): per-step
+        wire traffic is one reduce-scatter (grads) + one allgather (fresh
+        params) instead of one full allreduce, and the optimizer apply
+        touches only this rank's 1/world slice.  Bitwise-identical to
+        the replicated step: the C++ reduce-scatter reuses the
+        allreduce's exact accumulation order, and the optax math is
+        elementwise."""
+        su = self._shard_update
+        grad_fn = jax.jit(
+            jax.value_and_grad(self._loss_and_metrics, has_aux=True)
+        )
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def apply_update_sharded(p_shard, opt_state, g_shard):
+            updates, opt_state = self.optimizer.update(
+                g_shard, opt_state, p_shard
+            )
+            return optax.apply_updates(p_shard, updates), opt_state
+
+        def step(params, opt_state, batch, *extra):
+            (loss, metrics), grads = grad_fn(params, batch, *extra)
+            flat, _ = ravel_pytree(grads)
+            wire = _wire_dtype(flat.dtype)
+            g_shard = self.comm.reduce_scatter(
+                su.pad_flat(np.asarray(flat, wire))
+            )
+            g_shard = g_shard / np.asarray(self.world_size, g_shard.dtype)
+            if self.guard is not None:
+                # global skip verdict: each rank's apply_if_finite only
+                # sees its own slice, so sync a 1-element any-non-finite
+                # flag and NaN-poison every slice when any rank is bad -
+                # all wrappers then take the identical skip decision
+                flag = self.comm.allreduce(np.asarray(
+                    [0.0 if np.all(np.isfinite(g_shard)) else 1.0],
+                    np.float32,
+                ))
+                if flag[0] > 0:
+                    g_shard = np.full_like(g_shard, np.nan)
+            flat_p, unravel = ravel_pytree(params)
+            p_shard = jnp.asarray(su.shard_slice(
+                su.pad_flat(np.asarray(flat_p)), self.rank
+            ))
+            # the same cast unravel() applies on the replicated path
+            # (wire dtype -> param dtype), so the optax math sees
+            # identical inputs
+            p_shard, opt_state = apply_update_sharded(
+                p_shard, opt_state,
+                jnp.asarray(g_shard).astype(p_shard.dtype),
+            )
+            # fresh params: each rank contributes its slice, every rank
+            # reassembles the full (identical) vector
+            gathered = self.comm.allgather(
+                np.ascontiguousarray(np.asarray(p_shard))
+            )
+            params = unravel(jnp.asarray(gathered.reshape(-1)[: su.size]))
+            return params, opt_state, loss, metrics
+
+        return step
+
+    # -- checkpoint layout (gathered, unsharded - collective-safe) -----------
+
+    def _train_epoch(self, formatter):
+        result = super()._train_epoch(formatter)
+        if self._shard_update is not None and self._ckpt_world:
+            # epoch-end opt-state gather on EVERY rank (the allgather is
+            # a collective; _save_checkpoint runs only where
+            # checkpoint_dir survived the rank gate, so gathering there
+            # would deadlock the ring) - rank 0 then writes the cached
+            # unsharded layout
+            self._ckpt_cache = self._shard_update.gather_opt_state(
+                self.opt_state, self.comm.allgather
+            )
+        return result
+
+    def _checkpoint_state(self):
+        if self._shard_update is not None:
+            if self._ckpt_cache is None:
+                raise RuntimeError(
+                    "sharded-update checkpoint requested before any "
+                    "epoch-end gather - no unsharded state cached"
+                )
+            return self.params, self._ckpt_cache
+        return super()._checkpoint_state()
+
+    def _checkpoint_template_state(self):
+        if self._shard_update is not None:
+            return self.params, jax.eval_shape(
+                self.optimizer.init, self.params
+            )
+        return super()._checkpoint_template_state()
+
+    def _adopt_restored_state(self, params, opt_state):
+        if self._shard_update is not None:
+            self.params = params
+            self.opt_state = self._shard_update.shard_opt_state(
+                opt_state, self.rank
+            )
+        else:
+            super()._adopt_restored_state(params, opt_state)
 
 
 # ---------------------------------------------------------------------------
@@ -199,6 +347,44 @@ def declare_trace_entries(register):
         name="native_ddp.apply_update", family="ddp",
         path="pytorch_distributed_rnn_tpu/training/native_ddp.py",
         build=build, mesh_axes={}, data_axis=None, donate=(0, 1),
+        kind="update",
+    )
+
+    def build_sharded():
+        from pytorch_distributed_rnn_tpu.lint.trace_registry import (
+            abstract_init,
+            prng_spec,
+            sds,
+        )
+        from pytorch_distributed_rnn_tpu.models import MotionModel
+        from pytorch_distributed_rnn_tpu.parallel.sharded_update import (
+            ShardedUpdate,
+        )
+
+        model = MotionModel(input_dim=9, hidden_dim=8, layer_dim=1,
+                            output_dim=6, impl="scan")
+        params = abstract_init(model.init, prng_spec())
+        optimizer = optax.adam(1e-3)
+        # the on-device program of the sharded ring step: this rank's
+        # 1/world param slice + shard-local optimizer state + its slice
+        # of the reduce-scattered gradient (world 2, the lint mesh
+        # convention); the TCP reduce-scatter/allgather around it are
+        # host collectives and cannot trace
+        su = ShardedUpdate(optimizer, params, 2)
+        p_shard = sds((su.shard,), su.dtype)
+        opt_state = abstract_init(optimizer.init, p_shard)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def apply_update_sharded(p, state, g):
+            updates, state = optimizer.update(g, state, p)
+            return optax.apply_updates(p, updates), state
+
+        return apply_update_sharded, (p_shard, opt_state, p_shard)
+
+    register(
+        name="native_ddp.apply_update_sharded", family="ddp",
+        path="pytorch_distributed_rnn_tpu/training/native_ddp.py",
+        build=build_sharded, mesh_axes={}, data_axis=None, donate=(0, 1),
         kind="update",
     )
 
@@ -247,6 +433,9 @@ def run_rank(comm, args, model, datasets, trainer_class=None):
         batch_size=args.batch_size,
         learning_rate=args.learning_rate,
         checkpoint_dir=args.checkpoint_directory,
+        # previously dropped here: --faults epoch kills + --resume auto
+        # on the ring need periodic epoch checkpoints to restart from
+        checkpoint_every=getattr(args, "checkpoint_every", 0),
         seed=args.seed,
         # forwarded so the unsupported-flag guard raises instead of the
         # flag being silently dropped
@@ -259,6 +448,7 @@ def run_rank(comm, args, model, datasets, trainer_class=None):
         keep_checkpoints=getattr(args, "keep_checkpoints", 0),
         recorder=recorder,
         profile_steps=profile_steps,
+        sharded_update=getattr(args, "sharded_update", True),
     )
     resume = getattr(args, "resume", None)
     if resume is not None and str(resume) == "auto":

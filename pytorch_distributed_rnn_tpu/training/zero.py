@@ -37,6 +37,10 @@ class ZeroTrainer(SpmdTrainer):
     # steps are built from the base _make_* bodies (which route through
     # _make_grad_step), so microbatch accumulation composes fine
     SUPPORTS_GRAD_ACCUM = True
+    # ZeRO already shards params AND optimizer state by layout; the
+    # flat-ravel sharded update would be redundant (and fight the
+    # NamedSharding placement), so --sharded-update is inert here
+    SUPPORTS_SHARDED_UPDATE = False
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)

@@ -381,6 +381,44 @@ class TestTraceRegistry:
         assert "all-to-all" in by_name["moe.mesh_train_step"]["collectives"]
 
 
+class TestCollectiveGate:
+    """The CI collective-traffic gate (lint/collective_check.py): the
+    sharded weight update's wire contract (2004.13336) is checked-in as
+    per-entry expectations, and a fresh trace must match them exactly."""
+
+    def test_fresh_report_matches_expectations_and_drift_fails(
+        self, tmp_path
+    ):
+        from pytorch_distributed_rnn_tpu.lint import collective_check
+
+        result = run_lint([PACKAGE], root=REPO_ROOT,
+                          baseline=load_baseline(BASELINE), deep=True)
+        by_name = {e["entry"]: e for e in result.deep["entries"]}
+        # every sharded-update flavor registered and traced: RS+AG update
+        # phase on the SPMD entries, collective-free device program on
+        # the native ring's
+        for name in ("dp.spmd_train_step_sharded",
+                     "dp.spmd_train_step_sharded_hvd",
+                     "dp.spmd_epoch_fn_sharded"):
+            assert "reduce-scatter" in by_name[name]["collectives"], name
+            assert "all-gather" in by_name[name]["collectives"], name
+        assert by_name["native_ddp.apply_update_sharded"]["collectives"] == {}
+
+        report = tmp_path / "lint-deep-report.json"
+        report.write_text(json.dumps({"deep": result.deep}))
+        assert collective_check.main([str(report)]) == 0
+
+        # regrown update-phase traffic must fail the gate: double the
+        # sharded entry's reduce-scatter bytes and re-check
+        tampered = json.loads(report.read_text())
+        for row in tampered["deep"]["entries"]:
+            if row["entry"] == "dp.spmd_train_step_sharded":
+                row["collectives"]["reduce-scatter"]["bytes"] *= 2
+        drifted = tmp_path / "drifted.json"
+        drifted.write_text(json.dumps(tampered))
+        assert collective_check.main([str(drifted)]) == 1
+
+
 class TestDeepFindingPlumbing:
     """Deep findings ride the shared reporting path: fingerprints,
     baseline suppression, select/ignore."""

@@ -129,6 +129,48 @@ def _body_allreduce_bf16(rank, world, port):
         return np.asarray(data, np.float32)
 
 
+def _body_reduce_scatter(rank, world, port, dtype_name):
+    """Returns (shard, matching slice of the allreduce) - the sharded
+    weight update's bitwise contract: the reduce-scatter reuses the ring
+    allreduce's accumulation order, so each rank's chunk must equal its
+    slice of the full allreduce EXACTLY."""
+    import ml_dtypes
+
+    dtype = dict(f32=np.float32, f64=np.float64,
+                 bf16=ml_dtypes.bfloat16)[dtype_name]
+    with Communicator("127.0.0.1", port, rank, world) as comm:
+        rng = np.random.default_rng(100 + rank)
+        data = rng.standard_normal(16 * world).astype(dtype)
+        full = comm.allreduce(data.copy())
+        shard = comm.reduce_scatter(data.copy())
+        chunk = (16 * world) // world
+        return (np.asarray(shard, np.float64).copy(),
+                np.asarray(full[rank * chunk:(rank + 1) * chunk],
+                           np.float64).copy())
+
+
+def _body_reduce_scatter_mean(rank, world, port):
+    with Communicator("127.0.0.1", port, rank, world) as comm:
+        rng = np.random.default_rng(7 + rank)
+        data = rng.standard_normal(8 * world).astype(np.float32)
+        fullm = comm.allreduce(data.copy(), op="mean")
+        shard = comm.reduce_scatter(data.copy(), op="mean")
+        chunk = 8
+        return (shard.copy(),
+                fullm[rank * chunk:(rank + 1) * chunk].copy())
+
+
+def _body_reduce_scatter_uneven(rank, world, port):
+    with Communicator("127.0.0.1", port, rank, world) as comm:
+        try:
+            comm.reduce_scatter(np.zeros(world + 1, np.float32))
+        except ValueError:
+            # all ranks must still agree the collective never started
+            comm.barrier()
+            return "rejected"
+        return "accepted"
+
+
 class TestNativeCollectives:
     def test_library_builds(self):
         assert build_native_library().exists()
@@ -216,3 +258,43 @@ class TestNativeCollectives:
         with Communicator(world_size=1) as comm:
             with pytest.raises(TypeError):
                 comm.allreduce(np.ones(4, np.int32))
+
+    @pytest.mark.parametrize("dtype_name", ["f32", "f64", "bf16"])
+    def test_reduce_scatter_chunks_equal_allreduce_slices(self, dtype_name):
+        """The sharded-update wire contract at every supported dtype:
+        rank r's reduce-scatter chunk is BITWISE its slice of the full
+        allreduce (the C++ ring reuses the allreduce accumulation
+        order)."""
+        world = 4
+        results = _run_ranks(_body_reduce_scatter, world, PORT + 10,
+                             extra=(dtype_name,))
+        for rank in range(world):
+            shard, ref = results[rank]
+            assert shard.shape == (16,)
+            np.testing.assert_array_equal(shard, ref)
+
+    def test_reduce_scatter_mean_matches_allreduce_mean(self):
+        world = 2
+        results = _run_ranks(_body_reduce_scatter_mean, world, PORT + 11)
+        for rank in range(world):
+            shard, ref = results[rank]
+            np.testing.assert_array_equal(shard, ref)
+
+    def test_reduce_scatter_single_rank_identity(self):
+        with Communicator(world_size=1) as comm:
+            data = np.arange(6, dtype=np.float32)
+            out = comm.reduce_scatter(data.copy())
+            np.testing.assert_array_equal(out, data)
+
+    def test_reduce_scatter_rejects_uneven_count(self):
+        """count % world != 0 is a caller bug (the Python layer pads to
+        equal shards before hitting the wire) - every rank rejects it
+        without starting the collective."""
+        world = 2
+        results = _run_ranks(_body_reduce_scatter_uneven, world, PORT + 12)
+        assert all(v == "rejected" for v in results.values())
+
+    def test_reduce_scatter_rejects_unsupported_dtype(self):
+        with Communicator(world_size=1) as comm:
+            with pytest.raises(TypeError):
+                comm.reduce_scatter(np.ones(4, np.int32))
