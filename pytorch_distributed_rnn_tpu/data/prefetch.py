@@ -64,7 +64,7 @@ class _Channel:
         self.available.release()
 
 
-def _produce(source, chan: _Channel):
+def _produce(source, chan: _Channel, stage):
     try:
         while True:
             # poll the token so an abandoned consumer (stopped with a
@@ -76,6 +76,12 @@ def _produce(source, chan: _Channel):
                 return
             try:
                 item = next(source)
+                # staging runs HERE, on the producer thread, inside the
+                # same try: a stage failure (device OOM, bad transfer)
+                # re-raises at the consumer's position like any other
+                # producer-side error
+                if stage is not None:
+                    item = stage(item)
             except StopIteration:
                 chan.emit(_Done)
                 return
@@ -95,27 +101,37 @@ def _produce(source, chan: _Channel):
                 pass
 
 
-def prefetch(iterable: Iterable[T], depth: int = 2) -> "PrefetchIterator[T]":
+def prefetch(
+    iterable: Iterable[T], depth: int = 2, stage=None
+) -> "PrefetchIterator[T]":
     """Yield from ``iterable`` in order, pulling up to ``depth`` items
     ahead on a producer thread.
 
     When the consumer holds item ``i``, items up to ``i+depth`` have
     already been pulled from the source (and, for device batches, their
     uploads dispatched).  ``depth`` must be >= 1.
+
+    ``stage`` (optional) is applied to every item ON THE PRODUCER
+    THREAD before it enters the channel - the device-staging hook:
+    ``training/base.py`` passes a blocking ``jax.device_put`` so each
+    batch's H2D transfer completes off the consumer's critical path and
+    ``__next__`` hands back device-resident buffers.  A ``stage``
+    exception propagates to the consumer at that item's position, same
+    as a source error.
     """
     if depth < 1:
         raise ValueError(f"prefetch depth must be >= 1, got {depth}")
-    return PrefetchIterator(iterable, depth)
+    return PrefetchIterator(iterable, depth, stage)
 
 
 class PrefetchIterator(Generic[T]):
     """Iterator over a producer-thread-fed bounded channel."""
 
-    def __init__(self, iterable: Iterable[T], depth: int):
+    def __init__(self, iterable: Iterable[T], depth: int, stage=None):
         self._chan = _Channel(depth)
         self._closed = False
         self._thread = threading.Thread(
-            target=_produce, args=(iter(iterable), self._chan),
+            target=_produce, args=(iter(iterable), self._chan, stage),
             name="pdrnn-prefetch", daemon=True,
         )
         self._thread.start()

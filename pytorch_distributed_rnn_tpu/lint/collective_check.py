@@ -51,7 +51,17 @@ GATED_ENTRIES = (
     "dp.spmd_epoch_fn_sharded",
     "native_ddp.apply_update",
     "native_ddp.apply_update_sharded",
+    "native_ddp.apply_update_bucketed",
 )
+
+# the checked-in bucketed wire-shape binding (training/native_ddp.py
+# overlapped path): the motion model's 662 params on the world-2 lint
+# convention, bucket_mb small enough that the plan holds >1 bucket -
+# the same binding the native_ddp.apply_update_bucketed trace entry
+# registers.  f32 wire -> itemsize 4.
+NATIVE_WIRE_CONFIG = {
+    "size": 662, "world": 2, "itemsize": 4, "bucket_mb": 1e-3,
+}
 
 # sharded entry -> its replicated twin (for the bytes-drop relation)
 SHARDED_TO_REPLICATED = {
@@ -130,6 +140,16 @@ def check(entries: dict, expectations: dict, mesh_n: int = 2) -> list[str]:
                 "update-phase bytes did not drop as sharding promises"
             )
 
+    problems += check_native_wire(expectations)
+    bucketed_native = entries.get("native_ddp.apply_update_bucketed")
+    if bucketed_native and bucketed_native["collectives"]:
+        problems.append(
+            "native_ddp.apply_update_bucketed: traced collectives "
+            f"{json.dumps(bucketed_native['collectives'])} - the per-"
+            "bucket update program must stay collective-free (the "
+            "bucketed reduce-scatter/allgather ride the host ring's "
+            "comm worker)"
+        )
     sh_native = entries.get("native_ddp.apply_update_sharded")
     rep_native = entries.get("native_ddp.apply_update")
     if sh_native and rep_native:
@@ -150,7 +170,62 @@ def check(entries: dict, expectations: dict, mesh_n: int = 2) -> list[str]:
     return problems
 
 
+def check_native_wire(expectations: dict) -> list[str]:
+    """The bucketed native-ring wire contract: the checked-in per-bucket
+    reduce-scatter/allgather byte counts must (a) match the plan
+    recomputed fresh from the stored config and (b) SUM to exactly the
+    monolithic collective's bytes - overlap must never change the wire
+    traffic.  The sum is checked against the STORED numbers, so a
+    tampered bucket row fails even before the plan comparison does."""
+    from pytorch_distributed_rnn_tpu.parallel.bucketing import plan_buckets
+
+    wire = expectations.get("native_wire")
+    if wire is None:
+        return ["native_wire: section missing from expectations - the "
+                "bucketed wire contract is ungated (regenerate with "
+                "collective_check --write)"]
+    problems = []
+    cfg = wire.get("config", {})
+    stored_rs = sum(
+        b.get("reduce_scatter_bytes", 0) for b in wire.get("buckets", [])
+    )
+    stored_ag = sum(
+        b.get("allgather_bytes", 0) for b in wire.get("buckets", [])
+    )
+    mono = wire.get("monolithic", {})
+    if stored_rs != mono.get("reduce_scatter_bytes"):
+        problems.append(
+            f"native_wire: per-bucket reduce-scatter bytes sum to "
+            f"{stored_rs}, monolithic is {mono.get('reduce_scatter_bytes')}"
+            " - bucketing changed the gradient wire traffic"
+        )
+    if stored_ag != mono.get("allgather_bytes"):
+        problems.append(
+            f"native_wire: per-bucket allgather bytes sum to {stored_ag}, "
+            f"monolithic is {mono.get('allgather_bytes')} - bucketing "
+            "changed the param wire traffic"
+        )
+    try:
+        plan = plan_buckets(cfg["size"], cfg["world"], cfg["itemsize"],
+                            cfg["bucket_mb"])
+    except (KeyError, ValueError) as exc:
+        problems.append(f"native_wire: unreplayable config {cfg}: {exc}")
+        return problems
+    fresh = plan.wire_expectations()
+    if wire != fresh:
+        problems.append(
+            "native_wire: stored bucket layout drifted from the plan "
+            "recomputed from its own config\n"
+            f"  expected: {json.dumps(fresh, sort_keys=True)}\n"
+            f"  got:      {json.dumps(wire, sort_keys=True)}\n"
+            "  (intentional? regenerate with collective_check --write)"
+        )
+    return problems
+
+
 def write_expectations(entries: dict, path=EXPECTATIONS_PATH) -> None:
+    from pytorch_distributed_rnn_tpu.parallel.bucketing import plan_buckets
+
     payload = {
         "comment": "checked-in per-entry collective traffic for the "
                    "pure-DP entries; regenerate with "
@@ -161,6 +236,8 @@ def write_expectations(entries: dict, path=EXPECTATIONS_PATH) -> None:
             name: {"collectives": entries[name]["collectives"]}
             for name in GATED_ENTRIES if name in entries
         },
+        "native_wire": plan_buckets(**NATIVE_WIRE_CONFIG)
+        .wire_expectations(),
     }
     Path(path).write_text(json.dumps(payload, indent=2, sort_keys=True)
                           + "\n")

@@ -49,6 +49,11 @@ _SUMMARY_FIELDS = (
     ("step_s_p50", "{:.6f}"),
     ("step_s_p95", "{:.6f}"),
     ("data_wait_frac", "{:.4f}"),
+    # overlapped gradient communication (None and skipped on runs whose
+    # step fn publishes no comm telemetry)
+    ("comm_wait_s", "{:.6f}"),
+    ("comm_wait_s_mean", "{:.6f}"),
+    ("overlap_frac", "{:.4f}"),
     ("collective_bytes_per_step", "{:,d}"),
     # phase split: gradient = all-reduce; update = reduce-scatter +
     # all-gather (the sharded weight update's ~2x drop shows up here)

@@ -34,9 +34,13 @@ kind                payload
 meta                schema, sample_every, argv? - always the FIRST line;
                     its (t, tm) pair is the rank's wall<->monotonic anchor
 step                step, epoch, loss, dispatch_s, data_wait_s,
-                    fenced_s (sampled steps only); tm is the step's
-                    dispatch START (overridden by the trainer), so the
-                    timeline can synthesize the per-step sub-spans
+                    fenced_s (sampled steps only); comm_wait_s +
+                    overlap_frac when the strategy runs host
+                    collectives (native ring - wall blocked in
+                    collectives, and the wire-time share hidden behind
+                    compute); tm is the step's dispatch START
+                    (overridden by the trainer), so the timeline can
+                    synthesize the per-step sub-spans
 epoch               epoch, steps, loss, acc, wall_s, path (scan|step|host)
 eval                epoch (null = test), loss, acc
 collectives         ops {hlo-op: {count, bytes}}, bytes_per_step - traced

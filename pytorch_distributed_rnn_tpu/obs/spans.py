@@ -56,6 +56,10 @@ SUBSYSTEM_TIDS = {
     # streaming actor/learner lane: experience pushes, params refreshes,
     # staleness rejections (streaming/actor.py + streaming/learner.py)
     "actor": 12,
+    # host-collective lane: per-bucket reduce_scatter/allgather spans of
+    # the overlapped native-ring step (training/native_ddp.py) - stacked
+    # against the train lane they show comm riding under compute
+    "comm": 13,
 }
 
 

@@ -161,6 +161,20 @@ def summarize_events(events: list[dict], path=None) -> dict:
         float(e["fenced_s"]) for e in timed if e.get("fenced_s") is not None
     )
     data_wait = [float(e.get("data_wait_s", 0.0)) for e in steps]
+    # per-step host-collective telemetry (native ring): comm_wait_s is
+    # the wall the host sat blocked in collectives, overlap_frac the
+    # share of wire time hidden behind compute.  None-not-0: strategies
+    # without host collectives never carry the fields.  Same warm-up
+    # exclusion as the timing stats - the first step's waits include
+    # compile-skewed scheduling.
+    comm_wait = [
+        float(e["comm_wait_s"]) for e in timed
+        if e.get("comm_wait_s") is not None
+    ]
+    overlap = [
+        float(e["overlap_frac"]) for e in timed
+        if e.get("overlap_frac") is not None
+    ]
     losses = [float(e["loss"]) for e in steps if e.get("loss") is not None]
     if not losses:
         losses = [float(e["loss"]) for e in epochs if e.get("loss") is not None]
@@ -193,6 +207,10 @@ def summarize_events(events: list[dict], path=None) -> dict:
         "data_wait_s": wait_total,
         "data_wait_frac": (wait_total / denom)
         if denom == denom and denom > 0 else None,
+        "comm_wait_s": sum(comm_wait) if comm_wait else None,
+        "comm_wait_s_mean": (sum(comm_wait) / len(comm_wait))
+        if comm_wait else None,
+        "overlap_frac": (sum(overlap) / len(overlap)) if overlap else None,
         "collective_bytes_per_step": (
             collectives.get("bytes_per_step") if collectives else None
         ),
@@ -329,6 +347,11 @@ def summarize_run(path) -> list[dict]:
 REGRESSION_METRICS = (
     "step_s_mean", "step_s_p95", "duration_s", "memory_mb",
     "device_peak_mb", "data_wait_frac",
+    # host-collective blocked wall (native ring): overlap regressions -
+    # a schedule change that re-serializes comm behind compute - show up
+    # here before they dent step_s_mean.  overlap_frac is deliberately
+    # NOT listed: bigger is better, the wait metric already covers it.
+    "comm_wait_s", "comm_wait_s_mean",
     "collective_grad_bytes_per_step", "collective_update_bytes_per_step",
 )
 

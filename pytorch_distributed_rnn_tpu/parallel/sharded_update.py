@@ -241,6 +241,71 @@ class ShardedUpdate:
                 out.append(leaf)
         return jax.tree.unflatten(treedef, out)
 
+    # -- bucketed overlap (native ring only) ---------------------------------
+    #
+    # Buckets partition THIS RANK's shard range [0, shard) - see
+    # parallel/bucketing.py for why that (and not a contiguous split of
+    # the padded vector) keeps the ring's per-element accumulation order,
+    # and therefore the update, bitwise-identical to the monolithic path.
+    # Optimizer state in bucketed mode is a LIST of per-bucket states
+    # (each bucket's apply runs once per step, so scalar counters like
+    # adam's `count` advance identically in every bucket); checkpoints
+    # still carry the standard unsharded layout via merge -> gather.
+
+    def bucket_plan(self, bucket_mb: float, itemsize: int | None = None):
+        """The rank-shard bucket layout for this binding; ``itemsize``
+        is the WIRE dtype's (what rides TCP - may differ from the param
+        ravel dtype when the ring does not support it)."""
+        from pytorch_distributed_rnn_tpu.parallel.bucketing import plan_buckets
+
+        return plan_buckets(
+            self.size, self.world,
+            int(itemsize) if itemsize else np.dtype(self.dtype).itemsize,
+            bucket_mb,
+        )
+
+    def _is_bucket_vector(self, leaf, blen: int) -> bool:
+        return getattr(leaf, "ndim", 0) == 1 and leaf.shape[0] == blen
+
+    def init_bucket_opt_state(self, params, rank: int, plan):
+        """Per-bucket slices of the rank's shard optimizer state."""
+        flat, _ = ravel_pytree(params)
+        p_shard = self.shard_slice(self.pad_flat(np.asarray(flat)), rank)
+        return [
+            self.optimizer.init(jnp.asarray(p_shard[lo:hi]))
+            for lo, hi in plan.bounds
+        ]
+
+    def merge_bucket_opt_state(self, bucket_states, plan):
+        """Per-bucket states -> the rank's shard-layout state (vector
+        leaves concatenated in bucket order = shard order; scalar leaves
+        taken from bucket 0 - identical across buckets by construction).
+        Feeds :meth:`gather_opt_state` at checkpoint time."""
+        leaves0, treedef = jax.tree.flatten(bucket_states[0])
+        all_leaves = [jax.tree.flatten(s)[0] for s in bucket_states]
+        out = []
+        for i, leaf in enumerate(leaves0):
+            if self._is_bucket_vector(leaf, plan.bucket_len(0)):
+                out.append(jnp.concatenate([
+                    jnp.asarray(all_leaves[b][i])
+                    for b in range(len(bucket_states))
+                ]))
+            else:
+                out.append(leaf)
+        return jax.tree.unflatten(treedef, out)
+
+    def split_shard_opt_state(self, shard_state, plan):
+        """The rank's shard-layout state -> per-bucket list (the bucketed
+        resume path, after :meth:`shard_opt_state`)."""
+        leaves, treedef = jax.tree.flatten(shard_state)
+        return [
+            jax.tree.unflatten(treedef, [
+                jnp.asarray(l)[lo:hi] if self._is_shard_vector(l) else l
+                for l in leaves
+            ])
+            for lo, hi in plan.bounds
+        ]
+
     def shard_opt_state(self, std_state, rank: int):
         """Standard layout -> rank's shard-layout state (native resume)."""
         struct = jax.eval_shape(

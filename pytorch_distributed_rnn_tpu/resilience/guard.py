@@ -64,6 +64,13 @@ class NonFiniteGuard:
     def check(self, opt_state):
         """Inspect the ``ApplyIfFiniteState`` counters; raise
         :class:`NonFiniteAbort` past the consecutive limit."""
+        if isinstance(opt_state, list):
+            # bucketed native-ring state: a LIST (never a tuple - optax
+            # states are NamedTuples) of one wrapped state per gradient
+            # bucket, all fed the SAME global skip verdict (the poison
+            # broadcast), so every bucket's counters are identical -
+            # bucket 0 speaks for the step
+            opt_state = opt_state[0]
         consecutive = int(opt_state.notfinite_count)
         total = int(opt_state.total_notfinite)
         if total > self.total_skipped:

@@ -27,12 +27,17 @@
 // Exposed as a plain C ABI for ctypes (no pybind11 in this image).
 
 #include <arpa/inet.h>
+#include <algorithm>
 #include <cerrno>
 #include <chrono>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <deque>
+#include <memory>
+#include <mutex>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -42,6 +47,7 @@
 #include <sys/socket.h>
 #include <thread>
 #include <unistd.h>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -52,6 +58,26 @@ constexpr double kRtoPenaltyMs = 200; // simulated retransmit timeout
 // this magic so the master's acceptor can reject stray connections
 // (port scanners, half-open dials) instead of installing them as peers
 constexpr int32_t kElasticMagic = 0x70647273;  // 'pdrs'
+// pipeline segment for the ring legs: the incoming chunk is received in
+// segments of this many bytes so accumulate of segment i overlaps the
+// wire time of segment i+1 (adjacent-chunk overlap within a ring step)
+constexpr size_t kPipelineBytes = 256 * 1024;
+
+// One queued collective for the persistent comm worker.  Buffers are
+// borrowed from the caller, which must keep them alive until the job is
+// waited (the Python layer parks them on the handle object).
+struct CollJob {
+  int type = 0;  // 0 = allreduce, 1 = reduce_scatter, 2 = allgather
+  void* data = nullptr;
+  int64_t count = 0;
+  int dtype = 0;
+  int op = 0;
+  void* out = nullptr;
+  int64_t nbytes = 0;
+  int status = -1;
+  double seconds = 0.0;  // exclusive execution time on the worker
+  bool done = false;
+};
 
 struct Comm {
   int rank = 0;
@@ -62,6 +88,33 @@ struct Comm {
   double loss_prob = 0.0;
   std::mt19937 rng{12345};
   std::string error;
+
+  // persistent sender leg: replaces the former per-ring-step
+  // std::thread spawn.  Driven only by the collective worker, so a
+  // single pending-send slot suffices.
+  std::thread send_thread;
+  std::mutex send_mu;
+  std::condition_variable send_cv;
+  bool send_stop = false;
+  bool send_pending = false;
+  bool send_done = false;
+  bool send_ok = false;
+  int send_fd = -1;
+  const void* send_buf = nullptr;
+  size_t send_len = 0;
+
+  // persistent collective worker: runs queued collectives FIFO so every
+  // rank executes them in the same (program) order and async handles
+  // stay matched across the ring.
+  std::thread coll_thread;
+  std::mutex coll_mu;
+  std::condition_variable coll_cv;       // wakes the worker
+  std::condition_variable coll_done_cv;  // wakes waiters
+  bool coll_stop = false;
+  int64_t next_handle = 1;
+  std::deque<int64_t> coll_queue;
+  std::unordered_map<int64_t, std::shared_ptr<CollJob>> coll_jobs;
+  int threads_created = 0;  // lifetime total; stays <= 2 by construction
 };
 
 void set_sockopts(int fd) {
@@ -106,6 +159,49 @@ bool recv_all(int fd, void* buf, size_t n) {
     n -= static_cast<size_t>(got);
   }
   return true;
+}
+
+// -- persistent sender worker ------------------------------------------------
+//
+// The ring legs used to spawn a std::thread per step purely to run the
+// send concurrently with the recv.  The loop below is that thread made
+// persistent: post_send hands it one (fd, buf, len), wait_send blocks
+// until the transfer finished.  Every post_send MUST be paired with a
+// wait_send before the next post (the ring code always joins the leg
+// even on recv failure, exactly like the old sender.join()).
+
+void sender_loop(Comm* c) {
+  std::unique_lock<std::mutex> lk(c->send_mu);
+  for (;;) {
+    c->send_cv.wait(lk, [c] { return c->send_stop || c->send_pending; });
+    if (c->send_stop) return;
+    const int fd = c->send_fd;
+    const void* buf = c->send_buf;
+    const size_t len = c->send_len;
+    c->send_pending = false;
+    lk.unlock();
+    const bool ok = send_all(c, fd, buf, len);
+    lk.lock();
+    c->send_ok = ok;
+    c->send_done = true;
+    c->send_cv.notify_all();
+  }
+}
+
+void post_send(Comm* c, int fd, const void* buf, size_t len) {
+  std::lock_guard<std::mutex> lk(c->send_mu);
+  c->send_fd = fd;
+  c->send_buf = buf;
+  c->send_len = len;
+  c->send_pending = true;
+  c->send_done = false;
+  c->send_cv.notify_all();
+}
+
+bool wait_send(Comm* c) {
+  std::unique_lock<std::mutex> lk(c->send_mu);
+  c->send_cv.wait(lk, [c] { return c->send_done; });
+  return c->send_ok;
 }
 
 int make_listener(uint16_t* port_inout) {
@@ -494,8 +590,28 @@ Comm* pdrnn_init_listener(int port, int capacity) {
 
 namespace {
 
+// Receive an incoming ring chunk in pipeline segments, accumulating
+// each segment while later segments are still on the wire.  Element
+// order within the chunk is unchanged (ascending, same adds as a
+// recv-then-accumulate), so the reduction stays bitwise identical.
+template <typename T>
+bool recv_accumulate(Comm* c, int fd, T* dst, int64_t n, T* inbox) {
+  (void)c;
+  const int64_t seg =
+      std::max<int64_t>(1, static_cast<int64_t>(kPipelineBytes / sizeof(T)));
+  for (int64_t off = 0; off < n; off += seg) {
+    const int64_t m = std::min(seg, n - off);
+    if (!recv_all(fd, inbox + off, static_cast<size_t>(m) * sizeof(T)))
+      return false;
+    Elem<T>::accumulate(dst + off, inbox + off, m);
+  }
+  return true;
+}
+
 // Ring allreduce (reduce-scatter then allgather), generic over the wire
-// element type.  op: 0 = sum, 1 = mean.
+// element type.  op: 0 = sum, 1 = mean.  Runs on the persistent
+// collective worker; the send leg rides the persistent sender thread
+// (post_send/wait_send) instead of a per-step std::thread.
 template <typename T>
 int ring_allreduce(Comm* c, T* data, int64_t count, int op) {
   const int world = c->world;
@@ -518,31 +634,24 @@ int ring_allreduce(Comm* c, T* data, int64_t count, int op) {
   for (int step = 0; step < world - 1; ++step) {
     const int send_idx = (c->rank - step + world) % world;
     const int recv_idx = (c->rank - step - 1 + world) % world;
-    bool ok_send = false;
-    std::thread sender([&] {
-      ok_send = send_all(c, c->peer_fd[next], data + begin[send_idx],
-                         chunk_len(send_idx) * sizeof(T));
-    });
-    bool ok_recv = recv_all(c->peer_fd[prev], inbox.data(),
-                            chunk_len(recv_idx) * sizeof(T));
-    sender.join();
+    post_send(c, c->peer_fd[next], data + begin[send_idx],
+              chunk_len(send_idx) * sizeof(T));
+    const bool ok_recv = recv_accumulate(c, c->peer_fd[prev],
+                                         data + begin[recv_idx],
+                                         chunk_len(recv_idx), inbox.data());
+    const bool ok_send = wait_send(c);
     if (!ok_send || !ok_recv) return -1;
-    Elem<T>::accumulate(data + begin[recv_idx], inbox.data(),
-                        chunk_len(recv_idx));
   }
 
   // allgather: circulate the reduced chunks
   for (int step = 0; step < world - 1; ++step) {
     const int send_idx = (c->rank + 1 - step + world) % world;
     const int recv_idx = (c->rank - step + world) % world;
-    bool ok_send = false;
-    std::thread sender([&] {
-      ok_send = send_all(c, c->peer_fd[next], data + begin[send_idx],
-                         chunk_len(send_idx) * sizeof(T));
-    });
-    bool ok_recv = recv_all(c->peer_fd[prev], data + begin[recv_idx],
-                            chunk_len(recv_idx) * sizeof(T));
-    sender.join();
+    post_send(c, c->peer_fd[next], data + begin[send_idx],
+              chunk_len(send_idx) * sizeof(T));
+    const bool ok_recv = recv_all(c->peer_fd[prev], data + begin[recv_idx],
+                                  chunk_len(recv_idx) * sizeof(T));
+    const bool ok_send = wait_send(c);
     if (!ok_send || !ok_recv) return -1;
   }
 
@@ -577,49 +686,231 @@ int ring_reduce_scatter(Comm* c, T* data, int64_t count, int op, T* out) {
   for (int step = 0; step < world - 1; ++step) {
     const int send_idx = (c->rank - step + world) % world;
     const int recv_idx = (c->rank - step - 1 + world) % world;
-    bool ok_send = false;
-    std::thread sender([&] {
-      ok_send = send_all(c, c->peer_fd[next], data + send_idx * shard,
-                         static_cast<size_t>(shard) * sizeof(T));
-    });
-    bool ok_recv = recv_all(c->peer_fd[prev], inbox.data(),
-                            static_cast<size_t>(shard) * sizeof(T));
-    sender.join();
+    post_send(c, c->peer_fd[next], data + send_idx * shard,
+              static_cast<size_t>(shard) * sizeof(T));
+    const bool ok_recv = recv_accumulate(c, c->peer_fd[prev],
+                                         data + recv_idx * shard, shard,
+                                         inbox.data());
+    const bool ok_send = wait_send(c);
     if (!ok_send || !ok_recv) return -1;
-    Elem<T>::accumulate(data + recv_idx * shard, inbox.data(), shard);
   }
 
   // rotation hop: rank r holds reduced chunk (r+1) mod world; sending it
   // to `next` delivers chunk r to every rank directly into `out`
   const int held = (c->rank + 1) % world;
-  bool ok_send = false;
-  std::thread sender([&] {
-    ok_send = send_all(c, c->peer_fd[next], data + held * shard,
-                       static_cast<size_t>(shard) * sizeof(T));
-  });
-  bool ok_recv = recv_all(c->peer_fd[prev], out,
-                          static_cast<size_t>(shard) * sizeof(T));
-  sender.join();
+  post_send(c, c->peer_fd[next], data + held * shard,
+            static_cast<size_t>(shard) * sizeof(T));
+  const bool ok_recv = recv_all(c->peer_fd[prev], out,
+                                static_cast<size_t>(shard) * sizeof(T));
+  const bool ok_send = wait_send(c);
   if (!ok_send || !ok_recv) return -1;
   if (op == 1) Elem<T>::scale(out, shard, 1.0 / world);
   return 0;
+}
+
+// Allgather ring body (formerly pdrnn_allgather): output must hold
+// world * nbytes; rank r's contribution lands at slot r.
+int allgather_core(Comm* c, const void* input, int64_t nbytes, void* output) {
+  char* out = static_cast<char*>(output);
+  std::memcpy(out + c->rank * nbytes, input, static_cast<size_t>(nbytes));
+  if (c->world == 1) return 0;
+  const int next = (c->rank + 1) % c->world;
+  const int prev = (c->rank - 1 + c->world) % c->world;
+  for (int step = 0; step < c->world - 1; ++step) {
+    const int send_idx = (c->rank - step + c->world) % c->world;
+    const int recv_idx = (c->rank - step - 1 + c->world) % c->world;
+    post_send(c, c->peer_fd[next], out + send_idx * nbytes,
+              static_cast<size_t>(nbytes));
+    const bool ok_recv = recv_all(c->peer_fd[prev], out + recv_idx * nbytes,
+                                  static_cast<size_t>(nbytes));
+    const bool ok_send = wait_send(c);
+    if (!ok_send || !ok_recv) return -1;
+  }
+  return 0;
+}
+
+// -- persistent collective worker --------------------------------------------
+//
+// Collectives (sync AND async) are queued FIFO onto one worker thread
+// per communicator.  Every rank enqueues in identical program order, so
+// collective k on rank A always meets collective k on rank B even when
+// several async handles are outstanding.  wait() unblocks as soon as
+// its own job finishes while later jobs keep streaming - that gap is
+// the overlap the bucketed trainer exploits.
+
+int run_job(Comm* c, CollJob& j) {
+  switch (j.type) {
+    case 0:  // allreduce
+      switch (j.dtype) {
+        case 0:
+          return ring_allreduce(c, static_cast<float*>(j.data), j.count, j.op);
+        case 1:
+          return ring_allreduce(c, static_cast<double*>(j.data), j.count,
+                                j.op);
+        case 2:
+          return ring_allreduce(c, static_cast<Bf16*>(j.data), j.count, j.op);
+      }
+      return -1;
+    case 1:  // reduce_scatter
+      switch (j.dtype) {
+        case 0:
+          return ring_reduce_scatter(c, static_cast<float*>(j.data), j.count,
+                                     j.op, static_cast<float*>(j.out));
+        case 1:
+          return ring_reduce_scatter(c, static_cast<double*>(j.data), j.count,
+                                     j.op, static_cast<double*>(j.out));
+        case 2:
+          return ring_reduce_scatter(c, static_cast<Bf16*>(j.data), j.count,
+                                     j.op, static_cast<Bf16*>(j.out));
+      }
+      return -1;
+    case 2:  // allgather
+      return allgather_core(c, j.data, j.nbytes, j.out);
+  }
+  return -1;
+}
+
+void coll_loop(Comm* c) {
+  std::unique_lock<std::mutex> lk(c->coll_mu);
+  for (;;) {
+    c->coll_cv.wait(lk, [c] { return c->coll_stop || !c->coll_queue.empty(); });
+    if (c->coll_stop) {
+      // fail whatever is still queued so waiters unblock
+      for (int64_t id : c->coll_queue) {
+        auto it = c->coll_jobs.find(id);
+        if (it != c->coll_jobs.end()) {
+          it->second->status = -1;
+          it->second->done = true;
+        }
+      }
+      c->coll_queue.clear();
+      c->coll_done_cv.notify_all();
+      return;
+    }
+    const int64_t id = c->coll_queue.front();
+    c->coll_queue.pop_front();
+    auto job = c->coll_jobs[id];
+    lk.unlock();
+    const auto t0 = std::chrono::steady_clock::now();
+    const int status = run_job(c, *job);
+    const double secs =
+        std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
+            .count();
+    lk.lock();
+    job->status = status;
+    job->seconds = secs;
+    job->done = true;
+    c->coll_done_cv.notify_all();
+  }
+}
+
+void ensure_workers(Comm* c) {
+  std::lock_guard<std::mutex> lk(c->coll_mu);
+  if (!c->coll_thread.joinable()) {
+    c->threads_created += 2;
+    c->send_thread = std::thread(sender_loop, c);
+    c->coll_thread = std::thread(coll_loop, c);
+  }
+}
+
+int64_t enqueue_job(Comm* c, std::shared_ptr<CollJob> job) {
+  if (c->world == 1) {
+    // single-rank collectives are memcpy-only: run inline and park the
+    // completed job for wait() - no worker threads needed, ever
+    const auto t0 = std::chrono::steady_clock::now();
+    job->status = run_job(c, *job);
+    job->seconds =
+        std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
+            .count();
+    job->done = true;
+    std::lock_guard<std::mutex> lk(c->coll_mu);
+    const int64_t id = c->next_handle++;
+    c->coll_jobs.emplace(id, std::move(job));
+    return id;
+  }
+  ensure_workers(c);
+  std::lock_guard<std::mutex> lk(c->coll_mu);
+  const int64_t id = c->next_handle++;
+  c->coll_jobs.emplace(id, std::move(job));
+  c->coll_queue.push_back(id);
+  c->coll_cv.notify_all();
+  return id;
+}
+
+int wait_job(Comm* c, int64_t id, double* seconds_out) {
+  std::unique_lock<std::mutex> lk(c->coll_mu);
+  auto it = c->coll_jobs.find(id);
+  if (it == c->coll_jobs.end()) return -1;
+  auto job = it->second;
+  c->coll_done_cv.wait(lk, [&] { return job->done; });
+  if (seconds_out) *seconds_out = job->seconds;
+  const int status = job->status;
+  c->coll_jobs.erase(id);
+  return status;
 }
 
 }  // namespace
 
 extern "C" {
 
-// dtype: 0 = f32, 1 = f64, 2 = bf16 (raw uint16 bits).
+// Nonblocking collectives: enqueue onto the persistent comm worker and
+// return a handle immediately.  pdrnn_wait blocks until that handle's
+// job completed, writes its exclusive worker-execution time (seconds)
+// into `seconds_out` when non-null, and returns the job status.  The
+// caller owns the buffers until the wait returns.
+
+int64_t pdrnn_allreduce_async(Comm* c, void* data, int64_t count, int dtype,
+                              int op) {
+  auto job = std::make_shared<CollJob>();
+  job->type = 0;
+  job->data = data;
+  job->count = count;
+  job->dtype = dtype;
+  job->op = op;
+  return enqueue_job(c, std::move(job));
+}
+
+int64_t pdrnn_reduce_scatter_async(Comm* c, void* data, int64_t count,
+                                   int dtype, int op, void* output) {
+  auto job = std::make_shared<CollJob>();
+  job->type = 1;
+  job->data = data;
+  job->count = count;
+  job->dtype = dtype;
+  job->op = op;
+  job->out = output;
+  return enqueue_job(c, std::move(job));
+}
+
+int64_t pdrnn_allgather_async(Comm* c, const void* input, int64_t nbytes,
+                              void* output) {
+  auto job = std::make_shared<CollJob>();
+  job->type = 2;
+  job->data = const_cast<void*>(input);
+  job->nbytes = nbytes;
+  job->out = output;
+  return enqueue_job(c, std::move(job));
+}
+
+int pdrnn_wait(Comm* c, int64_t handle, double* seconds_out) {
+  return wait_job(c, handle, seconds_out);
+}
+
+// Lifetime count of worker threads this communicator ever created:
+// 0 before the first world>1 collective, then exactly 2 (sender +
+// collective worker) forever - the no-thread-spawn-per-step regression
+// pin reads this.
+int pdrnn_thread_count(Comm* c) {
+  std::lock_guard<std::mutex> lk(c->coll_mu);
+  return c->threads_created;
+}
+
+// dtype: 0 = f32, 1 = f64, 2 = bf16 (raw uint16 bits).  Synchronous
+// collectives are enqueue+wait on the same worker queue, so they stay
+// ordered with any outstanding async handles.
 int pdrnn_allreduce(Comm* c, void* data, int64_t count, int dtype, int op) {
-  switch (dtype) {
-    case 0:
-      return ring_allreduce(c, static_cast<float*>(data), count, op);
-    case 1:
-      return ring_allreduce(c, static_cast<double*>(data), count, op);
-    case 2:
-      return ring_allreduce(c, static_cast<Bf16*>(data), count, op);
-  }
-  return -1;
+  return wait_job(c, pdrnn_allreduce_async(c, data, count, dtype, op),
+                  nullptr);
 }
 
 // kept for ABI stability with existing callers
@@ -632,41 +923,13 @@ int pdrnn_allreduce_f32(Comm* c, float* data, int64_t count, int op) {
 // dtype/op codes as pdrnn_allreduce.
 int pdrnn_reduce_scatter(Comm* c, void* data, int64_t count, int dtype,
                          int op, void* output) {
-  switch (dtype) {
-    case 0:
-      return ring_reduce_scatter(c, static_cast<float*>(data), count, op,
-                                 static_cast<float*>(output));
-    case 1:
-      return ring_reduce_scatter(c, static_cast<double*>(data), count, op,
-                                 static_cast<double*>(output));
-    case 2:
-      return ring_reduce_scatter(c, static_cast<Bf16*>(data), count, op,
-                                 static_cast<Bf16*>(output));
-  }
-  return -1;
+  return wait_job(
+      c, pdrnn_reduce_scatter_async(c, data, count, dtype, op, output),
+      nullptr);
 }
 
 int pdrnn_allgather(Comm* c, const void* input, int64_t nbytes, void* output) {
-  // output must hold world * nbytes; rank r's contribution lands at slot r.
-  char* out = static_cast<char*>(output);
-  std::memcpy(out + c->rank * nbytes, input, static_cast<size_t>(nbytes));
-  if (c->world == 1) return 0;
-  const int next = (c->rank + 1) % c->world;
-  const int prev = (c->rank - 1 + c->world) % c->world;
-  for (int step = 0; step < c->world - 1; ++step) {
-    const int send_idx = (c->rank - step + c->world) % c->world;
-    const int recv_idx = (c->rank - step - 1 + c->world) % c->world;
-    bool ok_send = false;
-    std::thread sender([&] {
-      ok_send = send_all(c, c->peer_fd[next], out + send_idx * nbytes,
-                         static_cast<size_t>(nbytes));
-    });
-    bool ok_recv = recv_all(c->peer_fd[prev], out + recv_idx * nbytes,
-                            static_cast<size_t>(nbytes));
-    sender.join();
-    if (!ok_send || !ok_recv) return -1;
-  }
-  return 0;
+  return wait_job(c, pdrnn_allgather_async(c, input, nbytes, output), nullptr);
 }
 
 int pdrnn_barrier(Comm* c) {
@@ -677,6 +940,18 @@ int pdrnn_barrier(Comm* c) {
 
 void pdrnn_destroy(Comm* c) {
   if (!c) return;
+  {
+    std::lock_guard<std::mutex> lk(c->coll_mu);
+    c->coll_stop = true;
+    c->coll_cv.notify_all();
+  }
+  if (c->coll_thread.joinable()) c->coll_thread.join();
+  {
+    std::lock_guard<std::mutex> lk(c->send_mu);
+    c->send_stop = true;
+    c->send_cv.notify_all();
+  }
+  if (c->send_thread.joinable()) c->send_thread.join();
   for (int fd : c->peer_fd)
     if (fd >= 0) close(fd);
   if (c->listen_fd >= 0) close(c->listen_fd);

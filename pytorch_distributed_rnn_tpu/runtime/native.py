@@ -145,9 +145,64 @@ def _load():
         ctypes.c_void_p,
     ]
     lib.pdrnn_barrier.argtypes = [ctypes.c_void_p]
+    lib.pdrnn_reduce_scatter_async.restype = ctypes.c_int64
+    lib.pdrnn_reduce_scatter_async.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_void_p,
+    ]
+    lib.pdrnn_allgather_async.restype = ctypes.c_int64
+    lib.pdrnn_allgather_async.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_void_p,
+    ]
+    lib.pdrnn_allreduce_async.restype = ctypes.c_int64
+    lib.pdrnn_allreduce_async.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_int,
+        ctypes.c_int,
+    ]
+    lib.pdrnn_wait.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_double),
+    ]
+    lib.pdrnn_thread_count.argtypes = [ctypes.c_void_p]
     lib.pdrnn_destroy.argtypes = [ctypes.c_void_p]
     _lib = lib
     return lib
+
+
+class CollectiveHandle:
+    """Nonblocking-collective handle from :meth:`Communicator.reduce_scatter_async`
+    / :meth:`Communicator.allgather_async`.
+
+    Holds the wire buffers alive while the persistent comm worker runs the
+    collective (the C side borrows the pointers), plus bookkeeping the
+    overlap telemetry reads after :meth:`Communicator.wait`:
+
+    - ``result``    - the output array (valid only after wait)
+    - ``comm_seconds`` - the collective's exclusive execution time on the
+      comm worker (what the wire cost WOULD be with zero overlap); set by
+      wait from the C-side job clock.
+    """
+
+    __slots__ = ("id", "op", "result", "comm_seconds", "_keepalive", "_done")
+
+    def __init__(self, handle_id: int, op: str, result, keepalive):
+        self.id = handle_id
+        self.op = op
+        self.result = result
+        self.comm_seconds = 0.0
+        self._keepalive = keepalive
+        self._done = False
 
 
 class Communicator:
@@ -344,6 +399,73 @@ class Communicator:
             "allgather",
         )
         return out
+
+    # -- nonblocking collectives --------------------------------------------
+    #
+    # Collectives (sync and async) run FIFO on one persistent comm worker
+    # per communicator, so async handles stay matched across ranks as
+    # long as every rank posts them in the same program order.  wait()
+    # blocks only until ITS job finished; later queued collectives keep
+    # streaming - the overlap the bucketed gradient path exploits.
+
+    def reduce_scatter_async(
+        self, array: np.ndarray, op: str = "sum"
+    ) -> CollectiveHandle:
+        """Nonblocking :meth:`reduce_scatter`.  Returns a handle whose
+        ``result`` (this rank's reduced chunk) is valid after
+        :meth:`wait`.  Same dtype/divisibility contract and the same
+        bitwise accumulation order as the blocking form."""
+        dtype_code = _ALLREDUCE_DTYPES.get(array.dtype.name)
+        if dtype_code is None:
+            raise TypeError(
+                f"reduce_scatter supports {sorted(_ALLREDUCE_DTYPES)}, "
+                f"got {array.dtype.name}"
+            )
+        if array.size % self.world_size:
+            raise ValueError(
+                f"reduce_scatter needs size % world == 0, got "
+                f"{array.size} % {self.world_size}"
+            )
+        scratch = np.ascontiguousarray(array).reshape(-1).copy()
+        out = np.empty(array.size // self.world_size, dtype=array.dtype)
+        handle_id = self._lib.pdrnn_reduce_scatter_async(
+            self._handle, scratch.ctypes.data, scratch.size,
+            dtype_code, {"sum": 0, "mean": 1}[op], out.ctypes.data,
+        )
+        return CollectiveHandle(handle_id, "reduce_scatter", out, scratch)
+
+    def allgather_async(self, array: np.ndarray) -> CollectiveHandle:
+        """Nonblocking :meth:`allgather`; ``result`` has shape
+        ``(world,) + array.shape`` after :meth:`wait`."""
+        array = np.ascontiguousarray(array)
+        out = np.empty((self.world_size,) + array.shape, dtype=array.dtype)
+        handle_id = self._lib.pdrnn_allgather_async(
+            self._handle, array.ctypes.data, array.nbytes, out.ctypes.data
+        )
+        return CollectiveHandle(handle_id, "allgather", out, array)
+
+    def wait(self, handle: CollectiveHandle) -> np.ndarray:
+        """Block until ``handle``'s collective completed; returns its
+        result array.  Idempotent: waiting a finished handle returns the
+        cached result.  ``handle.comm_seconds`` is filled with the job's
+        exclusive execution time on the comm worker."""
+        if not handle._done:
+            seconds = ctypes.c_double(0.0)
+            status = self._lib.pdrnn_wait(
+                self._handle, handle.id, ctypes.byref(seconds)
+            )
+            handle.comm_seconds = float(seconds.value)
+            handle._done = True
+            handle._keepalive = None
+            self._check(status, handle.op)
+        return handle.result
+
+    def thread_count(self) -> int:
+        """Lifetime count of worker threads the native library created
+        for this communicator: 0 until the first world>1 collective,
+        exactly 2 from then on (persistent sender + collective worker).
+        The no-thread-spawn-per-step regression test pins this."""
+        return int(self._lib.pdrnn_thread_count(self._handle))
 
     def barrier(self):
         self._check(self._lib.pdrnn_barrier(self._handle), "barrier")

@@ -246,6 +246,10 @@ class Trainer:
         # run-relative optimizer-step counter - the address space for the
         # fault schedule's step triggers
         self._steps_done = 0
+        # (comm_wait_s, comm_active_s) published by the step fn that just
+        # ran, or None when the strategy has no per-step host collectives;
+        # the host loop rides it through the step event
+        self._last_step_comm = None
 
     # -- subclass hooks ------------------------------------------------------
 
@@ -1045,6 +1049,24 @@ class Trainer:
     # the consuming step (data/prefetch.py - the torch-DataLoader-worker
     # analogue: the next batch's async H2D upload overlaps this step)
     PREFETCH_DEPTH = 2
+    # device-staged prefetch: the producer thread device_put()s each
+    # prepared batch and blocks until the H2D copy lands, so next()
+    # hands the consumer device-resident buffers and no step pays the
+    # transfer inline (torch DataLoader pin_memory + non_blocking
+    # analogue).  Subclass escape hatch for strategies whose batches
+    # must stay host-side
+    DEVICE_STAGED_PREFETCH = True
+
+    def _prefetch_stage(self):
+        """Producer-side staging callable for the host-path prefetch, or
+        None to hand batches through untouched."""
+        if not self.DEVICE_STAGED_PREFETCH:
+            return None
+
+        def stage(batch):
+            return jax.block_until_ready(jax.device_put(batch))
+
+        return stage
 
     def _train_epoch_host(self, formatter):
         """Materialized-batch loop (used when the strategy must act on
@@ -1080,7 +1102,8 @@ class Trainer:
 
         recording = self.recorder.enabled
         t_epoch = time.perf_counter()
-        stream = prefetch(source(), depth=self.PREFETCH_DEPTH)
+        stream = prefetch(source(), depth=self.PREFETCH_DEPTH,
+                          stage=self._prefetch_stage())
         # device-scalar accumulators, fetched after the loop: the
         # programs' loss/metrics outputs are replicated over the
         # (possibly multi-process) mesh, so a post-loop fetch is legal on
@@ -1114,10 +1137,15 @@ class Trainer:
                 if self._profile is not None:
                     self._profile.on_step_start(step)
                 t0 = time.perf_counter()
+                # step fns with host collectives publish this step's
+                # (comm_wait_s, comm_active_s) here; reset first so a
+                # skipped publish can't replay the previous step's
+                self._last_step_comm = None
                 self.params, self.opt_state, loss, metrics = self._train_step_fn(
                     self.params, self.opt_state, batch, *extra
                 )
                 dispatch_s = time.perf_counter() - t0
+                step_comm = self._last_step_comm
                 fenced_s = None
                 if recording and self.recorder.is_sample_step(step):
                     _fence(loss)
@@ -1150,7 +1178,8 @@ class Trainer:
                     losses.append(loss)
                     corrects.append(metrics["correct"])
                 if recording:
-                    raw.append((step, t0, dispatch_s, fenced_s, data_wait_s))
+                    raw.append((step, t0, dispatch_s, fenced_s, data_wait_s,
+                                step_comm))
                 batch_idx += 1
         finally:
             # an early exit (injected exception, guard abort) must not
@@ -1163,13 +1192,27 @@ class Trainer:
             # step events emitted after the loop: the float() fetches are
             # the epoch-end fetch the uninstrumented path already pays.
             # tm = the step's dispatch start (see the device path above)
-            for (step, t0, dispatch_s, fenced_s, data_wait_s), loss_v in zip(
-                raw, losses
-            ):
+            for (step, t0, dispatch_s, fenced_s, data_wait_s,
+                 step_comm), loss_v in zip(raw, losses):
+                extra_fields = {}
+                if step_comm is not None:
+                    # None-not-0 convention: strategies without host
+                    # collectives simply omit the comm fields
+                    wait_s, active_s = step_comm
+                    extra_fields["comm_wait_s"] = wait_s
+                    # 1 - wait/active: the fraction of the step's wire
+                    # time the host did NOT sit blocked for (0 for fully
+                    # synchronous collectives); meaningless when the
+                    # collectives cost ~nothing, absent when active is 0
+                    if active_s > 0:
+                        extra_fields["overlap_frac"] = max(
+                            0.0, 1.0 - wait_s / active_s
+                        )
                 self.recorder.record(
                     "step", step=step, epoch=self._epoch,
                     loss=float(loss_v), dispatch_s=dispatch_s,
                     data_wait_s=data_wait_s, fenced_s=fenced_s, tm=t0,
+                    **extra_fields,
                 )
         # parity quirk kept: sum of batch-mean losses / dataset size
         train_loss = total_loss / len(self.training_set)

@@ -418,6 +418,48 @@ class TestCollectiveGate:
         drifted.write_text(json.dumps(tampered))
         assert collective_check.main([str(drifted)]) == 1
 
+    def test_bucketed_entry_gated_and_collective_free(self):
+        result = run_lint([PACKAGE], root=REPO_ROOT,
+                          baseline=load_baseline(BASELINE), deep=True)
+        by_name = {e["entry"]: e for e in result.deep["entries"]}
+        # the overlapped per-bucket update program: registered, traced,
+        # and collective-free (the ring rides the host comm worker)
+        assert by_name["native_ddp.apply_update_bucketed"]["collectives"] \
+            == {}
+
+    def test_native_wire_sum_invariant_tamper_fails(self, tmp_path):
+        """The bucketed wire contract: the checked-in per-bucket bytes
+        must sum EXACTLY to the monolithic collective's - editing any
+        bucket row (or the monolithic total) fails the gate, and
+        check_native_wire names the sum violation."""
+        from pytorch_distributed_rnn_tpu.lint.collective_check import (
+            EXPECTATIONS_PATH,
+            check_native_wire,
+        )
+
+        expectations = json.loads(EXPECTATIONS_PATH.read_text())
+        # the shipped file passes, and genuinely holds >1 bucket
+        assert check_native_wire(expectations) == []
+        assert len(expectations["native_wire"]["buckets"]) > 1
+
+        tampered = json.loads(EXPECTATIONS_PATH.read_text())
+        tampered["native_wire"]["buckets"][0]["reduce_scatter_bytes"] += 4
+        problems = check_native_wire(tampered)
+        assert any("sum to" in p for p in problems)
+
+        # consistent-but-wrong tamper (bucket AND monolithic edited
+        # together) still fails: the plan replayed from the stored
+        # config is the ground truth
+        tampered = json.loads(EXPECTATIONS_PATH.read_text())
+        tampered["native_wire"]["buckets"][0]["reduce_scatter_bytes"] += 8
+        tampered["native_wire"]["monolithic"]["reduce_scatter_bytes"] += 8
+        problems = check_native_wire(tampered)
+        assert any("drifted from the plan" in p for p in problems)
+
+        # a missing section is itself a finding (the contract cannot be
+        # silently un-gated)
+        assert check_native_wire({}) != []
+
 
 class TestDeepFindingPlumbing:
     """Deep findings ride the shared reporting path: fingerprints,

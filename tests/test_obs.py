@@ -308,6 +308,78 @@ class TestTrainerTelemetry:
         assert summary["step_s_mean"] > 0
         assert summary["data_wait_frac"] is not None
 
+    def test_native_run_emits_comm_telemetry_and_spans(self, train_set,
+                                                       tmp_path):
+        """A native-ring run with the recorder on: every step event
+        carries comm_wait_s + overlap_frac, sampled steps additionally
+        get per-collective cat="comm" spans, and the summary folds both
+        into comm_wait_s / overlap_frac fields."""
+        from pytorch_distributed_rnn_tpu.runtime.native import Communicator
+        from pytorch_distributed_rnn_tpu.training.native_ddp import (
+            NativeDDPTrainer,
+        )
+
+        path = tmp_path / "m.jsonl"
+        rec = MetricsRecorder(path, sample_every=2)
+        comm = Communicator(master_port=29765, rank=0, world_size=1)
+        NativeDDPTrainer(
+            comm=comm, model=small_model(), training_set=train_set,
+            batch_size=24, learning_rate=2.5e-3, seed=SEED, recorder=rec,
+            sharded_update=True, bucketed_comm=True, bucket_mb=1e-3,
+        ).train(epochs=2)
+        rec.close()
+
+        events = load_events(path)
+        steps = [e for e in events if e["kind"] == "step"]
+        assert steps
+        assert all(e.get("comm_wait_s") is not None and
+                   e["comm_wait_s"] >= 0 for e in steps)
+        assert all(0.0 <= e["overlap_frac"] <= 1.0 for e in steps
+                   if e.get("overlap_frac") is not None)
+        comm_spans = [e for e in events
+                      if e["kind"] == "span" and e.get("cat") == "comm"]
+        assert comm_spans, "sampled steps must emit comm spans"
+        assert {e["name"] for e in comm_spans} \
+            <= {"reduce_scatter", "allgather", "allreduce"}
+        # every comm span carries its bucket + wire bytes
+        rs = [e for e in comm_spans if e["name"] == "reduce_scatter"]
+        assert rs and all(e["bytes"] > 0 and e["bucket"] >= 0 for e in rs)
+        # only SAMPLED steps emit spans (the zero-overhead contract)
+        sampled = {e["step"] for e in comm_spans}
+        assert all(rec.is_sample_step(s) for s in sampled)
+
+        summary = summarize_file(path)
+        assert summary["comm_wait_s"] is not None
+        assert summary["comm_wait_s"] >= 0
+        assert summary["comm_wait_s_mean"] is not None
+        assert summary["overlap_frac"] is not None
+
+    def test_summary_comm_fields_none_when_absent(self, tmp_path):
+        """None-not-0: strategies without host collectives (the synthetic
+        sidecar above) report comm fields as None, so pdrnn-metrics diff
+        can never flag a no-comm baseline."""
+        out = _write_metrics(tmp_path / "m.jsonl")
+        summary = summarize_file(out)
+        assert summary["comm_wait_s"] is None
+        assert summary["comm_wait_s_mean"] is None
+        assert summary["overlap_frac"] is None
+
+    def test_diff_gates_comm_wait(self):
+        from pytorch_distributed_rnn_tpu.obs.summary import diff_summaries
+
+        base = {"comm_wait_s": 1.0, "comm_wait_s_mean": 0.01}
+        worse = {"comm_wait_s": 2.0, "comm_wait_s_mean": 0.02}
+        metrics = {r["metric"] for r in diff_summaries(base, worse)}
+        assert {"comm_wait_s", "comm_wait_s_mean"} <= metrics
+        # overlap_frac is bigger-is-better and must NOT be a diff metric
+        from pytorch_distributed_rnn_tpu.obs.summary import (
+            REGRESSION_METRICS,
+        )
+
+        assert "overlap_frac" not in REGRESSION_METRICS
+        # absent on either side -> skipped, never a false regression
+        assert diff_summaries({}, worse) == []
+
     def test_checkpoint_events(self, train_set, tmp_path):
         path = tmp_path / "m.jsonl"
         rec = MetricsRecorder(path)

@@ -76,6 +76,59 @@ class TestPrefetch:
             next(stream)
 
 
+class TestStaging:
+    """The device-staging hook: ``stage`` runs on the PRODUCER thread
+    (training/base.py passes a blocking device_put so H2D leaves the
+    consumer's critical path), preserves order, and fails like a source
+    error."""
+
+    def test_stage_applied_in_order(self):
+        out = list(prefetch(iter(range(5)), depth=2, stage=lambda x: x * 10))
+        assert out == [0, 10, 20, 30, 40]
+
+    def test_stage_runs_on_producer_thread(self):
+        threads = []
+
+        def stage(item):
+            threads.append(threading.current_thread().name)
+            return item
+
+        assert list(prefetch(iter(range(3)), depth=2, stage=stage)) \
+            == [0, 1, 2]
+        assert threads and all(n == "pdrnn-prefetch" for n in threads)
+
+    def test_stage_exception_propagates_at_item_position(self):
+        def stage(item):
+            if item == 2:
+                raise RuntimeError("stage blew up")
+            return item
+
+        stream = prefetch(iter(range(5)), depth=2, stage=stage)
+        assert next(stream) == 0
+        assert next(stream) == 1
+        with pytest.raises(RuntimeError, match="stage blew up"):
+            next(stream)
+        # the failed stream is latched closed, and the thread joins
+        stream.close()
+        assert _wait_until(_no_prefetch_threads)
+
+    def test_device_put_stage_yields_committed_arrays(self):
+        """The trainer's actual stage callable: batches come out as
+        device-committed jax arrays, values untouched."""
+        batches = [(np.ones((2, 3), np.float32) * i,
+                    np.arange(2, dtype=np.int32)) for i in range(3)]
+
+        def stage(batch):
+            return jax.block_until_ready(jax.device_put(batch))
+
+        for i, (f, l) in enumerate(prefetch(iter(batches), depth=2,
+                                            stage=stage)):
+            assert isinstance(f, jax.Array) and isinstance(l, jax.Array)
+            np.testing.assert_array_equal(np.asarray(f),
+                                          batches[i][0])
+            np.testing.assert_array_equal(np.asarray(l), batches[i][1])
+
+
 class TestProducerLifecycle:
     """The chaos-robustness contract: early-exiting consumers must not
     leak the producer thread; producer failures must surface in the

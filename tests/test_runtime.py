@@ -298,3 +298,91 @@ class TestNativeCollectives:
         with Communicator(world_size=1) as comm:
             with pytest.raises(TypeError):
                 comm.reduce_scatter(np.ones(4, np.int32))
+
+
+# -- nonblocking handles (the overlapped bucketed-comm transport) ------------
+
+def _body_async_parity(rank, world, port, dtype_name):
+    """Async reduce_scatter/allgather vs their sync twins, with MANY
+    handles outstanding at once: results must be bitwise identical (one
+    FIFO comm worker executes both flavors in program order)."""
+    import ml_dtypes
+
+    dtype = dict(f32=np.float32, f64=np.float64,
+                 bf16=ml_dtypes.bfloat16)[dtype_name]
+    with Communicator("127.0.0.1", port, rank, world) as comm:
+        rng = np.random.default_rng(17 + rank)
+        # odd per-bucket sizes incl. the 1-element-per-rank degenerate
+        lens = [1, 3, 16, 5]
+        datas = [rng.standard_normal(n * world).astype(dtype) for n in lens]
+        sync_rs = [comm.reduce_scatter(d.copy()) for d in datas]
+        sync_ag = [comm.allgather(s.copy()) for s in sync_rs]
+        # now the same traffic as outstanding handles, all posted first
+        rs_handles = [comm.reduce_scatter_async(d.copy()) for d in datas]
+        rs_out = [comm.wait(h) for h in rs_handles]
+        ag_handles = [comm.allgather_async(s.copy()) for s in rs_out]
+        ag_out = [comm.wait(h) for h in ag_handles]
+        # wait() is idempotent: a second wait returns the same buffer
+        again = comm.wait(rs_handles[0])
+        assert again is rs_out[0]
+        assert all(h.comm_seconds >= 0.0 for h in rs_handles + ag_handles)
+        return (
+            [np.asarray(a, np.float64) for a in sync_rs],
+            [np.asarray(a, np.float64) for a in sync_ag],
+            [np.asarray(a, np.float64) for a in rs_out],
+            [np.asarray(a, np.float64) for a in ag_out],
+            comm.thread_count(),
+        )
+
+
+def _body_thread_count_pin(rank, world, port):
+    """Satellite regression pin: the ring must NOT spawn a thread per
+    ring step / per collective - one persistent sender + one collective
+    worker for the communicator's whole life, no matter how many
+    collectives (sync or async) run."""
+    with Communicator("127.0.0.1", port, rank, world) as comm:
+        data = np.ones(8 * world, np.float32)
+        for _ in range(10):
+            comm.allreduce(data.copy())
+            comm.reduce_scatter(data.copy())
+            h = comm.allgather_async(np.ones(3, np.float32))
+            comm.wait(h)
+        return comm.thread_count()
+
+
+class TestAsyncCollectives:
+    @pytest.mark.parametrize("dtype_name", ["f32", "f64", "bf16"])
+    def test_async_matches_sync_bitwise(self, dtype_name):
+        world = 4
+        results = _run_ranks(_body_async_parity, world, PORT + 13,
+                             extra=(dtype_name,))
+        for rank in range(world):
+            sync_rs, sync_ag, rs_out, ag_out, threads = results[rank]
+            for a, b in zip(sync_rs, rs_out, strict=True):
+                np.testing.assert_array_equal(a, b)
+            for a, b in zip(sync_ag, ag_out, strict=True):
+                np.testing.assert_array_equal(a, b)
+            # 24 collectives ran; exactly the two persistent workers
+            assert threads == 2
+
+    def test_no_thread_spawn_per_step(self):
+        world = 2
+        results = _run_ranks(_body_thread_count_pin, world, PORT + 14)
+        assert all(v == 2 for v in results.values())
+
+    def test_single_rank_async_inline_no_threads(self):
+        """World-1 short-circuits collectives inline: the async API still
+        works (handles resolve immediately) and no worker threads are
+        ever created."""
+        with Communicator(world_size=1) as comm:
+            data = np.arange(6, dtype=np.float32)
+            h = comm.reduce_scatter_async(data.copy())
+            np.testing.assert_array_equal(comm.wait(h), data)
+            g = comm.allgather_async(data.copy())
+            np.testing.assert_array_equal(comm.wait(g), data[None])
+            assert comm.thread_count() == 0
+
+    def test_async_rejects_bad_inputs_before_posting(self):
+        with Communicator(world_size=1) as comm:
+            with pytest.raises(TypeError):
+                comm.reduce_scatter_async(np.ones(4, np.int32))

@@ -421,6 +421,39 @@ class TestChromeExport:
         )
         assert thread["args"]["name"] == "train"
 
+    def test_comm_spans_land_on_their_own_lane(self, tmp_path):
+        """The bucketed step's per-collective spans (cat="comm",
+        training/native_ddp.py) get a dedicated subsystem row - stacked
+        under the step they overlap, not folded into the train lane."""
+        out = _write_rank_sidecar(tmp_path / "m.jsonl", 0, steps=2)
+        with open(out, "a") as f:
+            f.write(json.dumps({
+                "kind": "span", "name": "reduce_scatter", "cat": "comm",
+                "t": 1000.001, "tm": 0.001, "rank": 0, "dur_s": 0.003,
+                "step": 0, "bucket": 0, "bytes": 1048,
+            }) + "\n")
+            f.write(json.dumps({
+                "kind": "span", "name": "allgather", "cat": "comm",
+                "t": 1000.005, "tm": 0.005, "rank": 0, "dur_s": 0.002,
+                "step": 0, "bucket": 0, "bytes": 524,
+            }) + "\n")
+        trace = build_chrome_trace(load_run(tmp_path / "m.jsonl"))
+        validate_chrome_trace(trace)
+        from pytorch_distributed_rnn_tpu.obs.spans import SUBSYSTEM_TIDS
+
+        comm = [
+            e for e in trace["traceEvents"]
+            if e.get("name") in ("reduce_scatter", "allgather")
+        ]
+        assert len(comm) == 2
+        assert all(e["tid"] == SUBSYSTEM_TIDS["comm"] for e in comm)
+        thread = next(
+            e for e in trace["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "thread_name"
+            and e["tid"] == SUBSYSTEM_TIDS["comm"]
+        )
+        assert thread["args"]["name"] == "comm"
+
     def test_cli_timeline_writes_default_path(self, tmp_path, capsys):
         path = tmp_path / "m.jsonl"
         for r in range(2):
