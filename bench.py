@@ -255,7 +255,8 @@ def native_bucketed_ab_row(epochs: int = 2, delay_ms: int = 2):
             s = summarize_file(metrics)
             row[key] = {k: s.get(k) for k in (
                 "step_s_mean", "comm_wait_s", "comm_wait_s_mean",
-                "overlap_frac")}
+                "overlap_frac", "goodput", "comm_wait_frac",
+                "fault_tax_s")}
     b, m = row["bucketed"], row["monolithic"]
     if b.get("comm_wait_s") and m.get("comm_wait_s"):
         # < 1.0 is the overlap actually paying for itself on the wire
@@ -264,6 +265,48 @@ def native_bucketed_ab_row(epochs: int = 2, delay_ms: int = 2):
     if b.get("step_s_mean") and m.get("step_s_mean"):
         row["step_s_ratio"] = round(
             b["step_s_mean"] / m["step_s_mean"], 3)
+    return row
+
+
+def motion_ledger_row(epochs: int = 3):
+    """Efficiency-ledger excerpt (obs/ledger.py) for an instrumented
+    motion-LSTM run: the headline workload re-run with a metrics sidecar,
+    then priced - goodput, analytic MFU vs this backend's peak (the
+    run-side peak block labels CPU estimates), comm-wait fraction and
+    fault tax.  This is the banked evidence row the regression gate and
+    the chaos drills compare against."""
+    import tempfile
+
+    from pytorch_distributed_rnn_tpu.data import MotionDataset
+    from pytorch_distributed_rnn_tpu.data.synthetic import generate_har_arrays
+    from pytorch_distributed_rnn_tpu.models import MotionModel
+    from pytorch_distributed_rnn_tpu.obs.ledger import ledger_run
+    from pytorch_distributed_rnn_tpu.obs.recorder import MetricsRecorder
+    from pytorch_distributed_rnn_tpu.training import Trainer
+
+    X, y = generate_har_arrays(NUM_SEQUENCES, SEQ_LEN, NUM_FEATURES, seed=0)
+    train_set = MotionDataset(X, y)
+    with tempfile.TemporaryDirectory(prefix="pdrnn-bench-ledger-") as tmp:
+        metrics = Path(tmp) / "metrics.jsonl"
+        recorder = MetricsRecorder(metrics)
+        try:
+            trainer = Trainer(
+                MotionModel(input_dim=NUM_FEATURES, hidden_dim=32,
+                            layer_dim=2, output_dim=6),
+                train_set, batch_size=BATCH_SIZE, learning_rate=0.0025,
+                seed=SEED, recorder=recorder,
+            )
+            trainer.train(epochs=epochs)
+        finally:
+            recorder.close()
+        agg = ledger_run(metrics)["aggregate"]
+    row = {k: agg.get(k) for k in (
+        "goodput", "mfu_est", "fault_tax_s", "comm_wait_frac",
+        "recompiles")}
+    row["fractions"] = {
+        k: round(v, 4) for k, v in agg["fractions"].items()}
+    if agg.get("peak_estimated"):
+        row["peak_estimated"] = True
     return row
 
 
@@ -815,6 +858,11 @@ def main():
             return curve
 
         attempt("motion_batch_curve_seq_per_sec", _batch_curve)
+
+        # the efficiency-ledger evidence row (ISSUE 15): the headline
+        # workload instrumented and priced - goodput, analytic MFU,
+        # fault tax, comm-wait fraction off its own sidecar
+        attempt("motion_efficiency_ledger", motion_ledger_row)
 
         # sharded-vs-replicated weight update on the dp mesh
         # (2004.13336); off-chip the row self-skips below 2 devices

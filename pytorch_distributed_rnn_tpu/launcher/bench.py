@@ -371,7 +371,26 @@ def execute_run(config: RunConfig, timeout: float | None = None,
         entry["metrics_path"] = metrics_path
         _append_run_span(metrics_path, config, start_wall, duration,
                          returncode)
+        ledger = _ledger_excerpt(metrics_path)
+        if ledger is not None:
+            entry["ledger"] = ledger
     return entry
+
+
+def _ledger_excerpt(metrics_path) -> dict | None:
+    """The archived efficiency-ledger block of one run entry: the four
+    headline numbers (obs/ledger.py aggregate), so sweep results carry
+    goodput/MFU/fault-tax evidence without re-reading sidecars.  Best
+    effort - schema-1 or absent sidecars archive nothing, never fail
+    the sweep."""
+    try:
+        from pytorch_distributed_rnn_tpu.obs.ledger import ledger_run
+
+        agg = ledger_run(metrics_path)["aggregate"]
+        return {k: agg.get(k) for k in (
+            "goodput", "mfu_est", "fault_tax_s", "comm_wait_frac")}
+    except Exception:
+        return None
 
 
 def _append_run_span(metrics_path, config: RunConfig, start_wall: float,

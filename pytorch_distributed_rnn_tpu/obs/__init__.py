@@ -10,6 +10,12 @@
   per-rank liveness (``rank_health``).
 - :mod:`.timeline`: cross-rank clock alignment, Chrome-trace/Perfetto
   export + validator, phase attribution.
+- :mod:`.flops`: analytic per-step FLOP/byte counts off abstract
+  jaxprs (no data, no compile) - the efficiency ledger's MFU numerator.
+- :mod:`.ledger`: the efficiency ledger - exhaustive wall-clock phase
+  accounting (fractions sum to 1), goodput, MFU/HFU vs the
+  ``utils/hw.py`` peak table, fault tax, and the
+  ``ledger_history.jsonl`` + ``pdrnn-metrics regress`` cross-run gate.
 - :mod:`.live`: the live plane - rolling windows, digest exporter (no
   thread of its own: rides the recorder's writer thread), and the
   per-process ``LivePlane`` wiring (``--live`` / ``PDRNN_LIVE``).
@@ -36,6 +42,22 @@ from pytorch_distributed_rnn_tpu.obs.live import (
     LiveExporter,
     LivePlane,
     RollingWindow,
+)
+from pytorch_distributed_rnn_tpu.obs.flops import (
+    closed_jaxpr_flop_stats,
+    entry_flop_report,
+    trace_flop_stats,
+)
+from pytorch_distributed_rnn_tpu.obs.ledger import (
+    FRACTION_TOL,
+    LEDGER_PHASES,
+    append_history,
+    check_history,
+    history_record,
+    ledger_events,
+    ledger_file,
+    ledger_run,
+    load_history,
 )
 from pytorch_distributed_rnn_tpu.obs.profile import StepTraceCapture
 from pytorch_distributed_rnn_tpu.obs.recorder import (
@@ -95,15 +117,27 @@ __all__ = [
     "dump_stacks",
     "install_stack_dump_handler",
     "render_prometheus",
+    "FRACTION_TOL",
+    "LEDGER_PHASES",
+    "append_history",
     "attribute_rank",
     "attribute_run",
     "attribute_stragglers",
     "build_chrome_trace",
+    "check_history",
+    "closed_jaxpr_flop_stats",
     "detect_stragglers",
     "diff_summaries",
+    "entry_flop_report",
     "estimate_clock_offsets",
+    "history_record",
+    "ledger_events",
+    "ledger_file",
+    "ledger_run",
     "load_events",
+    "load_history",
     "load_run",
+    "trace_flop_stats",
     "rank_files",
     "rank_health",
     "rank_suffixed",

@@ -38,6 +38,8 @@ pdrnn_step_seconds_mean                         gauge        window
 pdrnn_loss                                      gauge        window
 pdrnn_data_wait_seconds_mean                    gauge        window
 pdrnn_queue_depth                               gauge        window
+pdrnn_goodput                                   gauge        window
+pdrnn_mfu                                       gauge        window
 pdrnn_nan_skips_total                           counter      digest
 pdrnn_faults_total{action=...}                  counter      digest
 pdrnn_alerts_total                              counter      digest
@@ -380,6 +382,10 @@ class Aggregator:
                 digest.get("data_wait_s_mean"))
             depth = digest.get("queue_depth") or {}
             add("pdrnn_queue_depth", labels, depth.get("last"))
+            # efficiency-ledger live gauges (obs/ledger.py is the
+            # post-hoc source of truth; these are windowed estimates)
+            add("pdrnn_goodput", labels, digest.get("goodput_60s"))
+            add("pdrnn_mfu", labels, digest.get("mfu_60s"))
             add("pdrnn_nan_skips_total", labels,
                 digest.get("nan_skips_total"), "counter")
             for action, count in (digest.get("faults_total") or {}).items():

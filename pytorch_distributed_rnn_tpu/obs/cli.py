@@ -1,13 +1,13 @@
 """``pdrnn-metrics``: summarize / diff / stragglers / timeline /
-attribute / health over metrics sidecars.
+attribute / health / ledger / regress over metrics sidecars.
 
 Exit-code contract (pinned by tests and used as a CI gate):
 
 - ``0`` clean (summary/trace/table printed; no regression; no
   straggler; every rank healthy)
-- ``1`` signal found (``diff``: a regression past the threshold;
-  ``stragglers``/``attribute``: a rank past the spread threshold;
-  ``health``: a stalled or dead rank)
+- ``1`` signal found (``diff``/``regress``: a regression past the
+  threshold; ``stragglers``/``attribute``: a rank past the spread
+  threshold; ``health``: a stalled or dead rank)
 - ``2`` malformed input (unreadable file, bad JSONL, schema drift,
   or a sidecar too old for the requested view)
 
@@ -20,6 +20,8 @@ Examples::
   pdrnn-metrics attribute metrics.jsonl    # phase fractions + blame
   pdrnn-metrics health metrics.jsonl --stale-after 30
   pdrnn-metrics watch 127.0.0.1:9100       # live fleet table (aggregator)
+  pdrnn-metrics ledger metrics.jsonl --history ledger_history.jsonl
+  pdrnn-metrics regress ledger_history.jsonl --threshold 0.2
 """
 
 from __future__ import annotations
@@ -78,6 +80,14 @@ _SUMMARY_FIELDS = (
     ("replayed_microbatches", "{:d}"),
     ("roster", "{}"),
     ("checkpoint_saves", "{:d}"),
+    # efficiency ledger (None and skipped on schema-1 sidecars; the
+    # full phase table lives under `pdrnn-metrics ledger`)
+    ("recompiles", "{:d}"),
+    ("goodput", "{:.4f}"),
+    ("badput_frac", "{:.4f}"),
+    ("fault_tax_s", "{:.6f}"),
+    ("comm_wait_frac", "{:.4f}"),
+    ("mfu_est", "{:.3e}"),
     # serving runs (absent on training sidecars - skipped when None)
     ("requests", "{:d}"),
     ("requests_shed", "{:d}"),
@@ -212,6 +222,38 @@ def main(argv=None) -> int:
                    help="print the raw fleet+events JSON instead of the "
                    "table (implies --once)")
 
+    p = sub.add_parser(
+        "ledger",
+        help="efficiency ledger: classify the run's wall-clock into "
+        "phase fractions (summing to 1), goodput, MFU/HFU vs the "
+        "per-backend peak table (CPU peak is an estimate), and fault "
+        "tax; per-stage ledgers + bubble fraction on MPMD runs, "
+        "actor/learner split on streaming runs",
+    )
+    p.add_argument("files", nargs="+", help="rank-0 sidecar(s); -r<k> "
+                   "siblings are picked up automatically")
+    p.add_argument("--json", action="store_true", help="machine output")
+    p.add_argument("--history", default=None, metavar="PATH",
+                   help="append each run's aggregate to this "
+                   "ledger_history.jsonl (the `regress` gate's input)")
+    p.add_argument("--key", default=None, metavar="KEY",
+                   help="config key for the history record (default: "
+                   "the sidecar's stem)")
+
+    p = sub.add_parser(
+        "regress",
+        help="cross-run regression gate over a ledger_history.jsonl: "
+        "latest run per key vs the median of its predecessors "
+        "(goodput drop, fault-tax / comm-wait fraction rise)",
+    )
+    p.add_argument("history", help="ledger_history.jsonl path")
+    p.add_argument("--threshold", type=float, default=0.2, metavar="FRAC",
+                   help="relative tolerance (default 0.2)")
+    p.add_argument("--floor", type=float, default=0.05, metavar="FRAC",
+                   help="absolute tolerance in fraction points a "
+                   "regression must also clear (default 0.05)")
+    p.add_argument("--json", action="store_true")
+
     args = parser.parse_args(argv)
     try:
         return _dispatch(args)
@@ -259,6 +301,10 @@ def _dispatch(args) -> int:
         return _health(args)
     if args.cmd == "watch":
         return _watch(args)
+    if args.cmd == "ledger":
+        return _ledger(args)
+    if args.cmd == "regress":
+        return _regress(args)
 
     # stragglers
     summaries = [summarize_file(p) for p in _expand_families(args.files)]
@@ -457,6 +503,94 @@ def _watch(args) -> int:
             time.sleep(args.interval)
         except KeyboardInterrupt:  # pragma: no cover - interactive
             return 0
+
+
+def _ledger(args) -> int:
+    from pytorch_distributed_rnn_tpu.obs.ledger import (
+        LEDGER_PHASES,
+        append_history,
+        history_record,
+        ledger_run,
+    )
+
+    runs = [ledger_run(path) for path in args.files]
+    if args.history:
+        for path, run in zip(args.files, runs):
+            key = args.key or Path(path).stem
+            append_history(args.history, history_record(run, key))
+    if args.json:
+        print(json.dumps(runs, indent=1))
+        return 0
+    header = f"{'rank':>5} {'wall_s':>9} " + " ".join(
+        f"{p:>9}" for p in LEDGER_PHASES
+    )
+    for run in runs:
+        print(f"{run['path']}")
+        print(header)
+        for r in run["ranks"]:
+            fr = r["fractions"]
+            label = (f"s{r['stage']}" if r.get("stage") is not None
+                     else str(r["rank"]))
+            print(
+                f"{label:>5} {r['wall_s']:>9.3f} "
+                + " ".join(f"{100 * fr[p]:>8.1f}%" for p in LEDGER_PHASES)
+            )
+        agg = run["aggregate"]
+        mfu = agg["mfu_est"]
+        mfu_txt = "-" if mfu is None else "{:.2e}{}".format(
+            mfu, " (peak estimated)" if agg.get("peak_estimated") else ""
+        )
+        print(
+            f"  goodput {agg['goodput']:.4f}  mfu {mfu_txt}  "
+            f"fault_tax_s {agg['fault_tax_s']:.3f}  "
+            f"comm_wait_frac {agg['comm_wait_frac']:.4f}  "
+            f"recompiles {agg['recompiles']}"
+        )
+        if "mpmd" in run:
+            bubble = run["mpmd"]["bubble_frac"]
+            print(
+                "  pipeline bubble_frac "
+                + ("-" if bubble is None else f"{bubble:.4f}")
+                + " (lower bound; stage steps time link waits too)"
+            )
+        if "streaming" in run:
+            learner = run["streaming"]["learner"] or {}
+            actors = run["streaming"]["actors"]
+            tax = learner.get("reject_tax_s")
+            print(
+                f"  streaming: {actors['count']} actor(s), learner "
+                "reject_tax_s "
+                + ("-" if tax is None else f"{tax:.3f}")
+            )
+    return 0
+
+
+def _regress(args) -> int:
+    from pytorch_distributed_rnn_tpu.obs.ledger import (
+        check_history,
+        load_history,
+    )
+
+    verdict = check_history(
+        load_history(args.history), threshold=args.threshold,
+        floor=args.floor,
+    )
+    if args.json:
+        print(json.dumps(verdict, indent=1))
+    else:
+        if not verdict["regressions"]:
+            print(
+                f"no ledger regression across {verdict['compared']} "
+                f"comparable key(s) of {verdict['keys']} "
+                f"(threshold {args.threshold:g}, floor {args.floor:g})"
+            )
+        for r in verdict["regressions"]:
+            print(
+                f"REGRESSION {r['key']}: {r['metric']} "
+                f"{r['prior_median']:.4f} -> {r['latest']:.4f} "
+                f"({r['delta']:+.4f})"
+            )
+    return 1 if verdict["regressions"] else 0
 
 
 def _health(args) -> int:

@@ -1,0 +1,209 @@
+"""Analytic per-step FLOP and byte accounting from abstract jaxprs.
+
+The efficiency ledger needs a compute numerator that works on any
+backend without running (or even compiling) anything.  This module
+counts arithmetic straight off the traced jaxpr, the same walk
+``evaluation/collectives.py`` uses for per-step collective bytes: visit
+every equation, multiply enclosing ``lax.scan`` trip counts in, recurse
+into sub-jaxprs (pjit bodies, custom_vjp branches), and flag ``while``
+loops - whose trip counts are dynamic - as inexact.
+
+Costing rules (standard MFU conventions):
+
+- ``dot_general``: ``2 * output_elements * contraction_size`` (one
+  multiply + one add per MAC).  This is the term that dominates every
+  LSTM/GRU/dense step in the tree.
+- ``conv_general_dilated``: ``2 * output_elements * kernel_fan_in``.
+- data movement (reshape/transpose/slice/gather/...) and collectives:
+  0 FLOPs here - collective *bytes* are already counted by
+  ``evaluation/collectives.py`` and priced in its bandwidth model.
+- everything else: 1 FLOP per output element (add, mul, tanh, exp, ...
+  - transcendentals deliberately not weighted, which keeps the count a
+  *model* FLOP count comparable across backends, not a hardware
+  op count).
+
+Because the jaxpr of a full train step contains the backward pass, the
+traced total is the *executed* FLOPs (an HFU numerator); without
+rematerialization - none of this repo's step programs remat - it equals
+the model FLOPs (the MFU numerator), and the ledger reports both against
+``utils/hw.py`` peaks.
+"""
+
+from __future__ import annotations
+
+# Primitives that move, reshape, or select data without arithmetic, plus
+# cross-device collectives (bytes counted in evaluation/collectives.py).
+ZERO_FLOP_PRIMS = frozenset({
+    "broadcast_in_dim", "reshape", "transpose", "squeeze", "rev",
+    "slice", "dynamic_slice", "dynamic_update_slice", "concatenate",
+    "pad", "gather", "iota", "copy", "copy_p", "device_put",
+    "convert_element_type", "bitcast_convert_type", "stop_gradient",
+    "split", "expand_dims", "real", "imag",
+    "sharding_constraint", "layout_constraint",
+    # collectives / mesh bookkeeping
+    "psum", "pmax", "pmin", "ppermute", "all_to_all", "all_gather",
+    "reduce_scatter", "axis_index", "pvary",
+})
+
+# Control/structural primitives whose cost lives in their sub-jaxprs.
+_STRUCTURAL_PRIMS = frozenset({
+    "pjit", "jit", "closed_call", "core_call", "xla_call", "remat",
+    "remat2", "checkpoint", "custom_jvp_call", "custom_vjp_call",
+    "custom_vjp_call_jaxpr", "custom_jvp_call_jaxpr", "scan", "while",
+    "cond", "named_call",
+})
+
+
+def _elems(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    if not shape:
+        return 1
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def aval_bytes(aval) -> int:
+    """Bytes of one abstract value (0 for tokens and friends)."""
+    dtype = getattr(aval, "dtype", None)
+    if dtype is None or not hasattr(aval, "shape"):
+        return 0
+    return _elems(aval) * dtype.itemsize
+
+
+def tree_bytes(tree) -> int:
+    """Total bytes across a pytree of arrays/ShapeDtypeStructs."""
+    import jax
+
+    return sum(aval_bytes(leaf) for leaf in jax.tree.leaves(tree))
+
+
+def _dot_general_flops(eqn) -> int:
+    (lhs_contract, _), _ = eqn.params["dimension_numbers"]
+    lhs = eqn.invars[0].aval
+    contraction = 1
+    for d in lhs_contract:
+        contraction *= int(lhs.shape[d])
+    return 2 * _elems(eqn.outvars[0].aval) * contraction
+
+
+def _conv_flops(eqn) -> int:
+    rhs = eqn.invars[1].aval
+    dnums = eqn.params.get("dimension_numbers")
+    out_feature_dim = dnums.rhs_spec[0] if dnums is not None else 0
+    fan_in = _elems(rhs) // max(int(rhs.shape[out_feature_dim]), 1)
+    return 2 * _elems(eqn.outvars[0].aval) * fan_in
+
+
+def closed_jaxpr_flop_stats(closed) -> dict:
+    """FLOPs and boundary bytes of one traced program execution.
+
+    Returns ``{"flops", "by_primitive", "arg_bytes", "out_bytes",
+    "exact"}`` where ``exact`` flips False when a ``while`` body (whose
+    trip count the trace cannot know) was counted once - same honesty
+    marker as the collective walk's ``while-body(unknown-trip-count)``.
+    """
+    jaxpr_cls = type(closed.jaxpr)
+    closed_cls = type(closed)
+    by_prim: dict[str, int] = {}
+    state = {"exact": True}
+
+    def subjaxprs(params):
+        found = []
+
+        def maybe(x):
+            if isinstance(x, closed_cls):
+                found.append(x.jaxpr)
+            elif isinstance(x, jaxpr_cls):
+                found.append(x)
+
+        for value in params.values():
+            maybe(value)
+            if isinstance(value, (tuple, list)):
+                for item in value:
+                    maybe(item)
+        return found
+
+    def visit(jaxpr, mult):
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            sub_mult = mult
+            if name == "scan":
+                sub_mult = mult * int(eqn.params.get("length", 1))
+            elif name == "while":
+                state["exact"] = False
+            subs = subjaxprs(eqn.params)
+            for sub in subs:
+                visit(sub, sub_mult)
+            if name in ZERO_FLOP_PRIMS or name in _STRUCTURAL_PRIMS:
+                continue
+            if subs:
+                # unknown higher-order primitive: its cost was counted
+                # by the recursion above
+                continue
+            if name == "dot_general":
+                flops = _dot_general_flops(eqn)
+            elif name == "conv_general_dilated":
+                flops = _conv_flops(eqn)
+            else:
+                flops = sum(_elems(v.aval) for v in eqn.outvars)
+            if flops:
+                by_prim[name] = by_prim.get(name, 0) + mult * flops
+
+    visit(closed.jaxpr, 1)
+    return {
+        "flops": sum(by_prim.values()),
+        "by_primitive": dict(sorted(
+            by_prim.items(), key=lambda kv: -kv[1])),
+        "arg_bytes": sum(aval_bytes(v.aval) for v in closed.jaxpr.invars),
+        "out_bytes": sum(aval_bytes(v.aval) for v in closed.jaxpr.outvars),
+        "exact": state["exact"],
+    }
+
+
+def trace_flop_stats(fn, *args) -> dict:
+    """:func:`closed_jaxpr_flop_stats` via ``jax.make_jaxpr`` - abstract
+    trace only, no data and no compile."""
+    import jax
+
+    return closed_jaxpr_flop_stats(jax.make_jaxpr(fn)(*args))
+
+
+def entry_flop_report(entries=None, n_devices: int | None = None) -> list:
+    """One FLOP/bytes row per registered abstract trace entry.
+
+    Works over ``lint/trace_registry.py``'s provider modules under a
+    virtual CPU mesh, so the whole registry (trainer families, MPMD
+    stages, streaming) is costed with no data and no compile.  Entries
+    whose mesh needs more devices than the session provides are reported
+    with an ``error`` instead of silently dropped.
+    """
+    from pytorch_distributed_rnn_tpu.lint.trace_registry import (
+        LINT_DEVICE_COUNT,
+        PROVIDER_MODULES,
+        cpu_trace_session,
+        load_entries,
+    )
+
+    n = n_devices or LINT_DEVICE_COUNT
+    rows = []
+    with cpu_trace_session(n):
+        for entry in (entries if entries is not None
+                      else load_entries(PROVIDER_MODULES)):
+            row = {"name": entry.name, "family": entry.family,
+                   "kind": entry.kind}
+            try:
+                fn, args = entry.build()
+                stats = trace_flop_stats(fn, *args)
+            except Exception as exc:  # noqa: BLE001 - report, don't abort
+                row["error"] = f"{type(exc).__name__}: {exc}"
+            else:
+                row.update(
+                    flops_per_call=stats["flops"],
+                    arg_bytes=stats["arg_bytes"],
+                    out_bytes=stats["out_bytes"],
+                    exact=stats["exact"],
+                )
+            rows.append(row)
+    return rows

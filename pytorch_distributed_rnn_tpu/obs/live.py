@@ -45,6 +45,14 @@ step_s              {count, mean, p50, p95, last} over the window
 loss                {last, mean, nonfinite_streak} over the window
 data_wait_s_mean    window mean input-pipeline wait
 queue_depth         {last, p95} over the window (serving / PS)
+goodput_60s         fraction of the last minute spent in step compute
+                    (windowed ``sum_rate`` of step durations, clamped
+                    to 1) - the live analogue of the post-hoc ledger's
+                    goodput
+mfu_60s             windowed model-FLOPs utilisation: the analytic
+                    per-step FLOPs the trainer recorded in its
+                    ``collectives`` event x step rate / local peak
+                    (absent until that event arrives)
 nan_skips_total     non-finite guard skips (counter)
 faults_total        {action: count} chaos faults fired (counter)
 alerts_total        alert events observed (counter)
@@ -236,6 +244,12 @@ class LiveExporter:
         self.finished = False
         self.loss_nonfinite_streak = 0
 
+        # efficiency-ledger live inputs: the trainer's collectives event
+        # carries the analytic per-step model FLOPs; peak FLOPS is
+        # resolved lazily (jax is already up in-process when training)
+        self._model_flops_per_step = None
+        self._peak_flops_total = None
+
         self._sources: list = []  # callables returning digest sub-dicts
         self._digest_seq = 0
         self._last_push = 0.0
@@ -290,6 +304,11 @@ class LiveExporter:
                         k: v for k, v in event.items()
                         if k not in ("kind", "tm")
                     })
+        elif kind == "collectives":
+            flops = _finite_or_none(event.get("model_flops_per_step"))
+            if flops is not None and flops > 0:
+                with self._lock:
+                    self._model_flops_per_step = flops
         elif kind == "run_summary":
             self.finished = True
         elif kind in ("member_join", "member_drain", "member_dead"):
@@ -385,8 +404,51 @@ class LiveExporter:
         body["data_wait_s_mean"] = self.data_wait_s.stats(now)["mean"]
         depth = self.queue_depth.stats(now)
         body["queue_depth"] = {"last": depth["last"], "p95": depth["p95"]}
+        body["goodput_60s"] = self.goodput_60s(now)
+        body["mfu_60s"] = self.mfu_60s(now)
         body.update(self.source_snapshot())
         return body
+
+    # -- live efficiency (the in-run half of obs/ledger.py) ------------------
+
+    def goodput_60s(self, now: float | None = None) -> float | None:
+        """Fraction of the effective window spent inside step compute
+        (sum of step durations / window seconds, clamped to 1 - deferred
+        batch arrival can momentarily stack more step-seconds than
+        wall-seconds).  None before the first step lands."""
+        now = time.perf_counter() if now is None else now
+        if not self.step_s.values(now):
+            return None
+        return min(1.0, self.step_s.sum_rate(now))
+
+    def mfu_60s(self, now: float | None = None) -> float | None:
+        """Windowed MFU: analytic per-step model FLOPs (learned from the
+        trainer's ``collectives`` event) x windowed step rate / local
+        peak FLOPS.  None until the flops figure arrives or when no
+        peak is resolvable."""
+        now = time.perf_counter() if now is None else now
+        with self._lock:
+            flops = self._model_flops_per_step
+        if flops is None or not self.step_s.values(now):
+            return None
+        peak = self._resolve_peak()
+        if not peak:
+            return None
+        return flops * self.step_s.count_rate(now) / peak
+
+    def _resolve_peak(self) -> float | None:
+        if self._peak_flops_total is None:
+            try:
+                from pytorch_distributed_rnn_tpu.utils.hw import (
+                    local_peak_flops,
+                )
+
+                self._peak_flops_total = float(
+                    local_peak_flops().get("peak_flops_total") or 0.0
+                )
+            except Exception:  # pragma: no cover - peak must not kill
+                self._peak_flops_total = 0.0
+        return self._peak_flops_total or None
 
     def maybe_push(self) -> bool:
         """Writer-thread hook: push a digest when the cadence elapsed."""

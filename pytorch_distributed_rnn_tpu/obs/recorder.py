@@ -44,7 +44,16 @@ step                step, epoch, loss, dispatch_s, data_wait_s,
 epoch               epoch, steps, loss, acc, wall_s, path (scan|step|host)
 eval                epoch (null = test), loss, acc
 collectives         ops {hlo-op: {count, bytes}}, bytes_per_step - traced
-                    once per run from the live step program
+                    once per run from the live step program; plus the
+                    efficiency ledger's analytic cost of the same trace
+                    (obs/flops.py): model_flops_per_step,
+                    model_flops_exact, arg_bytes, out_bytes
+compile             step, seconds, cache_size - a step function's trace
+                    cache grew AFTER its warm-up compile (a retrace:
+                    shape drift, weak types, donation mismatch);
+                    seconds is that step's dispatch wall, which the
+                    ledger moves from the compute to the compile phase
+                    and `pdrnn-metrics summarize` counts as recompiles
 checkpoint_save     epoch, best, seconds, format
 checkpoint_restore  path, epoch, seconds
 nan_skip            new, total, consecutive
@@ -107,7 +116,11 @@ actor_reconnect     worker_id, attempts, seq, version - an actor
 learner_summary     updates, final_version, rejoins + ingest counters
                     - the streaming learner's verdict line
 run_summary         memory_mb, duration_s, device_peaks_mb, steps,
-                    nan_skipped, faults_fired; the PS master's variant
+                    nan_skipped, faults_fired, ledger (the trainer's
+                    efficiency block: model_flops_per_step, backend,
+                    device_kind/count, peak_flops_total,
+                    peak_flops_estimated - see obs/ledger.py); the
+                    PS master's variant
                     carries roster counts + rejoins + degraded_rounds;
                     the streaming learner's adds experience_batches,
                     experience_per_s, updates_per_s, stale_rejected,

@@ -245,6 +245,13 @@ def summarize_events(events: list[dict], path=None) -> dict:
         "checkpoint_saves": sum(
             1 for e in events if e["kind"] == "checkpoint_save"
         ),
+        # post-warm-up retraces (training/base.py emits a `compile`
+        # event when a step function's trace-cache size bumps after its
+        # first compile).  None-not-0: pre-ledger sidecars never carry
+        # the event and must not read as "zero recompiles, verified".
+        "recompiles": sum(
+            1 for e in events if e["kind"] == "compile"
+        ) or None,
         "ps_exchanges": sum(
             1 for e in events if e["kind"] == "ps_exchange"
         ),
@@ -320,6 +327,22 @@ def summarize_events(events: list[dict], path=None) -> dict:
         for key in SERVING_SUMMARY_KEYS + STREAMING_SUMMARY_KEYS:
             if key in run:
                 summary[key] = run[key]
+    # efficiency-ledger ratios (obs/ledger.py): goodput, its inverse
+    # badput_frac (the diffable direction - see REGRESSION_METRICS),
+    # fault tax and the comm-wait share of wall.  None, never 0, on
+    # schema-1 sidecars: the ledger needs the monotonic clock, and an
+    # uninstrumented run must not read as "goodput zero".
+    try:
+        from pytorch_distributed_rnn_tpu.obs.ledger import ledger_events
+
+        led = ledger_events(events)
+    except MalformedMetricsError:
+        led = None
+    summary["goodput"] = led["goodput"] if led else None
+    summary["badput_frac"] = (1.0 - led["goodput"]) if led else None
+    summary["fault_tax_s"] = led["fault_tax_s"] if led else None
+    summary["comm_wait_frac"] = led["comm_wait_frac"] if led else None
+    summary["mfu_est"] = led["mfu_est"] if led else None
     return summary
 
 
@@ -353,6 +376,13 @@ REGRESSION_METRICS = (
     # NOT listed: bigger is better, the wait metric already covers it.
     "comm_wait_s", "comm_wait_s_mean",
     "collective_grad_bytes_per_step", "collective_update_bytes_per_step",
+    # efficiency-ledger ratios (obs/ledger.py).  goodput itself is
+    # bigger-is-better and therefore NOT listed (the overlap_frac
+    # precedent): its inverse badput_frac is the gated direction.
+    # fault_tax_s is 0 on clean baselines, which the <= 0 guard skips -
+    # turning chaos ON can never read as a regression against them; on
+    # schema-1 sidecars all three are None (skipped the same way).
+    "badput_frac", "fault_tax_s", "comm_wait_frac",
 )
 
 

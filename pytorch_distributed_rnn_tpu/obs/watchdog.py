@@ -15,7 +15,13 @@ exporter's rolling windows and the recorder's progress note for
 - **loss spikes** - the newest loss above ``loss_spike_factor`` x the
   rolling window median;
 - **serving SLO breaches** - the engine's windowed p95 latency above
-  ``PDRNN_WATCHDOG_SLO_P95_MS``.
+  ``PDRNN_WATCHDOG_SLO_P95_MS``;
+- **goodput collapse** - the exporter's windowed goodput estimate
+  (``goodput_60s``: fraction of the last minute inside step compute,
+  the live half of ``obs/ledger.py``) falls below the
+  ``PDRNN_WATCHDOG_GOODPUT`` floor while the run is still making
+  nominal progress - the "alive but mostly waiting" failure mode a
+  stall detector cannot see.  Armed only when the env knob is set.
 
 Alerts are recorded as normal sidecar events (kind ``alert``, schema in
 ``obs/recorder.py``) and flushed immediately, so ``pdrnn-metrics
@@ -53,6 +59,7 @@ log = logging.getLogger(__name__)
 WATCHDOG_ENV = "PDRNN_WATCHDOG"  # "0" disables the watchdog outright
 WATCHDOG_STALL_ENV = "PDRNN_WATCHDOG_STALL"  # seconds (default 10)
 WATCHDOG_SLO_ENV = "PDRNN_WATCHDOG_SLO_P95_MS"  # serving SLO (ms)
+WATCHDOG_GOODPUT_ENV = "PDRNN_WATCHDOG_GOODPUT"  # goodput floor (0..1)
 
 _DEFAULT_STALL_AFTER_S = 10.0
 _DEFAULT_NAN_STREAK = 3
@@ -138,6 +145,7 @@ class AnomalyWatchdog:
                  nan_streak: int = _DEFAULT_NAN_STREAK,
                  loss_spike_factor: float = _DEFAULT_SPIKE_FACTOR,
                  slo_p95_s: float | None = None,
+                 goodput_floor: float | None = None,
                  dump_dir_hint=None):
         self.recorder = recorder
         self.exporter = exporter
@@ -150,6 +158,7 @@ class AnomalyWatchdog:
         self.nan_streak = int(nan_streak)
         self.loss_spike_factor = float(loss_spike_factor)
         self.slo_p95_s = slo_p95_s
+        self.goodput_floor = goodput_floor
         self.stacks_path = stacks_path_for(
             dump_dir_hint or recorder.path or "pdrnn-metrics.jsonl"
         )
@@ -161,21 +170,25 @@ class AnomalyWatchdog:
         self._in_nan = False
         self._in_spike = False
         self._in_slo = False
+        self._in_goodput = False
 
     @classmethod
     def resolve(cls, recorder, exporter, *, faults=None,
                 env=None) -> "AnomalyWatchdog | None":
         """Env-tuned construction (``PDRNN_WATCHDOG=0`` disables;
         ``PDRNN_WATCHDOG_STALL`` seconds; ``PDRNN_WATCHDOG_SLO_P95_MS``
-        arms the serving SLO detector)."""
+        arms the serving SLO detector; ``PDRNN_WATCHDOG_GOODPUT`` arms
+        the goodput-collapse detector with a 0..1 floor)."""
         env = env or os.environ
         if env.get(WATCHDOG_ENV, "1") in ("0", "off", "false"):
             return None
         slo_ms = env.get(WATCHDOG_SLO_ENV)
+        goodput = env.get(WATCHDOG_GOODPUT_ENV)
         return cls(
             recorder, exporter, faults=faults,
             stall_after_s=resolve_stall_after(env),
             slo_p95_s=float(slo_ms) / 1e3 if slo_ms else None,
+            goodput_floor=float(goodput) if goodput else None,
         )
 
     # -- lifecycle -----------------------------------------------------------
@@ -208,6 +221,7 @@ class AnomalyWatchdog:
         self._check_stall(now)
         self._check_loss()
         self._check_slo()
+        self._check_goodput(now)
 
     def _check_stall(self, now: float) -> None:
         age = self.exporter.progress_age_s(now)
@@ -278,6 +292,29 @@ class AnomalyWatchdog:
             self._in_slo = False
             self._alert("slo_recovered", severity="info",
                         latency_s_p95=p95, slo_p95_s=self.slo_p95_s)
+
+    def _check_goodput(self, now: float) -> None:
+        if self.goodput_floor is None or self.exporter.finished:
+            return
+        goodput = self.exporter.goodput_60s(now)
+        # demand a populated step window: warm-up and the pre-first-step
+        # gap report None / near-zero goodput without being a collapse
+        stats = self.exporter.step_s.stats(now)
+        if goodput is None or stats["count"] < _SPIKE_MIN_SAMPLES:
+            return
+        if goodput < self.goodput_floor:
+            if not self._in_goodput:
+                self._in_goodput = True
+                self._alert(
+                    "goodput_collapse", goodput_60s=goodput,
+                    goodput_floor=self.goodput_floor,
+                    step_s_mean=stats["mean"],
+                )
+        elif self._in_goodput:
+            self._in_goodput = False
+            self._alert("goodput_recovered", severity="info",
+                        goodput_60s=goodput,
+                        goodput_floor=self.goodput_floor)
 
     # -- emission ------------------------------------------------------------
 

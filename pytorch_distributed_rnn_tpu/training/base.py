@@ -126,6 +126,13 @@ class Trainer:
         self._profile = profile_steps
         # traced collective traffic is recorded once per run
         self._collectives_recorded = False
+        # analytic FLOPs of the traced live step (obs/flops.py), filled
+        # by _maybe_record_collectives for the run_summary ledger block
+        self._model_flops_per_step = None
+        self._model_flops_exact = None
+        # per-step-fn trace-cache sizes last observed: a bump after the
+        # first compile is a RETRACE and emits a `compile` event
+        self._trace_cache_seen = {}
         # gathered: the reference-parity single file (training/
         # checkpoint.py) - state is gathered to the writing host.
         # sharded: orbax/tensorstore per-shard writes - no gather, no
@@ -698,6 +705,7 @@ class Trainer:
             faults_fired=(
                 dict(self._faults.fired) if self._faults is not None else {}
             ),
+            ledger=self._ledger_block(),
         )
         self.recorder.flush()
 
@@ -865,32 +873,90 @@ class Trainer:
     def _maybe_record_collectives(self, step_fn, *args):
         """Trace the LIVE step program once and record its per-step
         collective traffic (``evaluation/collectives.
-        closed_jaxpr_collective_stats`` - scan trip counts multiplied in).
-        Tracing is abstract (no execution, no compile) and happens once
-        per run, before the first dispatch.  Steps that are host
-        functions (native-TCP DDP, the PS worker's push/pull) abort the
-        trace on their first host conversion - telemetry then records
-        the absence instead of failing the run."""
+        closed_jaxpr_collective_stats`` - scan trip counts multiplied in)
+        plus its analytic FLOP count (``obs/flops.py`` - the efficiency
+        ledger's MFU numerator) off the same ClosedJaxpr.  Tracing is
+        abstract (no execution, no compile) and happens once per run,
+        before the first dispatch.  Steps that are host functions
+        (native-TCP DDP, the PS worker's push/pull) abort the trace on
+        their first host conversion - telemetry then records the
+        absence instead of failing the run."""
         if self._collectives_recorded or not self.recorder.enabled:
             return
         self._collectives_recorded = True
         from pytorch_distributed_rnn_tpu.evaluation.collectives import (
             closed_jaxpr_collective_stats,
         )
+        from pytorch_distributed_rnn_tpu.obs.flops import (
+            closed_jaxpr_flop_stats,
+        )
 
         try:
-            stats = closed_jaxpr_collective_stats(
-                jax.make_jaxpr(step_fn)(*args)
-            )
+            closed = jax.make_jaxpr(step_fn)(*args)
+            stats = closed_jaxpr_collective_stats(closed)
+            flops = closed_jaxpr_flop_stats(closed)
         except Exception as exc:  # host-loop steps are untraceable
             self.recorder.record(
                 "collectives", ops=None, bytes_per_step=None,
                 error=f"{type(exc).__name__}: {str(exc)[:200]}",
             )
             return
+        self._model_flops_per_step = flops["flops"]
+        self._model_flops_exact = flops["exact"]
         self.recorder.record(
             "collectives", ops=stats,
             bytes_per_step=sum(s["bytes"] for s in stats.values()),
+            model_flops_per_step=flops["flops"],
+            model_flops_exact=flops["exact"],
+            arg_bytes=flops["arg_bytes"],
+            out_bytes=flops["out_bytes"],
+        )
+
+    def _ledger_block(self) -> dict:
+        """run_summary's efficiency-ledger block: the traced FLOP count
+        and the backend peak the ledger CLI divides it by, recorded
+        run-side so offline readers need no jax and no hardware."""
+        from pytorch_distributed_rnn_tpu.utils.hw import peak_flops
+
+        devices = jax.devices()
+        peak = peak_flops(jax.default_backend(), devices[0].device_kind)
+        return {
+            "model_flops_per_step": self._model_flops_per_step,
+            "model_flops_exact": self._model_flops_exact,
+            "backend": jax.default_backend(),
+            "device_kind": devices[0].device_kind,
+            "device_count": len(devices),
+            "peak_flops_total":
+                peak["peak_flops_per_device"] * len(devices),
+            # True whenever the peak did not come off a datasheet (CPU
+            # and unknown devices) - every ledger surface labels it
+            "peak_flops_estimated": peak["estimated"],
+        }
+
+    def _note_recompile(self, fn, step: int, seconds: float, tm: float):
+        """Emit a `compile` event when ``fn``'s trace cache grew past
+        its warm-up compile: a post-warm-up RETRACE (shape drift, weak
+        types, donation mismatch) that silently re-pays compile cost.
+        Probes the jit cache size OUTSIDE any traced region (the
+        trace-transparency contract), one attribute call per recorded
+        step."""
+        size_fn = getattr(fn, "_cache_size", None)
+        if size_fn is None:
+            return
+        try:
+            size = int(size_fn())
+        except Exception:
+            return
+        key = id(fn)
+        seen = self._trace_cache_seen.get(key)
+        self._trace_cache_seen[key] = size
+        # first observation (the warm-up compile itself) is expected
+        # and priced by the ledger's first-step excess, not an event
+        if seen is None or size <= seen:
+            return
+        self.recorder.record(
+            "compile", step=step, seconds=seconds, cache_size=size,
+            tm=tm,
         )
 
     def _chaos_host_loop(self) -> bool:
@@ -960,6 +1026,10 @@ class Trainer:
                 if recording and self.recorder.is_sample_step(step):
                     _fence(loss)
                     fenced_s = time.perf_counter() - t0
+                if recording:
+                    self._note_recompile(
+                        self._idx_step_fn, step, dispatch_s, t0
+                    )
                 if self._profile is not None:
                     self._profile.on_step_end(step, fence_value=loss)
                 self._steps_done = step + 1
@@ -1150,6 +1220,10 @@ class Trainer:
                 if recording and self.recorder.is_sample_step(step):
                     _fence(loss)
                     fenced_s = time.perf_counter() - t0
+                if recording:
+                    self._note_recompile(
+                        self._train_step_fn, step, dispatch_s, t0
+                    )
                 if self._profile is not None:
                     self._profile.on_step_end(step, fence_value=loss)
                 self._steps_done = step + 1

@@ -1,3 +1,9 @@
+from pytorch_distributed_rnn_tpu.utils.hw import (
+    CPU_PEAK_FLOPS_ESTIMATE,
+    PEAK_FLOPS_TABLE,
+    local_peak_flops,
+    peak_flops,
+)
 from pytorch_distributed_rnn_tpu.utils.platform import (
     apply_platform_overrides,
     ensure_usable_backend,
@@ -5,7 +11,11 @@ from pytorch_distributed_rnn_tpu.utils.platform import (
 )
 
 __all__ = [
+    "CPU_PEAK_FLOPS_ESTIMATE",
+    "PEAK_FLOPS_TABLE",
     "apply_platform_overrides",
     "ensure_usable_backend",
+    "local_peak_flops",
+    "peak_flops",
     "probe_backend",
 ]

@@ -1,0 +1,804 @@
+"""Efficiency ledger (obs/ledger.py + obs/flops.py): phase accounting
+on handcrafted schema-2 sidecars (fractions provably sum to 1, fault
+tax under chaos, sampled-cadence rescale), analytic FLOP counting vs
+hand-computed LSTM numbers, the ledger/regress CLI contract with its
+``ledger_history.jsonl`` gate, and a REAL chaos-vs-clean Trainer run
+proving the interrupted run pays a measurable fault tax.
+"""
+
+import json
+import math
+
+import pytest
+
+from pytorch_distributed_rnn_tpu.obs import (
+    MalformedMetricsError,
+    MetricsRecorder,
+    load_events,
+)
+from pytorch_distributed_rnn_tpu.obs.cli import main as metrics_main
+from pytorch_distributed_rnn_tpu.obs.ledger import (
+    FRACTION_TOL,
+    LEDGER_PHASES,
+    append_history,
+    check_history,
+    history_record,
+    ledger_events,
+    ledger_file,
+    ledger_run,
+    load_history,
+)
+from pytorch_distributed_rnn_tpu.obs.summary import summarize_events
+
+SEED = 123456789
+
+# a fixed fake peak so no test path imports jax just to price MFU
+PEAK = {"peak_flops_total": 1e9, "estimated": True, "device": "testdev"}
+
+
+def _events(rank=0, *, steps=6, step_wall=0.02, fenced_s=0.012,
+            data_wait_s=0.001, comm_wait_s=0.002, sample_every=1,
+            role=None, stage=None, flops_per_step=None, epoch=True,
+            run_summary=True, run_extra=None, ledger_block=None,
+            extra=(), t_base=1000.0):
+    """A handcrafted schema-2 event list.
+
+    The true wall time of step ``k``'s start is ``k * step_wall``; the
+    monotonic clock starts at 0.  With ``sample_every > 1`` only every
+    n-th step is recorded (the recorder's sampling contract), which the
+    ledger must rescale by the step span.
+    """
+    events = []
+    meta = {
+        "kind": "meta", "t": t_base, "tm": 0.0, "rank": rank,
+        "schema": 2, "sample_every": sample_every,
+    }
+    if role:
+        meta["role"] = role
+    if stage is not None:
+        meta["stage"] = stage
+    events.append(meta)
+    coll = {
+        "kind": "collectives", "t": t_base, "tm": 0.0, "rank": rank,
+        "ops": {"all-reduce": {"count": 1, "bytes": 4096}},
+        "bytes_per_step": 4096,
+    }
+    if flops_per_step is not None:
+        coll["model_flops_per_step"] = flops_per_step
+        coll["model_flops_exact"] = True
+    events.append(coll)
+    for k in range(0, steps, sample_every):
+        tm = k * step_wall
+        events.append({
+            "kind": "step", "t": t_base + tm, "tm": tm, "rank": rank,
+            "step": k, "epoch": 0, "loss": 2.0 - 0.1 * k,
+            "dispatch_s": fenced_s / 2, "fenced_s": fenced_s,
+            "data_wait_s": data_wait_s, "comm_wait_s": comm_wait_s,
+        })
+    end_tm = steps * step_wall
+    if epoch:
+        events.append({
+            "kind": "epoch", "t": t_base + end_tm, "tm": 0.0,
+            "rank": rank, "epoch": 0, "steps": steps, "loss": 1.5,
+            "acc": 0.5, "wall_s": end_tm, "path": "step",
+        })
+    if run_summary:
+        run = {
+            "kind": "run_summary", "t": t_base + end_tm, "tm": end_tm,
+            "rank": rank, "memory_mb": 100.0, "duration_s": end_tm,
+            "steps": steps, "epochs": 1, "nan_skipped": 0,
+            "faults_fired": {},
+        }
+        if run_extra:
+            run.update(run_extra)
+        if ledger_block is not None:
+            run["ledger"] = ledger_block
+        events.append(run)
+    events.extend(extra)
+    return events
+
+
+def _write(path, events, rank=0):
+    suffix = "" if rank == 0 else f"-r{rank}"
+    out = path.parent / f"{path.stem}{suffix}{path.suffix}"
+    out.write_text("".join(json.dumps(e) + "\n" for e in events))
+    return out
+
+
+def _frac_sum(led):
+    return sum(led["fractions"][p] for p in LEDGER_PHASES)
+
+
+# -- phase accounting on handcrafted sidecars --------------------------------
+
+
+class TestLedgerEvents:
+    def test_clean_run_fractions_sum_to_one(self):
+        led = ledger_events(_events())
+        assert _frac_sum(led) == pytest.approx(1.0, abs=FRACTION_TOL)
+        # 6 steps x 0.02s epoch window; carve out the known residents
+        assert led["wall_s"] == pytest.approx(0.12)
+        assert led["phase_s"]["data_wait"] == pytest.approx(0.006)
+        assert led["phase_s"]["comm_wait"] == pytest.approx(0.012)
+        assert led["phase_s"]["compute"] == pytest.approx(0.102)
+        assert led["goodput"] == pytest.approx(0.102 / 0.12)
+        assert led["comm_wait_frac"] == pytest.approx(0.1)
+        assert led["fault_tax_s"] == 0.0
+        assert led["steps_est"] == 6 and led["steps_sampled"] == 6
+
+    def test_every_phase_key_present(self):
+        led = ledger_events(_events())
+        assert set(led["phase_s"]) == set(LEDGER_PHASES)
+        assert set(led["fractions"]) == set(LEDGER_PHASES)
+
+    def test_chaos_kill_pays_fault_tax_and_still_sums_to_one(self):
+        """A stalled-then-killed run: the stall span and the lost tail
+        after the last step both land in the fault phase, and the
+        accounting identity survives the torn stream."""
+        stall = {
+            "kind": "span", "name": "fault_stall", "cat": "resilience",
+            "t": 1000.04, "tm": 0.04, "rank": 0, "dur_s": 0.04,
+        }
+        kill = {
+            "kind": "fault", "action": "kill", "t": 1000.16,
+            "tm": 0.16, "rank": 0, "step": 6,
+        }
+        led = ledger_events(_events(
+            epoch=False, run_summary=False, extra=(stall, kill),
+        ))
+        assert _frac_sum(led) == pytest.approx(1.0, abs=FRACTION_TOL)
+        # the kill mark extends the stream; tail after the last step
+        # end (0.1 + 0.012) is lost work
+        assert led["wall_s"] == pytest.approx(0.16)
+        lost_tail = 0.16 - (0.1 + 0.012)
+        assert led["phase_s"]["fault"] == pytest.approx(0.04 + lost_tail)
+        assert led["fault_tax_s"] > 0
+        # interrupted goodput must sit below the clean run's
+        assert led["goodput"] < ledger_events(_events())["goodput"]
+
+    def test_stall_time_moves_out_of_data_wait(self):
+        """The injected stall blocks the producer, so the consumer sees
+        it as data wait - the ledger must charge it to fault exactly
+        once, not twice."""
+        stall = {
+            "kind": "span", "name": "fault_stall", "cat": "resilience",
+            "t": 1000.02, "tm": 0.02, "rank": 0, "dur_s": 0.05,
+        }
+        led = ledger_events(_events(data_wait_s=0.01, extra=(stall,)))
+        # raw data wait is 0.06; 0.05 of it was the stall
+        assert led["phase_s"]["data_wait"] == pytest.approx(0.01)
+        assert led["phase_s"]["fault"] == pytest.approx(0.05)
+        assert _frac_sum(led) == pytest.approx(1.0, abs=FRACTION_TOL)
+
+    def test_sampled_cadence_rescales_per_step_sums(self):
+        """With sample_every=3 only steps 0,3,6 are recorded; per-step
+        sums must scale by the step SPAN, not the sample count."""
+        led = ledger_events(_events(steps=9, sample_every=3))
+        assert led["steps_sampled"] == 3
+        assert led["steps_est"] == 7  # span 0..6 inclusive
+        assert led["phase_s"]["data_wait"] == pytest.approx(0.001 * 7)
+        assert led["phase_s"]["comm_wait"] == pytest.approx(0.002 * 7)
+        assert _frac_sum(led) == pytest.approx(1.0, abs=FRACTION_TOL)
+
+    def test_compile_events_counted_and_priced(self):
+        recompile = {
+            "kind": "compile", "t": 1000.06, "tm": 0.06, "rank": 0,
+            "step": 3, "seconds": 0.005, "cache_size": 2,
+        }
+        led = ledger_events(_events(extra=(recompile,)))
+        assert led["recompiles"] == 1
+        assert led["phase_s"]["compile"] == pytest.approx(0.005)
+        assert _frac_sum(led) == pytest.approx(1.0, abs=FRACTION_TOL)
+
+    def test_first_step_excess_is_warmup_compile(self):
+        """The warm-up compile shows up as the first step's excess over
+        the steady-state mean - no event needed."""
+        events = _events()
+        first = next(e for e in events if e["kind"] == "step")
+        first["fenced_s"] = 0.112  # 0.1s of tracing on top of steady 0.012
+        led = ledger_events(events)
+        assert led["phase_s"]["compile"] == pytest.approx(0.1)
+        assert led["recompiles"] == 0  # warm-up is not a RE-compile
+        assert _frac_sum(led) == pytest.approx(1.0, abs=FRACTION_TOL)
+
+    def test_zero_step_run_is_all_idle(self):
+        meta = {"kind": "meta", "t": 5.0, "tm": 0.0, "rank": 0,
+                "schema": 2}
+        led = ledger_events([meta])
+        assert led["wall_s"] == 0.0
+        assert led["fractions"]["idle"] == 1.0
+        assert _frac_sum(led) == pytest.approx(1.0, abs=FRACTION_TOL)
+        assert led["goodput"] == 0.0 and led["fault_tax_s"] == 0.0
+        assert led["mfu_est"] is None
+
+    def test_schema_1_sidecar_is_malformed(self, tmp_path):
+        path = tmp_path / "old.jsonl"
+        path.write_text('{"kind": "meta", "schema": 1, "rank": 0, '
+                        '"t": 5.0}\n')
+        with pytest.raises(MalformedMetricsError, match="schema"):
+            ledger_file(path)
+
+    def test_torn_final_line_tolerated(self, tmp_path):
+        """A run killed mid-write leaves a torn last line; the ledger
+        prices what survived instead of refusing."""
+        path = _write(tmp_path / "m.jsonl", _events())
+        with path.open("a") as f:
+            f.write('{"kind": "step", "t": 10')  # torn by the kill
+        led = ledger_file(path)
+        assert led["steps_est"] == 6
+        assert _frac_sum(led) == pytest.approx(1.0, abs=FRACTION_TOL)
+
+    def test_mfu_from_run_summary_peak_block_without_jax(self):
+        """A run-side ledger block carries flops AND the peak table row,
+        so the offline CLI never needs jax to price MFU."""
+        led = ledger_events(_events(
+            flops_per_step=1e6,
+            ledger_block={
+                "model_flops_per_step": 1e6,
+                "peak_flops_total": 1e9,
+                "peak_flops_estimated": True,
+                "device_kind": "cpu",
+            },
+        ))
+        # 1e6 flops x 6 steps over 0.12s against a 1e9 peak
+        assert led["mfu_est"] == pytest.approx(6e6 / (0.12 * 1e9))
+        assert led["hfu_est"] == led["mfu_est"]
+        assert led["peak_estimated"] is True
+        assert led["peak_device"] == "cpu"
+        assert led["flops_exact"] is True
+
+    def test_mfu_from_explicit_peak_table(self):
+        led = ledger_events(_events(flops_per_step=2e6), peak=PEAK)
+        assert led["mfu_est"] == pytest.approx(12e6 / (0.12 * 1e9))
+        assert led["peak_device"] == "testdev"
+
+    def test_nan_skips_discount_mfu_steps(self):
+        led = ledger_events(
+            _events(flops_per_step=1e6, run_extra={"nan_skipped": 2}),
+            peak=PEAK,
+        )
+        # only 4 of the 6 spanned steps advanced the model
+        assert led["mfu_est"] == pytest.approx(4e6 / (0.12 * 1e9))
+        assert led["nan_skipped"] == 2
+        assert _frac_sum(led) == pytest.approx(1.0, abs=FRACTION_TOL)
+
+
+# -- whole-run aggregation ---------------------------------------------------
+
+
+class TestLedgerRun:
+    def test_multi_rank_aggregate(self, tmp_path):
+        path = tmp_path / "m.jsonl"
+        _write(path, _events(rank=0), rank=0)
+        _write(path, _events(rank=1, comm_wait_s=0.004), rank=1)
+        run = ledger_run(path)
+        assert [r["rank"] for r in run["ranks"]] == [0, 1]
+        agg = run["aggregate"]
+        assert agg["wall_s"] == pytest.approx(0.12)
+        assert sum(agg["fractions"][p] for p in LEDGER_PHASES) == (
+            pytest.approx(1.0, abs=FRACTION_TOL)
+        )
+        # pooled comm fraction sits between the two ranks' own
+        assert 0.1 < agg["comm_wait_frac"] < 0.2
+        assert agg["goodput"] == agg["fractions"]["compute"]
+        assert "mpmd" not in run and "streaming" not in run
+
+    def test_mpmd_stage_view_and_bubble(self, tmp_path):
+        path = tmp_path / "m.jsonl"
+        _write(path, _events(rank=0, stage=0), rank=0)
+        # stage 1 computes half as much: a real pipeline bubble
+        _write(path, _events(rank=1, stage=1, steps=3, step_wall=0.04),
+               rank=1)
+        run = ledger_run(path)
+        assert set(run["mpmd"]["stages"]) == {0, 1}
+        bubble = run["mpmd"]["bubble_frac"]
+        assert bubble is not None and 0.0 < bubble < 1.0
+
+    def test_streaming_split(self, tmp_path):
+        path = tmp_path / "m.jsonl"
+        _write(path, _events(rank=0, role="learner", run_extra={
+            "stale_rejected": 3, "duplicates": 1, "queue_sheds": 0,
+            "experience_per_s": 100.0,
+        }), rank=0)
+        _write(path, _events(rank=1, role="actor"), rank=1)
+        run = ledger_run(path)
+        learner = run["streaming"]["learner"]
+        assert learner["reject_tax_s"] == pytest.approx(0.04)
+        assert run["streaming"]["actors"]["count"] == 1
+        assert run["streaming"]["actors"]["goodput_mean"] > 0
+
+    def test_missing_sidecar_raises(self, tmp_path):
+        with pytest.raises(MalformedMetricsError, match="no metrics"):
+            ledger_run(tmp_path / "absent.jsonl")
+
+
+# -- analytic FLOPs ----------------------------------------------------------
+
+
+class TestFlops:
+    def test_matmul_exact_count(self):
+        import numpy as np
+
+        from pytorch_distributed_rnn_tpu.obs.flops import trace_flop_stats
+
+        stats = trace_flop_stats(
+            lambda a, b: a @ b,
+            np.zeros((4, 8), np.float32), np.zeros((8, 16), np.float32),
+        )
+        # 2 x out_elems x contraction = 2 * (4*16) * 8
+        assert stats["flops"] == 1024
+        assert stats["by_primitive"]["dot_general"] == 1024
+        assert stats["exact"] is True
+        assert stats["arg_bytes"] == (4 * 8 + 8 * 16) * 4
+        assert stats["out_bytes"] == 4 * 16 * 4
+
+    def test_data_movement_is_free(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from pytorch_distributed_rnn_tpu.obs.flops import trace_flop_stats
+
+        stats = trace_flop_stats(
+            lambda a: jnp.transpose(a).reshape(-1),
+            np.zeros((4, 8), np.float32),
+        )
+        assert stats["flops"] == 0
+
+    def test_scan_multiplies_by_length(self):
+        import jax
+        import numpy as np
+
+        from pytorch_distributed_rnn_tpu.obs.flops import trace_flop_stats
+
+        def fn(h, xs, w):
+            def body(h, x):
+                h = h @ w  # 2 * (4*8) * 8 = 512 flops per iteration
+                return h, h
+            h, _ = jax.lax.scan(body, h, xs)
+            return h
+
+        stats = trace_flop_stats(
+            fn, np.zeros((4, 8), np.float32),
+            np.zeros((5, 1), np.float32), np.zeros((8, 8), np.float32),
+        )
+        assert stats["by_primitive"]["dot_general"] == 5 * 512
+
+    def test_lstm_cell_matches_hand_count(self):
+        """The gate matmul of one LSTM cell, hand-counted: a (b, i+h) x
+        (i+h, 4h) dot_general is 2*b*4h*(i+h) flops."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from pytorch_distributed_rnn_tpu.obs.flops import trace_flop_stats
+
+        b, i, h = 3, 9, 8
+
+        def cell(x, hid, c, w):
+            z = jnp.concatenate([x, hid], axis=1) @ w
+            ii, ff, gg, oo = jnp.split(z, 4, axis=1)
+            c = jax.nn.sigmoid(ff) * c + jax.nn.sigmoid(ii) * jnp.tanh(gg)
+            return jax.nn.sigmoid(oo) * jnp.tanh(c)
+
+        import jax
+
+        stats = trace_flop_stats(
+            cell,
+            np.zeros((b, i), np.float32), np.zeros((b, h), np.float32),
+            np.zeros((b, h), np.float32),
+            np.zeros((i + h, 4 * h), np.float32),
+        )
+        assert stats["by_primitive"]["dot_general"] == 2 * b * 4 * h * (i + h)
+        # elementwise gates add flops on top of the matmul
+        assert stats["flops"] > 2 * b * 4 * h * (i + h)
+
+    def test_entry_flop_report_with_explicit_entries(self):
+        import numpy as np
+
+        from pytorch_distributed_rnn_tpu.lint.trace_registry import (
+            TraceEntry,
+        )
+        from pytorch_distributed_rnn_tpu.obs.flops import entry_flop_report
+
+        def build():
+            a = np.zeros((2, 4), np.float32)
+            b = np.zeros((4, 4), np.float32)
+            return (lambda a, b: a @ b), (a, b)
+
+        entry = TraceEntry(name="tiny_matmul", family="test",
+                           path="tests/test_ledger.py", build=build)
+        rows = entry_flop_report(entries=[entry])
+        assert rows[0]["name"] == "tiny_matmul"
+        assert rows[0]["flops_per_call"] == 2 * (2 * 4) * 4
+        assert rows[0]["exact"] is True
+
+    def test_registry_entries_all_costed(self):
+        """Every registered trace entry gets a row; failures degrade to
+        an error row, never an abort."""
+        from pytorch_distributed_rnn_tpu.lint.trace_registry import (
+            load_entries,
+        )
+        from pytorch_distributed_rnn_tpu.obs.flops import entry_flop_report
+
+        rows = entry_flop_report()
+        assert len(rows) == len(load_entries())
+        costed = [r for r in rows if r.get("flops_per_call")]
+        assert costed, "no registry entry produced a flop count"
+        for r in costed:
+            assert r["flops_per_call"] > 0
+            assert math.isfinite(r["flops_per_call"])
+
+
+# -- history + regression gate ----------------------------------------------
+
+
+def _hist_record(key="cfg", goodput=0.8, fault_tax_frac=0.05,
+                 comm_wait_frac=0.1, **over):
+    rec = {
+        "key": key, "goodput": goodput, "mfu_est": 0.01,
+        "fault_tax_s": fault_tax_frac * 10.0,
+        "fault_tax_frac": fault_tax_frac,
+        "comm_wait_frac": comm_wait_frac, "wall_s": 10.0, "steps": 100,
+    }
+    rec.update(over)
+    return rec
+
+
+class TestHistoryRegress:
+    def test_round_trip(self, tmp_path):
+        hist = tmp_path / "ledger_history.jsonl"
+        append_history(hist, _hist_record())
+        append_history(hist, _hist_record(goodput=0.81))
+        records = load_history(hist)
+        assert len(records) == 2
+        assert records[1]["goodput"] == 0.81
+
+    def test_history_record_off_run_ledger(self, tmp_path):
+        path = _write(tmp_path / "m.jsonl", _events())
+        rec = history_record(ledger_run(path), "mykey")
+        assert rec["key"] == "mykey"
+        assert rec["goodput"] == pytest.approx(0.85)
+        assert rec["fault_tax_frac"] == 0.0
+        assert rec["steps"] == 6
+
+    def test_load_strictness(self, tmp_path):
+        missing = tmp_path / "nope.jsonl"
+        with pytest.raises(MalformedMetricsError, match="unreadable"):
+            load_history(missing)
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text("not json\n")
+        with pytest.raises(MalformedMetricsError, match="unparseable"):
+            load_history(bad)
+        nokey = tmp_path / "nokey.jsonl"
+        nokey.write_text('{"goodput": 0.5}\n')
+        with pytest.raises(MalformedMetricsError, match="key"):
+            load_history(nokey)
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text("\n")
+        with pytest.raises(MalformedMetricsError, match="empty"):
+            load_history(empty)
+
+    def test_same_config_rerun_stays_green(self):
+        # identical reruns plus sub-floor jitter must not trip the gate
+        records = [
+            _hist_record(goodput=0.80),
+            _hist_record(goodput=0.81),
+            _hist_record(goodput=0.78, comm_wait_frac=0.13),
+        ]
+        report = check_history(records)
+        assert report["regressions"] == []
+        assert report["compared"] == 1
+
+    def test_goodput_drop_flagged(self):
+        records = [_hist_record(goodput=0.8), _hist_record(goodput=0.2)]
+        report = check_history(records)
+        assert [r["metric"] for r in report["regressions"]] == ["goodput"]
+        assert report["regressions"][0]["delta"] == pytest.approx(-0.6)
+
+    def test_fault_tax_rise_flagged(self):
+        records = [
+            _hist_record(fault_tax_frac=0.02),
+            _hist_record(fault_tax_frac=0.3),
+        ]
+        report = check_history(records)
+        assert [r["metric"] for r in report["regressions"]] == (
+            ["fault_tax_frac"]
+        )
+
+    def test_needs_both_threshold_and_floor(self):
+        # 50% relative rise but only 0.015 absolute: under the floor
+        records = [
+            _hist_record(comm_wait_frac=0.03),
+            _hist_record(comm_wait_frac=0.045),
+        ]
+        assert check_history(records)["regressions"] == []
+        # large absolute move on a big base still needs the relative bar
+        records = [
+            _hist_record(goodput=0.9),
+            _hist_record(goodput=0.8),  # -0.1 > floor but only -11%
+        ]
+        assert check_history(records)["regressions"] == []
+
+    def test_single_run_keys_not_compared(self):
+        report = check_history([_hist_record(key="solo")])
+        assert report["keys"] == 1 and report["compared"] == 0
+
+    def test_latest_vs_median_of_priors(self):
+        # one historic outlier must not drag the baseline down
+        records = [
+            _hist_record(goodput=0.8), _hist_record(goodput=0.2),
+            _hist_record(goodput=0.8), _hist_record(goodput=0.78),
+        ]
+        assert check_history(records)["regressions"] == []
+
+
+# -- CLI contract ------------------------------------------------------------
+
+
+class TestLedgerCLI:
+    def test_ledger_table(self, tmp_path, capsys):
+        path = _write(tmp_path / "m.jsonl", _events())
+        assert metrics_main(["ledger", str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "goodput" in out and "compute" in out
+        assert "fault_tax_s" in out
+
+    def test_ledger_json_fractions_sum(self, tmp_path, capsys):
+        path = _write(tmp_path / "m.jsonl", _events())
+        assert metrics_main(["ledger", str(path), "--json"]) == 0
+        body = json.loads(capsys.readouterr().out)
+        fractions = body[0]["aggregate"]["fractions"]
+        assert sum(fractions[p] for p in LEDGER_PHASES) == (
+            pytest.approx(1.0, abs=FRACTION_TOL)
+        )
+
+    def test_ledger_schema1_exits_2(self, tmp_path, capsys):
+        path = tmp_path / "old.jsonl"
+        path.write_text('{"kind": "meta", "schema": 1, "rank": 0, '
+                        '"t": 5.0}\n')
+        assert metrics_main(["ledger", str(path)]) == 2
+
+    def test_history_then_regress_green(self, tmp_path, capsys):
+        path = _write(tmp_path / "m.jsonl", _events())
+        hist = tmp_path / "ledger_history.jsonl"
+        for _ in range(2):  # same-config rerun: the CI gate's green path
+            assert metrics_main([
+                "ledger", str(path), "--history", str(hist),
+                "--key", "ci-cfg",
+            ]) == 0
+        capsys.readouterr()
+        assert metrics_main(["regress", str(hist)]) == 0
+        assert "no ledger regression" in capsys.readouterr().out
+
+    def test_regress_flags_collapse(self, tmp_path, capsys):
+        hist = tmp_path / "ledger_history.jsonl"
+        append_history(hist, _hist_record(goodput=0.8))
+        append_history(hist, _hist_record(goodput=0.1,
+                                          fault_tax_frac=0.5))
+        assert metrics_main(["regress", str(hist)]) == 1
+        out = capsys.readouterr().out
+        assert "REGRESSION" in out and "goodput" in out
+
+    def test_regress_missing_history_exits_2(self, tmp_path, capsys):
+        assert metrics_main([
+            "regress", str(tmp_path / "absent.jsonl"),
+        ]) == 2
+
+
+# -- summary integration -----------------------------------------------------
+
+
+class TestSummaryIntegration:
+    def test_summary_carries_ledger_ratios(self):
+        summary = summarize_events(_events())
+        assert summary["goodput"] == pytest.approx(0.85)
+        assert summary["badput_frac"] == pytest.approx(0.15)
+        assert summary["fault_tax_s"] == 0.0
+        assert summary["comm_wait_frac"] == pytest.approx(0.1)
+
+    def test_summary_counts_recompiles(self):
+        recompile = {
+            "kind": "compile", "t": 1000.06, "tm": 0.06, "rank": 0,
+            "step": 3, "seconds": 0.5, "cache_size": 2,
+        }
+        assert summarize_events(_events(extra=(recompile,)))[
+            "recompiles"] == 1
+        # None-not-0: no compile event must not read as "verified zero"
+        assert summarize_events(_events())["recompiles"] is None
+
+
+# -- live plane: goodput/MFU gauges + watchdog collapse detector -------------
+
+
+class TestLiveGoodput:
+    def _plane(self, tmp_path):
+        from pytorch_distributed_rnn_tpu.obs.aggregator import Aggregator
+        from pytorch_distributed_rnn_tpu.obs.live import LiveExporter
+
+        rec = MetricsRecorder(tmp_path / "m.jsonl", sample_every=1)
+        agg = Aggregator()
+        exporter = LiveExporter(rec, agg, push_every_s=999.0)
+        rec.attach_live(exporter)
+        return rec, agg, exporter
+
+    def test_goodput_and_mfu_gauges_on_metrics(self, tmp_path):
+        rec, agg, exporter = self._plane(tmp_path)
+        rec.record("collectives", model_flops_per_step=1e6,
+                   bytes_per_step=4096)
+        for i in range(10):
+            rec.record("step", step=i, loss=1.0, fenced_s=0.01,
+                       data_wait_s=0.001)
+        digest = exporter.digest()
+        assert digest["goodput_60s"] is not None
+        assert 0.0 < digest["goodput_60s"] <= 1.0
+        assert digest["mfu_60s"] is not None and digest["mfu_60s"] > 0
+        exporter.push_now()
+        lines = agg.prometheus_text().splitlines()
+        assert "# TYPE pdrnn_goodput gauge" in lines
+        assert any(line.startswith("pdrnn_goodput{") for line in lines)
+        assert any(line.startswith("pdrnn_mfu{") for line in lines)
+        rec.close()
+
+    def test_no_steps_no_goodput_gauge(self, tmp_path):
+        rec, agg, exporter = self._plane(tmp_path)
+        assert exporter.digest()["goodput_60s"] is None
+        exporter.push_now()
+        # a None gauge is dropped, not rendered as 0
+        assert not any(
+            line.startswith("pdrnn_goodput{")
+            for line in agg.prometheus_text().splitlines()
+        )
+        rec.close()
+
+    def test_watchdog_goodput_collapse_then_recovery(self, tmp_path):
+        import time
+
+        from pytorch_distributed_rnn_tpu.obs.live import LiveExporter
+        from pytorch_distributed_rnn_tpu.obs.watchdog import AnomalyWatchdog
+
+        rec = MetricsRecorder(tmp_path / "m.jsonl", sample_every=1)
+        exporter = LiveExporter(rec, None)
+        rec.attach_live(exporter)
+        wd = AnomalyWatchdog(rec, exporter, stall_after_s=999.0,
+                             check_every_s=0.01, goodput_floor=0.5)
+        # near-zero step time over real elapsed wall: windowed goodput
+        # collapses far below the floor
+        for i in range(9):
+            rec.record("step", step=i, loss=1.0, fenced_s=1e-5)
+        time.sleep(0.25)
+        rec.record("step", step=9, loss=1.0, fenced_s=1e-5)
+        wd.check()
+        wd.check()  # latched episode: no duplicate alert
+        # heavy steps push the windowed rate back over the floor
+        for i in range(10, 22):
+            rec.record("step", step=i, loss=1.0, fenced_s=0.05)
+        wd.check()
+        rec.close()
+        alerts = [e for e in load_events(tmp_path / "m.jsonl")
+                  if e["kind"] == "alert"]
+        assert [a["alert"] for a in alerts] == [
+            "goodput_collapse", "goodput_recovered",
+        ]
+        assert alerts[0]["goodput_60s"] < 0.5
+        assert alerts[0]["goodput_floor"] == 0.5
+
+    def test_watchdog_goodput_env_knob(self, monkeypatch, tmp_path):
+        from pytorch_distributed_rnn_tpu.obs.live import LiveExporter
+        from pytorch_distributed_rnn_tpu.obs.watchdog import (
+            WATCHDOG_GOODPUT_ENV,
+            AnomalyWatchdog,
+        )
+
+        rec = MetricsRecorder(tmp_path / "m.jsonl", sample_every=1)
+        exporter = LiveExporter(rec, None)
+        rec.attach_live(exporter)
+        monkeypatch.setenv(WATCHDOG_GOODPUT_ENV, "0.25")
+        wd = AnomalyWatchdog.resolve(rec, exporter)
+        assert wd.goodput_floor == 0.25
+        monkeypatch.delenv(WATCHDOG_GOODPUT_ENV)
+        assert AnomalyWatchdog.resolve(rec, exporter).goodput_floor is None
+        rec.close()
+
+
+# -- REAL runs: trainer integration + the chaos drill ------------------------
+
+
+class TestTrainerLedger:
+    @pytest.fixture(scope="class")
+    def motion_set(self):
+        from pytorch_distributed_rnn_tpu.data import MotionDataset
+        from pytorch_distributed_rnn_tpu.data.synthetic import (
+            generate_har_arrays,
+        )
+
+        X, y = generate_har_arrays(96, seq_length=12, seed=0)
+        return MotionDataset(X, y)
+
+    def _run(self, motion_set, path, faults=None, epochs=2):
+        from pytorch_distributed_rnn_tpu.models import MotionModel
+        from pytorch_distributed_rnn_tpu.training import Trainer
+
+        rec = MetricsRecorder(path, sample_every=1)
+        model = MotionModel(input_dim=9, hidden_dim=8, layer_dim=1,
+                            output_dim=6)
+        trainer = Trainer(
+            model, motion_set, batch_size=48, learning_rate=2.5e-3,
+            seed=SEED, faults=faults, recorder=rec,
+        )
+        try:
+            trainer.train(epochs=epochs)
+        finally:
+            rec.close()
+        return ledger_file(path, peak=PEAK)
+
+    def test_clean_run_ledger(self, motion_set, tmp_path):
+        led = self._run(motion_set, tmp_path / "clean.jsonl")
+        assert _frac_sum(led) == pytest.approx(1.0, abs=FRACTION_TOL)
+        assert led["goodput"] > 0
+        assert led["fault_tax_s"] == 0.0
+        # the trainer costed its own step program analytically
+        assert led["flops_per_step"] and led["flops_per_step"] > 0
+        assert led["mfu_est"] is not None and led["mfu_est"] > 0
+        events = load_events(tmp_path / "clean.jsonl")
+        run = next(e for e in events if e["kind"] == "run_summary")
+        block = run["ledger"]
+        assert block["model_flops_per_step"] > 0
+        assert block["peak_flops_total"] > 0
+        assert "peak_flops_estimated" in block
+
+    def test_chaos_run_pays_fault_tax(self, motion_set, tmp_path):
+        """The acceptance drill in miniature: a stalled run reports a
+        nonzero fault tax and strictly lower goodput than the same run
+        uninterrupted."""
+        from pytorch_distributed_rnn_tpu.resilience import FaultSchedule
+
+        clean = self._run(motion_set, tmp_path / "clean.jsonl")
+        chaos = self._run(
+            motion_set, tmp_path / "chaos.jsonl",
+            faults=FaultSchedule.parse("step:1:stall:0.4"),
+        )
+        # the 0.4s injected stall dominates the tax; proportional
+        # over-attribution scale-down may trim it slightly
+        assert chaos["fault_tax_s"] > 0.2
+        assert chaos["goodput"] < clean["goodput"]
+        assert _frac_sum(chaos) == pytest.approx(1.0, abs=FRACTION_TOL)
+
+    def test_note_recompile_emits_on_cache_growth(self, tmp_path):
+        """The retrace detector: first cache observation is warm-up
+        (silent); later growth emits exactly one compile event."""
+        from pytorch_distributed_rnn_tpu.training.base import Trainer
+
+        class _Stub:
+            recorder = None
+            _trace_cache_seen = {}
+
+        stub = _Stub()
+        stub._trace_cache_seen = {}
+        recorded = []
+
+        class _Rec:
+            def record(self, kind, **kw):
+                recorded.append((kind, kw))
+
+        stub.recorder = _Rec()
+
+        size = [1]
+
+        class _Fn:
+            def _cache_size(self):
+                return size[0]
+
+        fn = _Fn()
+        note = Trainer._note_recompile
+        note(stub, fn, step=0, seconds=0.1, tm=1.0)  # warm-up: silent
+        assert recorded == []
+        note(stub, fn, step=1, seconds=0.01, tm=1.1)  # stable: silent
+        assert recorded == []
+        size[0] = 2
+        note(stub, fn, step=2, seconds=0.8, tm=1.2)  # retrace!
+        assert len(recorded) == 1
+        kind, kw = recorded[0]
+        assert kind == "compile" and kw["cache_size"] == 2
+        assert kw["step"] == 2 and kw["seconds"] == 0.8
+        # a plain function without the probe is ignored
+        note(stub, lambda: None, step=3, seconds=0.1, tm=1.3)
+        assert len(recorded) == 1
