@@ -3,16 +3,19 @@
 ::
 
     python -m pytorch_distributed_rnn_tpu.lint [paths...]
-        [--deep] [--format text|json] [--select PD101,PD201]
-        [--ignore PD103] [--baseline lint_baseline.json | --no-baseline]
+        [--deep] [--no-concurrency] [--format text|json]
+        [--select PD101,PD201] [--ignore PD103] [--stats]
+        [--baseline lint_baseline.json | --no-baseline]
         [--write-baseline | --prune-baseline] [--known-axes dp,tp]
         [--list-rules]
 
-Two layers share one reporting path: the AST rules (PD1xx) always run;
-``--deep`` adds the jaxpr-level rules (PD2xx) by tracing every
-registered trainer entry point on CPU (abstract inputs, no compile, no
-TPU - see ``lint/trace_registry.py``).  Baseline, ``# noqa``,
-select/ignore and the JSON schema apply identically to both layers.
+Three layers share one reporting path: the AST rules (PD1xx) and the
+concurrency lock-discipline rules (PD3xx, ``lint/concurrency.py``,
+skippable with ``--no-concurrency``) always run; ``--deep`` adds the
+jaxpr-level rules (PD2xx) by tracing every registered trainer entry
+point on CPU (abstract inputs, no compile, no TPU - see
+``lint/trace_registry.py``).  Baseline, ``# noqa``, select/ignore and
+the JSON schema apply identically to all layers.
 
 Exit status: 0 = clean (all findings baselined or none), 1 = new
 findings, 2 = usage error.
@@ -30,6 +33,7 @@ from pytorch_distributed_rnn_tpu.lint.baseline import (
     prune_baseline,
     write_baseline,
 )
+from pytorch_distributed_rnn_tpu.lint.concurrency import concurrency_rules
 from pytorch_distributed_rnn_tpu.lint.core import all_rules, run_lint
 from pytorch_distributed_rnn_tpu.lint.jaxpr_pass import deep_rules
 
@@ -72,6 +76,15 @@ def build_parser() -> argparse.ArgumentParser:
         "--deep", action="store_true",
         help="also trace every registered trainer entry point and run "
              "the jaxpr-level PD2xx rules (CPU-only, no compile)")
+    parser.add_argument(
+        "--no-concurrency", action="store_true",
+        help="skip the PD3xx lock-discipline rules (baseline "
+             "write/prune then preserves PD3xx entries, exactly as "
+             "PD2xx entries are preserved without --deep)")
+    parser.add_argument(
+        "--stats", action="store_true",
+        help="append a per-rule count summary (new + baselined) to the "
+             "text output - CI log readability")
     parser.add_argument("--format", choices=("text", "json"),
                         default="text", dest="fmt")
     parser.add_argument("--select", type=_csv, default=None, metavar="RULES",
@@ -102,7 +115,9 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.list_rules:
         for code, rule in sorted({**all_rules(), **deep_rules()}.items()):
-            layer = "jaxpr" if code.startswith("PD2") else "ast"
+            layer = ("jaxpr" if code.startswith("PD2")
+                     else "concurrency" if code.startswith("PD3")
+                     else "ast")
             print(f"{code} [{layer}] {rule.name}: {rule.description}")
         return 0
 
@@ -124,6 +139,14 @@ def main(argv: list[str] | None = None) -> int:
         print(f"pdrnn-lint: --select {', '.join(sorted(deep_selected))} "
               "needs --deep (jaxpr rules only run when the deep pass "
               "traces the registry)", file=sys.stderr)
+        return 2
+
+    # same vacuously-green hazard for the concurrency layer
+    conc_selected = set(args.select or ()) & set(concurrency_rules())
+    if conc_selected and args.no_concurrency:
+        print(f"pdrnn-lint: --select {', '.join(sorted(conc_selected))} "
+              "conflicts with --no-concurrency (the PD3xx layer would "
+              "not run)", file=sys.stderr)
         return 2
 
     # a filtered run sees only a subset of findings; rewriting the
@@ -159,6 +182,7 @@ def main(argv: list[str] | None = None) -> int:
             # repo root), so fingerprints match no matter the cwd
             root=baseline_path.resolve().parent,
             deep=args.deep,
+            concurrency=not args.no_concurrency,
         )
     except FileNotFoundError as e:
         print(f"pdrnn-lint: {e}", file=sys.stderr)
@@ -170,11 +194,14 @@ def main(argv: list[str] | None = None) -> int:
                   f"({skip['reason']})", file=sys.stderr)
 
     if args.write_baseline or args.prune_baseline:
-        # two preservation guards keep a narrowed run from deleting
-        # accepted entries it could not have re-observed: entries for
-        # files outside the linted paths, and PD2xx entries when the
-        # jaxpr layer never ran (no --deep)
+        # preservation guards keep a narrowed run from deleting accepted
+        # entries it could not have re-observed: entries for files
+        # outside the linted paths, PD2xx entries when the jaxpr layer
+        # never ran (no --deep), and PD3xx entries when the concurrency
+        # layer was skipped (--no-concurrency)
         keep_rules = () if args.deep else tuple(deep_rules())
+        if args.no_concurrency:
+            keep_rules = tuple(keep_rules) + tuple(concurrency_rules())
         scanned = _scanned_paths(args.paths, baseline_path)
 
     if args.write_baseline:
@@ -205,6 +232,7 @@ def main(argv: list[str] | None = None) -> int:
             "known_axes": sorted(result.known_axes),
             "counts": result.counts(),
             "baseline_suppressed": result.suppressed,
+            "baseline_suppressed_counts": result.suppressed_counts,
             "findings": [f.to_dict() for f in result.findings],
         }
         if result.deep is not None:
@@ -213,6 +241,17 @@ def main(argv: list[str] | None = None) -> int:
     else:
         for f in result.findings:
             print(f.render())
+        if args.stats:
+            # one row per rule that produced anything this run, new and
+            # baselined both - the per-rule view a CI log can grep
+            rows = sorted(set(result.counts())
+                          | set(result.suppressed_counts))
+            print("rule    new  baselined")
+            for code in rows:
+                print(f"{code}  {result.counts().get(code, 0):>5}  "
+                      f"{result.suppressed_counts.get(code, 0):>9}")
+            if not rows:
+                print("(no findings in any rule)")
         summary = (
             f"pdrnn-lint: {len(result.findings)} finding(s) in "
             f"{result.files} file(s)"

@@ -214,7 +214,10 @@ def register(code: str, name: str, description: str):
 
 
 def all_rules() -> dict[str, Rule]:
-    # import for side effect: rule registration
+    # import for side effect: rule registration (PD1xx AST rules and the
+    # PD3xx concurrency layer; the PD2xx jaxpr layer keeps its own
+    # registry in lint/jaxpr_pass.py because its check signature differs)
+    from pytorch_distributed_rnn_tpu.lint import concurrency  # noqa: F401
     from pytorch_distributed_rnn_tpu.lint import rules  # noqa: F401
 
     return dict(_REGISTRY)
@@ -252,6 +255,8 @@ class LintResult:
     known_axes: set[str]
     files: int
     deep: dict | None = None  # jaxpr-pass stats when run with deep=True
+    # per-rule count of baseline-suppressed findings (--stats)
+    suppressed_counts: dict = field(default_factory=dict)
 
     def counts(self) -> dict[str, int]:
         out: dict[str, int] = {}
@@ -276,6 +281,7 @@ def run_lint(
     baseline: dict[str, int] | None = None,
     root: str | Path | None = None,
     deep: bool = False,
+    concurrency: bool = True,
 ) -> LintResult:
     """Lint ``paths`` (files or directories) and return the result.
 
@@ -285,7 +291,10 @@ def run_lint(
     files themselves.  ``deep=True`` additionally traces every
     registered trainer entry point and runs the jaxpr-level PD2xx rules
     (:mod:`.jaxpr_pass`); deep findings ride the same noqa/baseline/
-    select machinery.
+    select machinery.  ``concurrency=False`` skips the PD3xx
+    lock-discipline layer (:mod:`.concurrency`), mirroring how the
+    PD2xx layer is absent without ``deep`` - the CLI's baseline
+    write/prune then preserves PD3xx entries instead of dropping them.
     """
     from pytorch_distributed_rnn_tpu.lint.axes import collect_known_axes
     from pytorch_distributed_rnn_tpu.lint.baseline import apply_baseline
@@ -312,6 +321,12 @@ def run_lint(
 
     rules = all_rules()
     active = set(rules)
+    if not concurrency:
+        from pytorch_distributed_rnn_tpu.lint.concurrency import (
+            concurrency_rules,
+        )
+
+        active -= set(concurrency_rules())
     if select:
         active &= set(select)
     if ignore:
@@ -358,6 +373,15 @@ def run_lint(
 
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     new, suppressed = apply_baseline(findings, baseline or {})
+    # per-rule suppressed counts: the multiset difference between all
+    # findings and the surviving ones (--stats renders this)
+    suppressed_counts: dict[str, int] = {}
+    survivor_ids = {id(f) for f in new}
+    for f in findings:
+        if id(f) not in survivor_ids:
+            suppressed_counts[f.rule] = suppressed_counts.get(f.rule, 0) + 1
     return LintResult(findings=new, suppressed=suppressed,
                       known_axes=index.known_axes, files=len(files),
-                      deep=deep_stats)
+                      deep=deep_stats,
+                      suppressed_counts=dict(sorted(
+                          suppressed_counts.items())))

@@ -65,6 +65,8 @@ import time
 from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from pytorch_distributed_rnn_tpu.utils import threadcheck
+
 log = logging.getLogger(__name__)
 
 _DEFAULT_STALE_AFTER_S = 5.0
@@ -144,7 +146,7 @@ class Aggregator:
         # are recorded as ``alert`` events into ITS sidecar, marked
         # fleet=True so the local exporter does not echo them back
         self.recorder = recorder
-        self._lock = threading.Lock()
+        self._lock = threadcheck.lock(threading.Lock(), "aggregator.fleet")  # guards: _peers, _events, _seen_alert_seq, _peer_pids, _straggling, _fleet_seq
         self._peers: dict[str, dict] = {}  # id -> {digest, received_tm}
         self._events: deque[dict] = deque(maxlen=int(events_maxlen))
         self._seen_alert_seq: dict[str, int] = {}

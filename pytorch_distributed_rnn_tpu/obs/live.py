@@ -78,6 +78,7 @@ import time
 from collections import deque
 
 from pytorch_distributed_rnn_tpu.obs.summary import percentile
+from pytorch_distributed_rnn_tpu.utils import threadcheck
 
 log = logging.getLogger(__name__)
 
@@ -110,7 +111,7 @@ class RollingWindow:
                  maxlen: int = 4096):
         self.horizon_s = float(horizon_s)
         self._items: deque[tuple[float, float]] = deque(maxlen=int(maxlen))
-        self._lock = threading.Lock()
+        self._lock = threadcheck.lock(threading.Lock(), "live.window")  # guards: _items
         self._created = time.perf_counter()
 
     def observe(self, value: float, tm: float | None = None) -> None:
@@ -119,7 +120,7 @@ class RollingWindow:
             self._items.append((now, float(value)))
             self._evict(now)
 
-    def _evict(self, now: float) -> None:
+    def _evict(self, now: float) -> None:  # holds: _lock
         cutoff = now - self.horizon_s
         items = self._items
         while items and items[0][0] < cutoff:
@@ -233,7 +234,7 @@ class LiveExporter:
         self.data_wait_s = RollingWindow()
         self.queue_depth = RollingWindow()
 
-        self._lock = threading.Lock()
+        self._lock = threadcheck.lock(threading.Lock(), "live.exporter")  # guards: _steps_total, _nan_skips, _faults, _alerts_total, _alerts
         self._steps_total = 0
         self._nan_skips = 0
         self._faults: dict[str, int] = {}

@@ -146,6 +146,7 @@ import time
 from pathlib import Path
 
 from pytorch_distributed_rnn_tpu.obs.spans import NULL_SPAN, Span
+from pytorch_distributed_rnn_tpu.utils import threadcheck
 
 log = logging.getLogger(__name__)
 
@@ -243,8 +244,9 @@ class MetricsRecorder:
         self.sample_every = int(sample_every)
         self.path = rank_suffixed(path, self.rank)
         self.path.parent.mkdir(parents=True, exist_ok=True)
-        self._lock = threading.Lock()  # buffer swap (record vs drain)
-        self._io_lock = threading.Lock()  # file append (drain vs drain)
+        # lock-order: MetricsRecorder._io_lock -> MetricsRecorder._lock
+        self._lock = threadcheck.lock(threading.Lock(), "recorder.buffer")  # guards: _buffer
+        self._io_lock = threadcheck.lock(threading.Lock(), "recorder.io")
         self._buffer: list[dict] = []
         self._flush_threshold = int(flush_threshold)
         self._wake = threading.Event()
@@ -286,6 +288,10 @@ class MetricsRecorder:
             target=self._writer, name="pdrnn-metrics", daemon=True
         )
         self._thread.start()
+        if threadcheck.installed():
+            # the sentinel's violation alerts land in THIS sidecar, and
+            # its faulthandler dumps next to it (stacks_path_for)
+            threadcheck.install(recorder=self)
 
     # -- construction --------------------------------------------------------
 

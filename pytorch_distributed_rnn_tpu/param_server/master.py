@@ -43,6 +43,7 @@ import numpy as np
 
 from pytorch_distributed_rnn_tpu.param_server import protocol
 from pytorch_distributed_rnn_tpu.resilience import membership
+from pytorch_distributed_rnn_tpu.utils import threadcheck
 
 log = logging.getLogger(__name__)
 
@@ -93,7 +94,7 @@ class ParameterServerMaster:
         self.elastic = bool(elastic)
         self.join_timeout = float(join_timeout)
         self.max_world = max_world
-        self.lock = threading.Lock()
+        self.lock = threadcheck.lock(threading.Lock(), "master.round")
         self.num_params = int(flat_params.size)
         self.updates_applied = 0
         self.degraded_rounds = 0
@@ -129,10 +130,15 @@ class ParameterServerMaster:
         # holds the lock through its _mark_dead, so the mark always
         # lands BEFORE the replacement thread exists (and thus before
         # the new incarnation can REGISTER), never after.
+        # the acquisition-order contract (a dying service thread holds
+        # _gen_lock through _mark_dead, which takes the round lock and
+        # then the roster's; nothing may ever take them the other way):
+        # lock-order: ParameterServerMaster._gen_lock -> ParameterServerMaster.lock -> Roster._lock
         self._thread_gen: dict[int, int] = {}
-        self._gen_lock = threading.Lock()
+        self._gen_lock = threadcheck.lock(threading.Lock(), "master.gen")  # guards: _thread_gen
         self._tolerated: dict[int, BaseException] = {}
-        self._member_cv = threading.Condition()
+        self._member_cv = threading.Condition(
+            threadcheck.lock(threading.Lock(), "master.member"))
 
     def serve(self):
         """Block until the roster reaches a terminal state: every member
@@ -315,12 +321,15 @@ class ParameterServerMaster:
 
     def _serve_worker(self, worker: int, gen: int | None = None):
         while True:
-            if gen is not None and self._thread_gen.get(worker) != gen:
-                # the rank's socket slot was re-accepted while this
-                # thread was processing a request: the NEW fd belongs to
-                # the replacement thread - exit instead of racing it on
-                # the wire framing
-                return
+            if gen is not None:
+                with self._gen_lock:
+                    stale = self._thread_gen.get(worker) != gen
+                if stale:
+                    # the rank's socket slot was re-accepted while this
+                    # thread was processing a request: the NEW fd belongs
+                    # to the replacement thread - exit instead of racing
+                    # it on the wire framing
+                    return
             opcode, grads, seq = protocol.recv_request(
                 self.comm, worker, self.num_params
             )
@@ -339,7 +348,13 @@ class ParameterServerMaster:
                 return
             if opcode == protocol.OP_PULL:
                 with self.lock:
-                    protocol.send_params(self.comm, worker, self.params)
+                    # hold contract: the reply must carry the params it
+                    # was snapshotted against; sending outside the lock
+                    # could interleave with a concurrent update and ship
+                    # a half-applied view (per-worker sockets keep the
+                    # send short and uncontended)
+                    protocol.send_params(self.comm, worker,  # noqa: PD302 - deliberate send-under-lock, see comment
+                                         self.params)
                 continue
             # OP_PUSH
             member = self.roster.member_for_rank(worker)
@@ -376,7 +391,9 @@ class ParameterServerMaster:
                     "with current params without re-applying"
                 )
                 with self.lock:
-                    protocol.send_params(self.comm, worker, self.params)
+                    # same hold contract as the OP_PULL reply above
+                    protocol.send_params(self.comm, worker,  # noqa: PD302 - deliberate send-under-lock, see OP_PULL
+                                         self.params)
                 continue
             assert grads is not None and grads.size == self.num_params, (
                 f"worker {worker} pushed a malformed gradient"
@@ -396,7 +413,8 @@ class ParameterServerMaster:
                     t0 = time.perf_counter()
                     self.params = self.apply_update(grads)
                     self.updates_applied += 1
-                    protocol.send_params(self.comm, worker, self.params)
+                    protocol.send_params(self.comm, worker,  # noqa: PD302 - reply must pair with the update just applied; see OP_PULL contract
+                                         self.params)
                     applied = self.updates_applied
                     if self.recorder.enabled:
                         self.recorder.emit_span(
@@ -437,7 +455,7 @@ class ParameterServerMaster:
         with self._member_cv:
             self._member_cv.notify_all()
 
-    def _close_round(self, degraded: bool = False):
+    def _close_round(self, degraded: bool = False):  # holds: lock
         """Average the gathered gradients, apply ONE update, reply to
         every worker owed fresh params, wake the waiters.  Caller holds
         the lock."""

@@ -41,6 +41,8 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from pytorch_distributed_rnn_tpu.utils import threadcheck
+
 log = logging.getLogger(__name__)
 
 # member lifecycle states
@@ -89,7 +91,10 @@ class Roster:
         from pytorch_distributed_rnn_tpu.obs.recorder import NULL_RECORDER
 
         self.recorder = recorder if recorder is not None else NULL_RECORDER
-        self._lock = threading.Lock()
+        # a LEAF lock by contract: roster methods never call out
+        # while holding it (the master/learner take their round
+        # lock first, never the other way around)
+        self._lock = threadcheck.lock(threading.Lock(), "roster.members")  # guards: _members, _by_rank
         self._members: dict[int, Member] = {}
         self._by_rank: dict[int, int] = {}
         self.rejoins = 0

@@ -38,6 +38,14 @@ the buffer window is unrecoverable loss and raises
 A restarted stage derives its watermarks from its own checkpoint
 (``resume_step * microbatches``) instead of persisting transport
 state: the checkpoint already IS the replay cursor.
+
+Concurrency contract (PD3xx): a :class:`LinkEnd` is SINGLE-OWNER - it
+is constructed, driven, and reconnected by exactly one stage thread,
+so it holds no locks at all and never appears in the lock-order graph
+(``lint/concurrency.py``).  Anyone adding a second thread here (an
+async prefetcher, a heartbeat) must add a lock via
+``utils/threadcheck.lock`` and declare its order against the
+recorder's, not bolt on bare state.
 """
 
 from __future__ import annotations

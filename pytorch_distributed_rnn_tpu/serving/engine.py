@@ -60,6 +60,7 @@ from pytorch_distributed_rnn_tpu.serving.scheduler import (
     ContinuousBatcher,
     ServeRequest,
 )
+from pytorch_distributed_rnn_tpu.utils import threadcheck
 
 log = logging.getLogger(__name__)
 
@@ -136,7 +137,8 @@ class ServingEngine:
         self.faults = faults
         if faults is not None and getattr(recorder, "enabled", False):
             faults.recorder = recorder
-        self._work = threading.Condition(threading.Lock())
+        self._work = threading.Condition(
+            threadcheck.lock(threading.Lock(), "engine.work"))
         self._closed = False
 
         # jit construction happens HERE, never in the serve loop; the
@@ -180,10 +182,11 @@ class ServingEngine:
         self._tokens_out = 0
         self._requests_done = 0
         self._started_tm = time.perf_counter()
-        # guards the stat deques: the engine thread appends while
-        # connection threads iterate them in stats() (an unguarded
-        # deque raises "mutated during iteration" mid-sort)
-        self._stats_lock = threading.Lock()
+        # guards the stat deques AND the scalar counters: the engine
+        # thread mutates while connection threads read in stats() (an
+        # unguarded deque raises "mutated during iteration" mid-sort;
+        # unguarded counters tear a snapshot across a step)
+        self._stats_lock = threadcheck.lock(threading.Lock(), "engine.stats")  # guards: _latencies, _ttfts, _queue_waits, _queue_depths, _steps, _tokens_out, _requests_done, _requests_failed, _chaos_exceptions
         self._latencies: deque[float] = deque(maxlen=_REQUEST_WINDOW)
         self._ttfts: deque[float] = deque(maxlen=_REQUEST_WINDOW)
         self._queue_waits: deque[float] = deque(maxlen=_REQUEST_WINDOW)
@@ -300,8 +303,9 @@ class ServingEngine:
         if not active:
             return False
 
-        step_index = self._steps
-        self._steps += 1
+        with self._stats_lock:
+            step_index = self._steps
+            self._steps += 1
         if self.faults is not None:
             self._apply_faults(step_index)
         t0 = time.perf_counter()
@@ -376,13 +380,14 @@ class ServingEngine:
         if error is not None:
             request.status = "error"
             request.error = error
-            self._requests_failed += 1
         else:
             request.status = "done"
-        self._requests_done += 1
-        self._tokens_out += len(request.tokens)
         self._completions.observe(len(request.tokens))
         with self._stats_lock:
+            if error is not None:
+                self._requests_failed += 1
+            self._requests_done += 1
+            self._tokens_out += len(request.tokens)
             if request.latency_s is not None:
                 self._latencies.append(request.latency_s)
             if request.ttft_s is not None:
@@ -407,7 +412,8 @@ class ServingEngine:
         try:
             self.faults.on_producer_item(step_index)
         except ChaosError as exc:
-            self._chaos_exceptions += 1
+            with self._stats_lock:
+                self._chaos_exceptions += 1
             log.warning(f"serving: absorbed injected failure: {exc}")
         if self.faults.has_step_events:
             logits, _ = self.faults.corrupt_batch(
@@ -458,18 +464,23 @@ class ServingEngine:
             ttft = sorted(self._ttfts)
             waits = sorted(self._queue_waits)
             depths = sorted(self._queue_depths)
+            steps = self._steps
+            requests_done = self._requests_done
+            requests_failed = self._requests_failed
+            tokens_out = self._tokens_out
+            chaos_absorbed = self._chaos_exceptions
         elapsed = time.perf_counter() - self._started_tm
         return {
-            "steps": self._steps,
-            "requests": self._requests_done,
+            "steps": steps,
+            "requests": requests_done,
             "requests_shed": self.batcher.shed,
             # every errored completion: non-finite logits, decode-loop
             # recovery, shutdown mid-decode
-            "requests_failed": self._requests_failed,
+            "requests_failed": requests_failed,
             "queue_depth": self.batcher.queue_depth,
             "active": self.batcher.active_count,
-            "tokens_out": self._tokens_out,
-            "tokens_per_s": self._tokens_out / elapsed if elapsed > 0
+            "tokens_out": tokens_out,
+            "tokens_per_s": tokens_out / elapsed if elapsed > 0
             else None,
             # rolling-window rates (last RATE_HORIZON_S seconds, honest
             # early in the run: the divisor is the window's actual age)
@@ -487,7 +498,7 @@ class ServingEngine:
             "queue_depth_p95": percentile(depths, 0.95) if depths
             else None,
             "queue_depth_max": depths[-1] if depths else None,
-            "chaos_absorbed": self._chaos_exceptions,
+            "chaos_absorbed": chaos_absorbed,
             "trace_counts": dict(self._trace_counts),
         }
 

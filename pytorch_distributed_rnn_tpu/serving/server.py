@@ -29,6 +29,7 @@ from pytorch_distributed_rnn_tpu.serving.protocol import (
     tokens_to_text,
 )
 from pytorch_distributed_rnn_tpu.serving.scheduler import ServeRequest
+from pytorch_distributed_rnn_tpu.utils import threadcheck
 
 log = logging.getLogger(__name__)
 
@@ -108,7 +109,7 @@ class ServingServer:
             handler.start()
 
     def _handle(self, conn: socket.socket):
-        wlock = threading.Lock()
+        wlock = threadcheck.lock(threading.Lock(), "server.conn.write")
         alive = {"ok": True}
 
         def send(obj: dict):

@@ -47,6 +47,7 @@ import numpy as np
 
 from pytorch_distributed_rnn_tpu.param_server import protocol
 from pytorch_distributed_rnn_tpu.resilience import membership
+from pytorch_distributed_rnn_tpu.utils import threadcheck
 
 log = logging.getLogger(__name__)
 
@@ -91,7 +92,7 @@ class ExperienceLearner:
         # reply that quotes the version (STATE_SYNC, PARAMS_AT, verdicts)
         # reads both together, so an actor can never stamp new params
         # with an old version number
-        self.lock = threading.Lock()
+        self.lock = threadcheck.lock(threading.Lock(), "learner.state")  # guards: params, opt_state, version, accepted, duplicates, stale_rejected, queue_sheds, poisoned
         self.version = int(version)
         # the bounded ingest queue - the backpressure boundary.  Service
         # threads put_nowait; only the apply loop gets.
@@ -119,10 +120,12 @@ class ExperienceLearner:
         # elastic service-thread bookkeeping (master.py idiom): a stale
         # thread dying after its rank was re-accepted must not mark the
         # NEW incarnation dead
+        # lock-order: StreamingLearner._gen_lock -> StreamingLearner.lock -> Roster._lock
         self._thread_gen: dict[int, int] = {}
-        self._gen_lock = threading.Lock()
+        self._gen_lock = threadcheck.lock(threading.Lock(), "learner.gen")  # guards: _thread_gen
         self._tolerated: dict[int, BaseException] = {}
-        self._member_cv = threading.Condition()
+        self._member_cv = threading.Condition(
+            threadcheck.lock(threading.Lock(), "learner.member"))
 
     # -- ingest verdict ------------------------------------------------------
 
@@ -152,11 +155,13 @@ class ExperienceLearner:
         with self.lock:
             current = self.version
         if seq <= member.push_seq:
-            self.duplicates += 1
+            with self.lock:
+                self.duplicates += 1
             self._reject("duplicate", member, seq, version, current)
             return protocol.EXP_DUPLICATE, current, 0.0
         if version < current - self.max_staleness:
-            self.stale_rejected += 1
+            with self.lock:
+                self.stale_rejected += 1
             self._reject("stale", member, seq, version, current)
             return protocol.EXP_STALE, current, 0.0
         item = (member.worker_id, seq, version,
@@ -164,11 +169,13 @@ class ExperienceLearner:
         try:
             self.queue.put_nowait(item)
         except queue_mod.Full:
-            self.queue_sheds += 1
+            with self.lock:
+                self.queue_sheds += 1
             self._reject("backoff", member, seq, version, current)
             return protocol.EXP_BACKOFF, current, self.throttle_hint_s
         self.roster.note_push(rank, seq)
-        self.accepted += 1
+        with self.lock:
+            self.accepted += 1
         return protocol.EXP_OK, current, 0.0
 
     def _reject(self, reason: str, member, seq: int, version: int,
@@ -195,7 +202,8 @@ class ExperienceLearner:
             # on what is APPLIED, so refuse here too - counted, and the
             # watermark already covers the seq so the actor (correctly)
             # does not re-send this batch
-            self.stale_rejected += 1
+            with self.lock:
+                self.stale_rejected += 1
             if self.recorder.enabled:
                 self.recorder.record(
                     "experience_reject", reason="stale_at_apply",
@@ -208,7 +216,8 @@ class ExperienceLearner:
         ).all():
             # a poisoned batch (chaos nan injection, torn payload) must
             # not kill the learner mid-fleet: count and drop, loudly
-            self.poisoned += 1
+            with self.lock:
+                self.poisoned += 1
             log.warning(
                 f"dropping poisoned experience batch: worker-id "
                 f"{worker_id} seq {seq} (size {payload.size}, "
@@ -277,13 +286,14 @@ class ExperienceLearner:
         )
 
     def counters(self) -> dict:
-        return {
-            "accepted": self.accepted,
-            "duplicates": self.duplicates,
-            "stale_rejected": self.stale_rejected,
-            "queue_sheds": self.queue_sheds,
-            "poisoned": self.poisoned,
-        }
+        with self.lock:
+            return {
+                "accepted": self.accepted,
+                "duplicates": self.duplicates,
+                "stale_rejected": self.stale_rejected,
+                "queue_sheds": self.queue_sheds,
+                "poisoned": self.poisoned,
+            }
 
     # -- wire service --------------------------------------------------------
 
@@ -303,7 +313,7 @@ class ExperienceLearner:
             t0 = time.perf_counter()
             version = self.version
             seq_watermark = member.push_seq
-            protocol.send_state_sync(
+            protocol.send_state_sync(  # noqa: PD302 - deliberate: the reply must quote the params/version pair it snapshotted (see comment above)
                 self.comm, rank, self.params, version, seq_watermark
             )
             if self.recorder.enabled:
@@ -324,7 +334,9 @@ class ExperienceLearner:
 
     def _serve_actor(self, rank: int, gen: int) -> None:
         while True:
-            if self._thread_gen.get(rank) != gen:
+            with self._gen_lock:
+                stale = self._thread_gen.get(rank) != gen
+            if stale:
                 # the rank's socket slot was re-accepted: the new fd
                 # belongs to the replacement thread
                 return
@@ -346,7 +358,10 @@ class ExperienceLearner:
                 return
             if opcode == protocol.OP_PARAMS_AT:
                 with self.lock:
-                    protocol.send_params_at(
+                    # hold contract: version and params are one atomic
+                    # pair; a send outside the lock could quote a version
+                    # the params no longer match
+                    protocol.send_params_at(  # noqa: PD302 - deliberate send-under-lock, see comment
                         self.comm, rank, self.version, self.params
                     )
                 continue
@@ -459,7 +474,8 @@ class ExperienceLearner:
             # the authoritative final state, written synchronously
             self._submit_checkpoint()
         self._summarize(serve_tm0)
-        return self.params
+        with self.lock:
+            return self.params
 
     def _fleet_terminal(self, serve_tm0: float) -> bool:
         members = self.roster.members()
@@ -480,6 +496,11 @@ class ExperienceLearner:
         self.duration_s = duration
         counts = self.roster.counts()
         samples = sorted(self._staleness_samples)
+        # one consistent snapshot of the guarded counters (the service
+        # threads are joined by now, but the guard contract is absolute)
+        cnt = self.counters()
+        with self.lock:
+            version = self.version
 
         def pct(q):
             if not samples:
@@ -489,17 +510,17 @@ class ExperienceLearner:
 
         log.info(
             f"streaming learner done: {self.updates_applied} updates "
-            f"(version {self.version}), {self.accepted} batches "
-            f"accepted, {self.duplicates} duplicate(s), "
-            f"{self.stale_rejected} stale-rejected, {self.queue_sheds} "
-            f"queue shed(s), roster {counts}"
+            f"(version {version}), {cnt['accepted']} batches "
+            f"accepted, {cnt['duplicates']} duplicate(s), "
+            f"{cnt['stale_rejected']} stale-rejected, "
+            f"{cnt['queue_sheds']} queue shed(s), roster {counts}"
         )
         if not self.recorder.enabled:
             return
         self.recorder.record(
             "learner_summary", updates=self.updates_applied,
-            final_version=self.version, rejoins=self.roster.rejoins,
-            **self.counters(),
+            final_version=version, rejoins=self.roster.rejoins,
+            **cnt,
         )
         # the run_summary carries the streaming verdict so
         # `pdrnn-metrics summarize`/`health` read experience rates and
@@ -511,20 +532,20 @@ class ExperienceLearner:
             duration_s=duration,
             steps=self.updates_applied,
             roster=counts, rejoins=self.roster.rejoins,
-            experience_batches=self.accepted,
+            experience_batches=cnt["accepted"],
             experience_per_s=(
-                self.accepted / duration if duration > 0 else 0.0
+                cnt["accepted"] / duration if duration > 0 else 0.0
             ),
             updates_per_s=(
                 self.updates_applied / duration if duration > 0 else 0.0
             ),
-            stale_rejected=self.stale_rejected,
-            queue_sheds=self.queue_sheds,
-            duplicates=self.duplicates,
-            poisoned=self.poisoned,
+            stale_rejected=cnt["stale_rejected"],
+            queue_sheds=cnt["queue_sheds"],
+            duplicates=cnt["duplicates"],
+            poisoned=cnt["poisoned"],
             staleness_p50=pct(0.50),
             staleness_p95=pct(0.95),
-            final_version=self.version,
+            final_version=version,
         )
         self.recorder.flush()
 

@@ -1,0 +1,380 @@
+"""Runtime lock-order / race sentinel (``PDRNN_THREADCHECK``).
+
+The dynamic half of the PD3xx concurrency pass
+(``lint/concurrency.py`` is the static half): where the lint proves
+discipline about the lock acquisitions it can SEE, the sentinel checks
+the ones that actually HAPPEN.  Every lock-using module routes its
+locks through :func:`lock`; with the sentinel off that call returns
+the raw ``threading.Lock`` unchanged - no proxy object, no extra
+thread, no per-acquire bookkeeping, the same zero-overhead-when-off
+doctrine as the recorder/live plane (``obs/recorder.py``'s
+``NULL_RECORDER``).  With ``PDRNN_THREADCHECK=1`` (on in the CI chaos,
+serving and streaming jobs) each lock becomes a :class:`TrackedLock`
+proxy and the sentinel detects, live:
+
+- **lock-order inversions** (the runtime PD303): every blocking
+  acquire adds ``held -> wanted`` edges to a process-wide acquisition
+  graph; a cycle means two threads can deadlock under the right
+  interleaving.  The check runs BEFORE the acquire, so the offending
+  test fails loudly with :class:`LockOrderError` instead of hanging
+  until the job times out.
+- **hold-while-blocking** (the runtime PD302):
+  :func:`assert_unlocked` / :func:`blocking` mark operations that must
+  never run under a lock (socket sends, checkpoint writes,
+  ``block_until_ready``); entering one with a tracked lock held raises
+  :class:`HeldWhileBlockingError`.
+- **long holds**: a lock held past ``PDRNN_THREADCHECK_HOLD_S``
+  (default 5s) emits a warning alert on release - the smoking gun for
+  "serialization sneaked inside the round lock" regressions.
+
+Violations are *structured*: the sentinel records a normal ``alert``
+event (``alert=lock_order_inversion|lock_held_while_blocking|
+lock_long_hold``) through whatever recorder :func:`install` was given,
+flushes it, appends a :mod:`faulthandler` all-thread stack dump via
+the watchdog's sidecar-adjacent stacks file, and *then* raises - the
+post-mortem is on disk before the exception unwinds.  The alert
+payload carries every thread's acquisition stack (lock names + hold
+ages), which is usually enough to name both sides of an inversion
+without opening the faulthandler dump.
+
+Activation is lazy and env-driven: the first :func:`lock` call
+resolves ``PDRNN_THREADCHECK`` once; :func:`install` forces the
+sentinel on (tests, drills) and :func:`uninstall` resets it.  Locks
+created while the sentinel is off stay raw forever - mixing raw and
+tracked locks is safe (raw locks are simply invisible to the graph).
+
+Lock NAMES are contracts: two locks with the same name share a node in
+the order graph, so name locks by role (``"engine.stats"``,
+``"master.round"``), not by instance.  The static pass's
+``# lock-order:`` declarations mirror the edges this sentinel learns
+at runtime.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+
+log = logging.getLogger(__name__)
+
+THREADCHECK_ENV = "PDRNN_THREADCHECK"
+HOLD_ENV = "PDRNN_THREADCHECK_HOLD_S"
+_OFF_VALUES = ("", "0", "false", "off", "no")
+
+
+class LockOrderError(RuntimeError):
+    """A blocking acquire would close a cycle in the acquisition-order
+    graph: some interleaving of the participating threads deadlocks."""
+
+
+class HeldWhileBlockingError(RuntimeError):
+    """A declared-blocking operation started while this thread held a
+    tracked lock (the exact bug class PD302 flags statically)."""
+
+
+class _Sentinel:
+    """Process-wide tracking state.  Its internal mutex is a leaf: it
+    is only ever held for dict/graph surgery, never while touching a
+    user lock, so the sentinel cannot itself deadlock the patient."""
+
+    def __init__(self, recorder=None):
+        from pytorch_distributed_rnn_tpu.obs.recorder import NULL_RECORDER
+
+        self.recorder = recorder if recorder is not None else NULL_RECORDER
+        self._mu = threading.Lock()
+        # name -> set of names acquired while `name` was held
+        self.edges: dict[str, set[str]] = {}
+        # thread ident -> [(lock name, acquire perf_counter), ...]
+        self.held: dict[int, list[tuple[str, float]]] = {}
+        self.hold_warn_s = float(os.environ.get(HOLD_ENV, "5.0"))
+        self.seq = 0
+        self.violations: list[dict] = []
+        self.locks_created = 0
+        # reentrancy latch: alert emission goes through the recorder,
+        # whose OWN locks are tracked - a violation found while already
+        # reporting one must raise bare, not recurse into the reporter
+        self._reporting = threading.local()
+
+    # -- graph ---------------------------------------------------------
+
+    def _reaches(self, src: str, dst: str) -> list[str] | None:
+        """Path src -> ... -> dst over the current edges (caller holds
+        ``_mu``); returns the node path or None."""
+        stack = [(src, [src])]
+        seen = {src}
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            for nxt in self.edges.get(node, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    def before_acquire(self, name: str) -> None:
+        """Order check for a BLOCKING acquire: run before touching the
+        user lock so an inversion raises instead of deadlocking."""
+        ident = threading.get_ident()
+        with self._mu:
+            held = [h for h, _ in self.held.get(ident, ())]
+            cycle = None
+            for h in held:
+                if h == name:
+                    continue  # reentrant same-role acquire (RLock)
+                path = self._reaches(name, h)
+                if path is not None:
+                    cycle = path + [name]
+                    break
+            if cycle is None:
+                for h in held:
+                    if h != name:
+                        self.edges.setdefault(h, set()).add(name)
+        if cycle is not None:
+            self._violation(
+                "lock_order_inversion", LockOrderError,
+                f"acquiring '{name}' while holding {held} closes the "
+                f"order cycle {' -> '.join(cycle)}",
+                wanted=name, held=held, cycle=cycle,
+            )
+
+    def after_acquire(self, name: str) -> None:
+        ident = threading.get_ident()
+        with self._mu:
+            self.held.setdefault(ident, []).append(
+                (name, time.perf_counter()))
+
+    def after_release(self, name: str) -> None:
+        ident = threading.get_ident()
+        held_s = None
+        with self._mu:
+            stack = self.held.get(ident, [])
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i][0] == name:
+                    held_s = time.perf_counter() - stack[i][1]
+                    del stack[i]
+                    break
+        if held_s is not None and held_s > self.hold_warn_s:
+            # warn-only: a long hold is a perf smell, not a deadlock
+            self._alert("lock_long_hold", severity="warn", lock=name,
+                        held_s=round(held_s, 3))
+            log.warning(f"threadcheck: '{name}' held {held_s:.3f}s "
+                        f"(> {self.hold_warn_s}s)")
+
+    def check_unlocked(self, what: str, allow: tuple = ()) -> None:
+        ident = threading.get_ident()
+        with self._mu:
+            held = [h for h, _ in self.held.get(ident, ())
+                    if h not in allow]
+        if held:
+            self._violation(
+                "lock_held_while_blocking", HeldWhileBlockingError,
+                f"blocking operation '{what}' entered while holding "
+                f"{held}", what=what, held=held,
+            )
+
+    # -- reporting -----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Every thread's acquisition stack: lock names + hold ages."""
+        now = time.perf_counter()
+        with self._mu:
+            return {
+                str(ident): [
+                    {"lock": h, "held_s": round(now - t0, 3)}
+                    for h, t0 in stack
+                ]
+                for ident, stack in self.held.items() if stack
+            }
+
+    def _alert(self, kind: str, severity: str = "error", **fields):
+        with self._mu:
+            self.seq += 1
+            seq = self.seq
+        payload = dict(alert=kind, severity=severity, seq=seq,
+                       source="threadcheck", **fields)
+        try:
+            self.recorder.record("alert", **payload)
+            self.recorder.flush()
+        except Exception:  # diagnosis must never kill the patient
+            log.exception("threadcheck: alert emission failed")
+        return payload
+
+    def _violation(self, kind: str, exc_type, msg: str, **fields):
+        if getattr(self._reporting, "active", False):
+            raise exc_type(msg)
+        self._reporting.active = True
+        try:
+            payload = self._alert(kind, severity="error",
+                                  threads=self.snapshot(), **fields)
+            self.violations.append(payload)
+            path = getattr(self.recorder, "path", None)
+            if path is not None:
+                try:
+                    from pytorch_distributed_rnn_tpu.obs import watchdog
+
+                    watchdog.dump_stacks(watchdog.stacks_path_for(path),
+                                         reason=f"threadcheck:{kind}")
+                except Exception:
+                    log.exception("threadcheck: stack dump failed")
+        finally:
+            self._reporting.active = False
+        log.error(f"threadcheck: {msg}")
+        raise exc_type(msg)
+
+
+class TrackedLock:
+    """Order-tracking proxy around a raw lock.
+
+    Deliberately exposes ONLY the waiter-facing surface (``acquire`` /
+    ``release`` / ``locked`` / context manager): no ``_release_save``
+    or ``_is_owned`` delegation, so ``threading.Condition`` wraps it
+    through its stdlib fallback paths - which call ``release()`` and
+    ``acquire()`` right back through this proxy, keeping the held
+    stack symmetric across ``cv.wait()``.
+    """
+
+    __slots__ = ("_raw", "name", "_sentinel")
+
+    def __init__(self, raw, name: str, sentinel: _Sentinel):
+        self._raw = raw
+        self.name = name
+        self._sentinel = sentinel
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if blocking:
+            # a nonblocking probe (Condition._is_owned's fallback uses
+            # acquire(False)) cannot deadlock, so only blocking
+            # acquires feed and consult the order graph
+            self._sentinel.before_acquire(self.name)
+        got = self._raw.acquire(blocking, timeout)
+        if got:
+            self._sentinel.after_acquire(self.name)
+        return got
+
+    def release(self):
+        self._raw.release()
+        self._sentinel.after_release(self.name)
+
+    def locked(self):
+        return self._raw.locked()
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"<TrackedLock {self.name!r} of {self._raw!r}>"
+
+
+# ---------------------------------------------------------------------------
+# module-level switchboard
+
+_STATE: _Sentinel | None = None
+_RESOLVED = False
+
+
+def _state() -> _Sentinel | None:
+    """Lazy env resolve: the first lock() call decides, once.  After
+    that only install()/uninstall() change the answer."""
+    global _STATE, _RESOLVED
+    if not _RESOLVED:
+        _RESOLVED = True
+        if os.environ.get(THREADCHECK_ENV, "").lower() not in _OFF_VALUES:
+            _STATE = _Sentinel()
+    return _STATE
+
+
+def installed() -> bool:
+    return _state() is not None
+
+
+def install(recorder=None) -> _Sentinel:
+    """Force the sentinel on (tests, drills, entrypoints that already
+    resolved a recorder); idempotent - re-install updates the recorder
+    but keeps the learned order graph."""
+    global _STATE, _RESOLVED
+    _RESOLVED = True
+    if _STATE is None:
+        _STATE = _Sentinel(recorder)
+    elif recorder is not None:
+        _STATE.recorder = recorder
+    return _STATE
+
+
+def uninstall() -> None:
+    """Reset to unresolved (tests).  Locks already wrapped stay
+    wrapped but their sentinel stops receiving new installs."""
+    global _STATE, _RESOLVED
+    _STATE = None
+    _RESOLVED = False
+
+
+def lock(raw=None, name: str = "anonymous"):
+    """Route a lock through the sentinel.  Off: returns ``raw``
+    unchanged (identity - no proxy, no overhead).  On: returns a
+    :class:`TrackedLock` participating in the order graph under
+    ``name``."""
+    if raw is None:
+        raw = threading.Lock()
+    st = _state()
+    if st is None:
+        return raw
+    st.locks_created += 1
+    return TrackedLock(raw, name, st)
+
+
+def assert_unlocked(what: str, allow: tuple = ()) -> None:
+    """Declare a must-not-hold point (socket send, checkpoint write,
+    ``block_until_ready``): raises :class:`HeldWhileBlockingError` if
+    this thread holds any tracked lock not in ``allow``.  Off: a
+    single global read."""
+    st = _STATE  # deliberate: no lazy resolve on the hot path
+    if st is not None:
+        st.check_unlocked(what, allow)
+
+
+class blocking:
+    """``with threadcheck.blocking("checkpoint write"):`` - the
+    context-manager spelling of :func:`assert_unlocked`."""
+
+    __slots__ = ("what", "allow")
+
+    def __init__(self, what: str, allow: tuple = ()):
+        self.what = what
+        self.allow = allow
+
+    def __enter__(self):
+        assert_unlocked(self.what, self.allow)
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def held_names() -> tuple:
+    """Lock names the calling thread currently holds (empty when
+    off)."""
+    st = _STATE
+    if st is None:
+        return ()
+    with st._mu:
+        return tuple(h for h, _ in st.held.get(threading.get_ident(), ()))
+
+
+def stats() -> dict:
+    """Sentinel introspection for tests: learned edges, violation
+    count, locks wrapped."""
+    st = _STATE
+    if st is None:
+        return {"installed": False}
+    with st._mu:
+        return {
+            "installed": True,
+            "locks_created": st.locks_created,
+            "edges": {k: sorted(v) for k, v in st.edges.items()},
+            "violations": len(st.violations),
+        }

@@ -1,0 +1,433 @@
+"""PD3xx concurrency lint layer (``lint/concurrency.py``).
+
+Fixture style mirrors ``tests/test_lint.py``: tiny modules written to
+tmp_path and run through :func:`run_lint` with the PD3xx rules
+selected.  The last class pins the real package's accepted contracts:
+the engine's stats counters stay declared-guarded, the hold contracts
+stay annotated, and the whole package stays PD3xx-clean.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from pytorch_distributed_rnn_tpu.lint.concurrency import (
+    CONCURRENCY_RULES,
+    concurrency_rules,
+)
+from pytorch_distributed_rnn_tpu.lint.core import all_rules, run_lint
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+PACKAGE = REPO_ROOT / "pytorch_distributed_rnn_tpu"
+
+PD3 = list(CONCURRENCY_RULES)
+
+PREAMBLE = """\
+import threading
+import socket
+from collections import deque
+"""
+
+
+def lint_src(tmp_path, src, name="fixture.py", select=PD3, **kw):
+    f = tmp_path / name
+    f.write_text(PREAMBLE + src)
+    return run_lint([f], root=tmp_path, select=select, **kw)
+
+
+def codes(result):
+    return [f.rule for f in result.findings]
+
+
+class TestPD301UnguardedSharedAttr:
+    def test_inferred_guard_flags_lockfree_write(self, tmp_path):
+        result = lint_src(tmp_path, """
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def locked_bump(self):
+        with self._lock:
+            self.count += 1
+
+    def racy_bump(self):
+        self.count += 1
+""")
+        assert codes(result) == ["PD301"]
+        (f,) = result.findings
+        assert "count" in f.message and "racy_bump" in f.symbol
+
+    def test_inferred_guard_ignores_lockfree_read(self, tmp_path):
+        # inference is writes-only: read-mostly patterns (stats dumps
+        # after join) stay quiet unless the guard is DECLARED
+        result = lint_src(tmp_path, """
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def locked_bump(self):
+        with self._lock:
+            self.count += 1
+
+    def peek(self):
+        return self.count
+""")
+        assert codes(result) == []
+
+    def test_declared_guard_flags_lockfree_read(self, tmp_path):
+        result = lint_src(tmp_path, """
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()  # guards: count
+        self.count = 0
+
+    def peek(self):
+        return self.count
+""")
+        assert codes(result) == ["PD301"]
+        assert "declared" in result.findings[0].message
+
+    def test_init_writes_are_exempt(self, tmp_path):
+        # construction happens-before publication
+        result = lint_src(tmp_path, """
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()  # guards: count
+        self.count = 0
+""")
+        assert codes(result) == []
+
+    def test_holds_annotation_trusts_caller(self, tmp_path):
+        result = lint_src(tmp_path, """
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()  # guards: count
+
+    def _bump(self):  # holds: _lock
+        self.count += 1
+""")
+        assert codes(result) == []
+
+    def test_locked_suffix_trusts_caller(self, tmp_path):
+        result = lint_src(tmp_path, """
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()  # guards: count
+
+    def _bump_locked(self):
+        self.count += 1
+""")
+        assert codes(result) == []
+
+    def test_mutator_method_call_counts_as_write(self, tmp_path):
+        result = lint_src(tmp_path, """
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = deque()
+
+    def locked_add(self):
+        with self._lock:
+            self.items.append(1)
+
+    def racy_add(self):
+        self.items.append(2)
+""")
+        assert codes(result) == ["PD301"]
+
+    def test_noqa_suppresses(self, tmp_path):
+        result = lint_src(tmp_path, """
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()  # guards: count
+
+    def peek(self):
+        return self.count  # noqa: PD301 - quiescent read after join
+""")
+        assert codes(result) == []
+
+
+class TestPD302BlockingUnderLock:
+    def test_socket_send_under_lock(self, tmp_path):
+        result = lint_src(tmp_path, """
+class Server:
+    def __init__(self, sock):
+        self._lock = threading.Lock()
+        self.sock = sock
+
+    def reply(self, data):
+        with self._lock:
+            self.sock.sendall(data)
+""")
+        assert codes(result) == ["PD302"]
+        assert "sendall" in result.findings[0].message
+
+    def test_thread_join_under_lock(self, tmp_path):
+        result = lint_src(tmp_path, """
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.threads = []
+
+    def stop(self):
+        with self._lock:
+            for t in self.threads:
+                t.join()
+""")
+        assert codes(result) == ["PD302"]
+
+    def test_join_with_args_is_string_join(self, tmp_path):
+        # ",".join(parts) is not a thread join
+        result = lint_src(tmp_path, """
+class Fmt:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def render(self, parts):
+        with self._lock:
+            return ",".join(parts)
+""")
+        assert codes(result) == []
+
+    def test_cv_wait_is_exempt(self, tmp_path):
+        # cv.wait RELEASES the lock while blocking - the one blocking
+        # call that is correct under a lock
+        result = lint_src(tmp_path, """
+class Q:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+
+    def get(self):
+        with self._cv:
+            self._cv.wait()
+""")
+        assert codes(result) == []
+
+    def test_noqa_states_the_hold_contract(self, tmp_path):
+        result = lint_src(tmp_path, """
+class Server:
+    def __init__(self, sock):
+        self._lock = threading.Lock()
+        self.sock = sock
+
+    def reply(self, data):
+        with self._lock:
+            self.sock.sendall(data)  # noqa: PD302 - reply pairs with state under this lock
+""")
+        assert codes(result) == []
+
+
+class TestPD303LockOrderInversion:
+    def test_nested_inversion_across_methods(self, tmp_path):
+        result = lint_src(tmp_path, """
+class TwoLocks:
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+
+    def fwd(self):
+        with self.a:
+            with self.b:
+                pass
+
+    def rev(self):
+        with self.b:
+            with self.a:
+                pass
+""")
+        assert "PD303" in codes(result)
+
+    def test_consistent_order_is_silent(self, tmp_path):
+        result = lint_src(tmp_path, """
+class TwoLocks:
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+
+    def one(self):
+        with self.a:
+            with self.b:
+                pass
+
+    def two(self):
+        with self.a:
+            with self.b:
+                pass
+""")
+        assert codes(result) == []
+
+    def test_declared_edge_conflicts_with_nesting(self, tmp_path):
+        # the module declares A-before-B, but the code nests B-then-A
+        result = lint_src(tmp_path, """
+# lock-order: C.a -> C.b
+
+class C:
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+
+    def rev(self):
+        with self.b:
+            with self.a:
+                pass
+""")
+        assert "PD303" in codes(result)
+
+    def test_call_through_edge(self, tmp_path):
+        # fwd holds a and CALLS helper, which takes b: the edge a->b
+        # exists even though no single method nests both with-blocks
+        result = lint_src(tmp_path, """
+class C:
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+
+    def helper(self):
+        with self.b:
+            pass
+
+    def fwd(self):
+        with self.a:
+            self.helper()
+
+    def rev(self):
+        with self.b:
+            with self.a:
+                pass
+""")
+        assert "PD303" in codes(result)
+
+
+class TestPD304RawAcquireRelease:
+    def test_bare_acquire_flagged(self, tmp_path):
+        result = lint_src(tmp_path, """
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def leaky(self):
+        self._lock.acquire()
+        self.work()
+        self._lock.release()
+""")
+        assert "PD304" in codes(result)
+
+    def test_try_acquire_is_exempt(self, tmp_path):
+        # acquire(False) / acquire(timeout=...) have no with-equivalent
+        result = lint_src(tmp_path, """
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def poll(self):
+        if self._lock.acquire(False):
+            self._lock.release()
+            return True
+        return False
+""")
+        assert codes(result) == []
+
+
+class TestPD305ModuleGlobalFromThread:
+    def test_thread_target_mutating_global_dict(self, tmp_path):
+        result = lint_src(tmp_path, """
+REGISTRY = {}
+
+def worker(key):
+    REGISTRY[key] = 1
+
+def start():
+    threading.Thread(target=worker, args=("x",)).start()
+""")
+        assert codes(result) == ["PD305"]
+
+    def test_guarded_mutation_is_silent(self, tmp_path):
+        result = lint_src(tmp_path, """
+REGISTRY = {}
+_REG_LOCK = threading.Lock()
+
+def worker(key):
+    with _REG_LOCK:
+        REGISTRY[key] = 1
+
+def start():
+    threading.Thread(target=worker, args=("x",)).start()
+""")
+        assert codes(result) == []
+
+    def test_non_target_function_is_silent(self, tmp_path):
+        # only functions actually handed to Thread(target=...) count
+        result = lint_src(tmp_path, """
+REGISTRY = {}
+
+def setup(key):
+    REGISTRY[key] = 1
+""")
+        assert codes(result) == []
+
+
+class TestLayerMechanics:
+    def test_rules_registered_in_shared_registry(self):
+        assert set(concurrency_rules()) == set(PD3)
+        assert set(PD3) <= set(all_rules())
+
+    def test_no_concurrency_skips_the_layer(self, tmp_path):
+        src = """
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def locked_bump(self):
+        with self._lock:
+            self.count += 1
+
+    def racy_bump(self):
+        self.count += 1
+"""
+        hit = lint_src(tmp_path, src, select=None)
+        assert "PD301" in codes(hit)
+        missed = lint_src(tmp_path, src, select=None, concurrency=False)
+        assert "PD301" not in codes(missed)
+
+
+class TestPackageContracts:
+    """Regression pins on the real tree: the races this PR fixed stay
+    fixed, and the accepted hold contracts stay declared."""
+
+    def test_package_is_pd3xx_clean(self):
+        result = run_lint([PACKAGE], root=REPO_ROOT, select=PD3)
+        assert result.findings == [], (
+            "new PD3xx findings:\n"
+            + "\n".join(f.render() for f in result.findings)
+        )
+
+    def test_engine_stats_counters_stay_declared_guarded(self):
+        # the serving stats race (counters written on the engine
+        # thread, read from connection threads) is fixed by declaring
+        # them behind _stats_lock; weakening the declaration would
+        # silently drop the strict read-side enforcement
+        src = (PACKAGE / "serving" / "engine.py").read_text()
+        line = next(l for l in src.splitlines() if "# guards:" in l)
+        for attr in ("_steps", "_tokens_out", "_requests_done",
+                     "_requests_failed", "_chaos_exceptions",
+                     "_latencies"):
+            assert attr in line, f"{attr} no longer declared guarded"
+
+    def test_thread_gen_reads_stay_under_gen_lock(self):
+        # master/learner stale-generation checks must read _thread_gen
+        # under _gen_lock (the acceptor's bump races the check)
+        for rel in ("param_server/master.py", "streaming/learner.py"):
+            src = (PACKAGE / rel).read_text()
+            assert "# guards: _thread_gen" in src, rel
+
+    def test_deliberate_send_under_lock_sites_stay_annotated(self):
+        # the documented hold contracts carry noqa + rationale, not
+        # silence: stripping the comment must resurface PD302
+        master = (PACKAGE / "param_server" / "master.py").read_text()
+        assert master.count("noqa: PD302") == 3
+        learner = (PACKAGE / "streaming" / "learner.py").read_text()
+        assert learner.count("noqa: PD302") == 2

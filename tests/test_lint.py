@@ -361,7 +361,8 @@ def todo():
         assert rc == 1
         report = json.loads(capsys.readouterr().out)
         assert set(report) == {"version", "files", "known_axes", "counts",
-                               "baseline_suppressed", "findings"}
+                               "baseline_suppressed",
+                               "baseline_suppressed_counts", "findings"}
         assert report["files"] == 1
         assert report["counts"] == {"PD101": 1, "PD105": 1}
         assert {"dp", "tp"} <= set(report["known_axes"])
@@ -578,7 +579,9 @@ class TestPackageGate:
 
     def test_all_rules_registered(self):
         assert sorted(all_rules()) == ["PD101", "PD102", "PD103",
-                                       "PD104", "PD105"]
+                                       "PD104", "PD105",
+                                       "PD301", "PD302", "PD303",
+                                       "PD304", "PD305"]
 
     def test_package_has_zero_non_baselined_findings(self):
         baseline = load_baseline(BASELINE)

@@ -1,0 +1,424 @@
+"""Runtime lock-order / race sentinel (``utils/threadcheck.py``).
+
+Three layers of pins:
+
+- **zero-overhead-when-off** - the same doctrine (and test idioms) as
+  the recorder/live plane: no proxy objects, no extra threads, and a
+  byte-identical trainer step jaxpr with the sentinel installed;
+- **sentinel semantics** - inversion detection BEFORE the acquire
+  (raise, not deadlock), hold-while-blocking, Condition wrapping
+  through the proxy, structured alert + faulthandler dump through the
+  obs sidecar path;
+- **chaos drill** - hammer the serving ``stats`` op during decode and
+  a concurrent aggregator scrape with the sentinel live: the clean run
+  stays alert-free, a seeded inversion is detected and dumped.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_rnn_tpu.utils import threadcheck
+
+
+@pytest.fixture(autouse=True)
+def _reset_sentinel(monkeypatch):
+    """Every test starts unresolved with the env clear; no sentinel
+    state leaks across tests (or out into the rest of the suite)."""
+    monkeypatch.delenv(threadcheck.THREADCHECK_ENV, raising=False)
+    threadcheck.uninstall()
+    yield
+    threadcheck.uninstall()
+
+
+# -- zero overhead when off ---------------------------------------------------
+
+
+class TestZeroOverheadOff:
+    def test_lock_is_identity_when_off(self):
+        raw = threading.Lock()
+        assert threadcheck.lock(raw, "x") is raw
+        assert not threadcheck.installed()
+        assert threadcheck.stats() == {"installed": False}
+
+    def test_wired_modules_get_raw_locks_when_off(self, tmp_path):
+        # the engine/recorder wiring must cost nothing with the env
+        # unset: their lock attributes are the stdlib types, not proxies
+        from pytorch_distributed_rnn_tpu.obs.recorder import (
+            MetricsRecorder,
+        )
+
+        rec = MetricsRecorder(tmp_path / "m.jsonl")
+        try:
+            assert not isinstance(rec._lock, threadcheck.TrackedLock)
+            assert not isinstance(rec._io_lock, threadcheck.TrackedLock)
+        finally:
+            rec.close()
+
+    def test_off_means_no_new_threads(self):
+        before = {t.name for t in threading.enumerate()}
+        lk = threadcheck.lock(threading.Lock(), "t")
+        with lk:
+            threadcheck.assert_unlocked("noop")
+        after = {t.name for t in threading.enumerate()} - before
+        assert not after, after
+
+    def test_assert_unlocked_is_noop_when_off(self):
+        lk = threadcheck.lock(threading.Lock(), "t")
+        with lk:
+            threadcheck.assert_unlocked("anything")  # must not raise
+        with threadcheck.blocking("anything"):
+            pass
+
+    def test_trainer_jaxpr_is_byte_identical_under_sentinel(self):
+        """The sentinel must not touch the step program: the trainer
+        builds the same jaxpr bytes with threadcheck installed (same
+        pin style as the recorder/live guards)."""
+        import jax
+
+        from pytorch_distributed_rnn_tpu.data import MotionDataset
+        from pytorch_distributed_rnn_tpu.data.synthetic import (
+            generate_har_arrays,
+        )
+        from pytorch_distributed_rnn_tpu.models import MotionModel
+        from pytorch_distributed_rnn_tpu.training import Trainer
+
+        X, y = generate_har_arrays(48, seq_length=12, seed=0)
+        train_set = MotionDataset(X, y)
+        model = lambda: MotionModel(input_dim=9, hidden_dim=8,  # noqa: E731
+                                    layer_dim=1, output_dim=6)
+        features = np.asarray(train_set.features)
+        labels = np.asarray(train_set.labels).reshape(-1)
+        idx = np.arange(24)
+
+        def jaxpr():
+            t = Trainer(model(), train_set, batch_size=24,
+                        learning_rate=2.5e-3, seed=7)
+            return str(jax.make_jaxpr(t._make_idx_train_step())(
+                t.params, t.opt_state, features, labels, idx
+            ))
+
+        plain = jaxpr()
+        threadcheck.install()
+        checked = jaxpr()
+        assert plain == checked
+
+
+# -- sentinel semantics -------------------------------------------------------
+
+
+class TestSentinel:
+    def test_env_resolves_lazily(self, monkeypatch):
+        monkeypatch.setenv(threadcheck.THREADCHECK_ENV, "1")
+        threadcheck.uninstall()  # back to unresolved with the env set
+        lk = threadcheck.lock(threading.Lock(), "env")
+        assert isinstance(lk, threadcheck.TrackedLock)
+        assert threadcheck.installed()
+
+    def test_inversion_raises_before_deadlock(self):
+        threadcheck.install()
+        a = threadcheck.lock(threading.Lock(), "A")
+        b = threadcheck.lock(threading.Lock(), "B")
+        with a:
+            with b:
+                pass
+        caught = []
+
+        def invert():
+            try:
+                with b:
+                    with a:
+                        pass
+            except threadcheck.LockOrderError as exc:
+                caught.append(exc)
+
+        t = threading.Thread(target=invert)
+        t.start()
+        t.join(timeout=5)
+        assert not t.is_alive(), "inversion deadlocked instead of raising"
+        (exc,) = caught
+        assert "A" in str(exc) and "B" in str(exc)
+        assert threadcheck.stats()["violations"] == 1
+
+    def test_consistent_order_stays_silent(self):
+        threadcheck.install()
+        a = threadcheck.lock(threading.Lock(), "A")
+        b = threadcheck.lock(threading.Lock(), "B")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert threadcheck.stats()["violations"] == 0
+        assert threadcheck.stats()["edges"] == {"A": ["B"]}
+
+    def test_hold_while_blocking_raises(self):
+        threadcheck.install()
+        lk = threadcheck.lock(threading.Lock(), "L")
+        with pytest.raises(threadcheck.HeldWhileBlockingError):
+            with lk:
+                threadcheck.assert_unlocked("socket send")
+
+    def test_allow_list_permits_declared_holds(self):
+        threadcheck.install()
+        lk = threadcheck.lock(threading.Lock(), "L")
+        with lk:
+            threadcheck.assert_unlocked("reply send", allow=("L",))
+
+    def test_condition_wrapping_keeps_held_stack_symmetric(self):
+        threadcheck.install()
+        lk = threadcheck.lock(threading.Lock(), "cv.lock")
+        cv = threading.Condition(lk)
+        seen = []
+
+        def waiter():
+            with cv:
+                cv.wait(timeout=2)
+                seen.append(threadcheck.held_names())
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.1)
+        with cv:
+            cv.notify_all()
+        t.join(timeout=5)
+        assert seen == [("cv.lock",)]
+        assert threadcheck.held_names() == ()
+
+    def test_nonblocking_acquire_skips_order_check(self):
+        # Condition._is_owned probes with acquire(False); a probe can
+        # never deadlock, so it must not poison the order graph
+        threadcheck.install()
+        a = threadcheck.lock(threading.Lock(), "A")
+        b = threadcheck.lock(threading.Lock(), "B")
+        with a:
+            with b:
+                pass
+        with b:
+            assert a.acquire(False)
+            a.release()
+        assert threadcheck.stats()["violations"] == 0
+
+    def test_violation_emits_alert_and_stack_dump(self, tmp_path):
+        """The structured post-mortem: the alert event lands in the
+        sidecar (flushed while the run is wedged) and a faulthandler
+        dump appears next to it - the watchdog's path."""
+        from pytorch_distributed_rnn_tpu.obs.recorder import (
+            MetricsRecorder,
+        )
+        from pytorch_distributed_rnn_tpu.obs.watchdog import (
+            stacks_path_for,
+        )
+
+        threadcheck.install()
+        rec = MetricsRecorder(tmp_path / "m.jsonl")  # self-registers
+        try:
+            a = threadcheck.lock(threading.Lock(), "A")
+            b = threadcheck.lock(threading.Lock(), "B")
+            with a:
+                with b:
+                    pass
+            with pytest.raises(threadcheck.LockOrderError):
+                with b:
+                    with a:
+                        pass
+        finally:
+            rec.close()
+        events = [json.loads(line) for line in
+                  (tmp_path / "m.jsonl").read_text().splitlines()]
+        (alert,) = [e for e in events
+                    if e["kind"] == "alert"
+                    and e.get("alert") == "lock_order_inversion"]
+        assert alert["source"] == "threadcheck"
+        assert alert["wanted"] == "A" and alert["held"] == ["B"]
+        assert "A" in alert["cycle"] and "B" in alert["cycle"]
+        # every thread's acquisition stack rides the alert
+        assert any(s and s[0]["lock"] == "B"
+                   for s in alert["threads"].values())
+        stacks = stacks_path_for(tmp_path / "m.jsonl")
+        assert stacks.exists()
+        assert "threadcheck:lock_order_inversion" in stacks.read_text()
+
+    def test_long_hold_emits_warning_alert(self, tmp_path, monkeypatch):
+        from pytorch_distributed_rnn_tpu.obs.recorder import (
+            MetricsRecorder,
+        )
+
+        monkeypatch.setenv(threadcheck.HOLD_ENV, "0.05")
+        threadcheck.install()
+        rec = MetricsRecorder(tmp_path / "m.jsonl")
+        try:
+            lk = threadcheck.lock(threading.Lock(), "slowpoke")
+            with lk:
+                time.sleep(0.1)
+        finally:
+            rec.close()
+        events = [json.loads(line) for line in
+                  (tmp_path / "m.jsonl").read_text().splitlines()]
+        (alert,) = [e for e in events
+                    if e.get("alert") == "lock_long_hold"]
+        assert alert["severity"] == "warn"
+        assert alert["lock"] == "slowpoke"
+        assert alert["held_s"] >= 0.05
+
+    def test_reinstall_keeps_graph_but_updates_recorder(self):
+        st = threadcheck.install()
+        a = threadcheck.lock(threading.Lock(), "A")
+        b = threadcheck.lock(threading.Lock(), "B")
+        with a:
+            with b:
+                pass
+
+        class FakeRec:
+            def record(self, *a, **k):
+                pass
+
+            def flush(self):
+                pass
+
+        rec = FakeRec()
+        assert threadcheck.install(recorder=rec) is st
+        assert st.recorder is rec
+        assert threadcheck.stats()["edges"] == {"A": ["B"]}
+
+
+# -- chaos drill --------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestThreadcheckDrill:
+    def _engine(self):
+        import jax
+
+        from pytorch_distributed_rnn_tpu.models import CharRNN
+        from pytorch_distributed_rnn_tpu.serving.adapters import (
+            adapter_for,
+        )
+        from pytorch_distributed_rnn_tpu.serving.buckets import BucketSpec
+        from pytorch_distributed_rnn_tpu.serving.engine import (
+            ServingEngine,
+        )
+        from pytorch_distributed_rnn_tpu.serving.scheduler import (
+            ServeRequest,
+        )
+
+        model = CharRNN(vocab_size=32, embed_dim=8, hidden_dim=12,
+                        layer_dim=1, cell="lstm", impl="scan")
+        params = model.init(jax.random.PRNGKey(1))
+        engine = ServingEngine(adapter_for(model), params, num_slots=2,
+                               bucket_spec=BucketSpec((8,)),
+                               max_new_tokens=6)
+        rng = np.random.RandomState(0)
+        requests = [
+            ServeRequest(
+                prompt=rng.randint(0, 32, size=4).tolist(),
+                max_new_tokens=4, temperature=0.0, seed=100 + i,
+                id=str(i),
+            )
+            for i in range(8)
+        ]
+        return engine, requests
+
+    def test_serving_stats_hammer_and_scrape_stay_alert_free(self):
+        """The clean run: decode on one thread, the ``stats`` op
+        hammered from two more, a live aggregator scrape on a fourth -
+        with the sentinel live, no violation and no alert."""
+        from pytorch_distributed_rnn_tpu.obs.aggregator import Aggregator
+
+        threadcheck.install()
+        engine, requests = self._engine()
+        agg = Aggregator()
+        for r in requests:
+            assert engine.submit(r)
+        stop = threading.Event()
+        errors = []
+
+        def hammer():
+            try:
+                while not stop.is_set():
+                    s = engine.stats()
+                    assert s["steps"] >= 0
+            except BaseException as exc:  # noqa: BLE001 - reported below
+                errors.append(exc)
+
+        def scrape():
+            try:
+                n = 0
+                while not stop.is_set():
+                    agg.ingest(dict(engine.live_source(),
+                                    id="serve-0", role="serve", rank=0,
+                                    seq=n, t=time.time(),
+                                    tm=time.perf_counter()))
+                    agg.fleet()
+                    agg.prometheus_text()
+                    n += 1
+            except BaseException as exc:  # noqa: BLE001 - reported below
+                errors.append(exc)
+
+        threads = [threading.Thread(target=hammer) for _ in range(2)]
+        threads.append(threading.Thread(target=scrape))
+        for t in threads:
+            t.start()
+        try:
+            engine.drain()
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+        assert not errors, errors
+        assert all(r.status == "done" for r in requests)
+        assert threadcheck.stats()["violations"] == 0
+
+    def test_seeded_inversion_is_detected_and_dumped(self, tmp_path):
+        """The drill's negative control: two deliberately misordered
+        locks among the serving/aggregator traffic are caught and the
+        post-mortem written - proof the clean run above is meaningful."""
+        from pytorch_distributed_rnn_tpu.obs.recorder import (
+            MetricsRecorder,
+        )
+        from pytorch_distributed_rnn_tpu.obs.watchdog import (
+            stacks_path_for,
+        )
+
+        threadcheck.install()
+        rec = MetricsRecorder(tmp_path / "m.jsonl")
+        engine, requests = self._engine()
+        for r in requests[:2]:
+            engine.submit(r)
+        seeded_a = threadcheck.lock(threading.Lock(), "drill.a")
+        seeded_b = threadcheck.lock(threading.Lock(), "drill.b")
+        caught = []
+
+        def forward():
+            with seeded_a:
+                with seeded_b:
+                    engine.stats()
+
+        def inverted():
+            try:
+                with seeded_b:
+                    with seeded_a:
+                        engine.stats()
+            except threadcheck.LockOrderError as exc:
+                caught.append(exc)
+
+        try:
+            t = threading.Thread(target=forward)
+            t.start()
+            t.join(timeout=10)
+            t = threading.Thread(target=inverted)
+            t.start()
+            t.join(timeout=10)
+            engine.drain()
+        finally:
+            rec.close()
+        assert len(caught) == 1
+        events = [json.loads(line) for line in
+                  (tmp_path / "m.jsonl").read_text().splitlines()]
+        assert any(e.get("alert") == "lock_order_inversion"
+                   for e in events)
+        assert stacks_path_for(tmp_path / "m.jsonl").exists()
