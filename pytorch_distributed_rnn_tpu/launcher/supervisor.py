@@ -285,6 +285,21 @@ class StageSupervisor(RespawnSupervisor):
             self.min_workers = len(self.slots)
 
 
+class ReplicaSupervisor(RespawnSupervisor):
+    """Serving-fleet flavor (``serving/fleet/``): the ``pdrnn-serve``
+    engine REPLICAS behind the router are supervised; the router itself
+    is the unsupervised anchor (it owns no model state and dying with
+    it is an outage, not a degradation).  A respawned replica rebinds
+    the SAME host:port its slot was launched on, so the router's static
+    pool entry stays valid and the circuit breaker re-admits it through
+    half-open probing once its pings succeed - no re-registration
+    protocol needed.  A SIGTERM drain (stop dispatching, finish
+    in-flight, DEREGISTER via the drained digest) exits 0 and is
+    terminal; the floor is the minimum replica count that keeps the
+    fleet serving - losing replicas degrades capacity, never
+    correctness (requests reroute)."""
+
+
 class ActorSupervisor(RespawnSupervisor):
     """Streaming actor/learner flavor (``streaming/runner.py``): the
     actor FLEET is supervised around a separately-watched learner.  A

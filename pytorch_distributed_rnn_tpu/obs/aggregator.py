@@ -52,6 +52,17 @@ pdrnn_serving_tokens_rate_per_s                 gauge        window
 pdrnn_serving_shed_rate_per_s                   gauge        window
 pdrnn_serving_latency_seconds{quantile=...}     gauge        window
 pdrnn_serving_ttft_seconds{quantile=...}        gauge        window
+pdrnn_router_inflight                           gauge        router
+pdrnn_router_replicas{state=...}                gauge        router
+pdrnn_router_routed_total                       counter      router
+pdrnn_router_rerouted_total                     counter      router
+pdrnn_router_retries_total                      counter      router
+pdrnn_router_hedges_total                       counter      router
+pdrnn_router_hedge_wins_total                   counter      router
+pdrnn_router_shed_total{qos=...}                counter      router
+pdrnn_router_errors_total                       counter      router
+pdrnn_router_request_rate_per_s                 gauge        window
+pdrnn_router_latency_seconds{quantile=...}      gauge        window
 =============================================== ============ ==========
 """
 
@@ -181,6 +192,15 @@ class Aggregator:
         with self._lock:
             self._note_alert_locked(alert, source)
 
+    def peek(self, source_id: str) -> dict | None:
+        """Latest digest pushed by ``source_id`` (None when unseen).
+        The fleet router's load-hint read path: replica digests double
+        as the load signal (``serving.active + queue_depth``), so
+        least-loaded dispatch needs no second telemetry channel."""
+        with self._lock:
+            entry = self._peers.get(str(source_id))
+            return None if entry is None else entry["digest"]
+
     def _note_alert_locked(self, alert: dict, source: str) -> None:
         seq = alert.get("seq")
         if seq is not None:
@@ -247,13 +267,18 @@ class Aggregator:
 
     def _status(self, digest: dict, age_s: float,
                 drained_slots: set[int]) -> str:
+        if digest.get("drained"):
+            # a voluntary leave (``LiveExporter.note_drained`` - the
+            # SIGTERM drain of a serving replica) beats everything:
+            # fresh while it finishes in-flight work, stale after it
+            # exits - never "dead", and not "finished" either (the
+            # router pool cares that it LEFT, not that it completed)
+            return "drained"
         if digest.get("finished"):
             return "finished"
         if age_s > self.stale_after_s:
             rank = digest.get("rank")
-            if digest.get("drained") or (
-                rank is not None and int(rank) in drained_slots
-            ):
+            if rank is not None and int(rank) in drained_slots:
                 return "drained"
             return "dead"
         progress_age = digest.get("progress_age_s")
@@ -417,6 +442,32 @@ class Aggregator:
             for q, key in (("0.5", "ttft_s_p50"), ("0.95", "ttft_s_p95")):
                 add("pdrnn_serving_ttft_seconds",
                     {**labels, "quantile": q}, serving.get(key))
+            router = digest.get("router") or {}
+            add("pdrnn_router_inflight", labels, router.get("inflight"))
+            for state, count in (router.get("replicas") or {}).items():
+                add("pdrnn_router_replicas", {**labels, "state": state},
+                    count)
+            add("pdrnn_router_routed_total", labels, router.get("routed"),
+                "counter")
+            add("pdrnn_router_rerouted_total", labels,
+                router.get("rerouted"), "counter")
+            add("pdrnn_router_retries_total", labels,
+                router.get("retries"), "counter")
+            add("pdrnn_router_hedges_total", labels, router.get("hedges"),
+                "counter")
+            add("pdrnn_router_hedge_wins_total", labels,
+                router.get("hedge_wins"), "counter")
+            for qos, count in (router.get("shed") or {}).items():
+                add("pdrnn_router_shed_total", {**labels, "qos": qos},
+                    count, "counter")
+            add("pdrnn_router_errors_total", labels, router.get("errors"),
+                "counter")
+            add("pdrnn_router_request_rate_per_s", labels,
+                router.get("req_per_s_60s"))
+            for q, key in (("0.5", "latency_s_p50"), ("0.95",
+                                                     "latency_s_p95")):
+                add("pdrnn_router_latency_seconds",
+                    {**labels, "quantile": q}, router.get(key))
         return render_prometheus(samples)
 
 

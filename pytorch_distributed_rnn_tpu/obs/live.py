@@ -62,6 +62,10 @@ roster              latest elastic-roster counts (master digests)
 drained_slots       rank slots that DEREGISTERed voluntarily - the
                     aggregator classifies their silence as drained,
                     not dead
+drained             this source itself is draining / drained
+                    (``note_drained`` - a SIGTERMed serving replica
+                    finishing in-flight work); the aggregator
+                    classifies its silence as drained, not dead
 serving             serving-engine gauge block (queue depth, windowed
                     req/s / tokens/s / shed/s, latency/TTFT p50/p95)
 =================== =======================================================
@@ -243,6 +247,7 @@ class LiveExporter:
         self._roster = None
         self._drained_slots: set[int] = set()
         self.finished = False
+        self.drained = False
         self.loss_nonfinite_streak = 0
 
         # efficiency-ledger live inputs: the trainer's collectives event
@@ -328,6 +333,13 @@ class LiveExporter:
                     else:
                         self._drained_slots.discard(int(slot))
 
+    def note_drained(self) -> None:
+        """Mark this source as voluntarily draining (a SIGTERMed serving
+        replica finishing in-flight work before exit): every subsequent
+        digest carries ``drained`` so the aggregator classifies the
+        source's eventual silence as ``drained``, never ``dead``."""
+        self.drained = True
+
     def note_alert(self, alert: dict) -> None:
         """Watchdog-side entry: queue an alert for the next digest (the
         sidecar ``alert`` event is recorded separately and feeds
@@ -396,6 +408,8 @@ class LiveExporter:
                 body["roster"] = dict(self._roster)
             if self._drained_slots:
                 body["drained_slots"] = sorted(self._drained_slots)
+            if self.drained:
+                body["drained"] = True
         body["step_s"] = self.step_s.stats(now)
         loss_stats = self.loss.stats(now)
         body["loss"] = {

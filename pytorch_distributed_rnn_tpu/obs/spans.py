@@ -60,6 +60,10 @@ SUBSYSTEM_TIDS = {
     # the overlapped native-ring step (training/native_ddp.py) - stacked
     # against the train lane they show comm riding under compute
     "comm": 13,
+    # serving-fleet router lane: dispatch spans plus breaker transitions
+    # (replica_eject / replica_readmit), shed and drain instants
+    # (serving/fleet/router.py)
+    "router": 14,
 }
 
 

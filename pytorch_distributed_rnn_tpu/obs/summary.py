@@ -125,6 +125,18 @@ STREAMING_SUMMARY_KEYS = (
 )
 
 
+# serving-fleet router metrics the router folds into its run_summary
+# event (serving/fleet/router.py): dispatch/retry/hedge accounting and
+# the breaker transition counters.  Same verbatim-passthrough contract
+# as the serving keys: present on a pdrnn-router sidecar, absent (None,
+# not 0) on every other run.
+ROUTER_SUMMARY_KEYS = (
+    "routed", "rerouted", "retries", "hedges", "hedge_wins",
+    "router_shed", "router_errors", "stream_aborts",
+    "replica_ejections", "replica_readmissions", "drain_rejected",
+)
+
+
 def _phase_bytes(collectives, op_kinds):
     """Per-step bytes of the named traced collective op kinds, or None
     when the run has no per-op breakdown (host-loop steps record the
@@ -324,7 +336,8 @@ def summarize_events(events: list[dict], path=None) -> dict:
     if run and run.get("roster") is not None:
         summary["roster"] = run["roster"]
     if run:
-        for key in SERVING_SUMMARY_KEYS + STREAMING_SUMMARY_KEYS:
+        for key in (SERVING_SUMMARY_KEYS + STREAMING_SUMMARY_KEYS
+                    + ROUTER_SUMMARY_KEYS):
             if key in run:
                 summary[key] = run[key]
     # efficiency-ledger ratios (obs/ledger.py): goodput, its inverse

@@ -381,6 +381,17 @@ def build_chrome_trace(by_rank: dict[int, list[dict]],
                     "params_refresh": "actor",
                     "actor_reconnect": "member",
                     "learner_summary": "run",
+                    # the serving-fleet router lane: breaker transitions
+                    # (eject on consecutive failures, half-open probes,
+                    # readmission), QoS sheds and the drain marker, next
+                    # to the route dispatch spans (cat=router)
+                    "replica_eject": "router",
+                    "replica_probe": "router",
+                    "replica_readmit": "router",
+                    "replica_drain": "router",
+                    "route_shed": "router",
+                    "hedge": "router",
+                    "router_drain": "router",
                 }.get(kind, "sys")
                 tb.instant(rank, cat, kind, w, _args(e), scope)
 

@@ -19,6 +19,13 @@ Spec grammar (``--faults`` flag / ``PDRNN_CHAOS`` env)::
                                             (seeded, per-step deterministic)
            | net:delay:<ms>                 transport delay (PDRNN_FAULT_* bridge)
            | net:loss:<prob>                transport loss (PDRNN_FAULT_* bridge)
+           | net:flap:<s>                   periodic connection drop: every s
+                                            seconds the process's serving
+                                            listeners close every open peer
+                                            connection (PDRNN_FAULT_FLAP_S
+                                            bridge) - a FLAKY replica/link,
+                                            distinct from kill: the process
+                                            survives, its connections do not
            | seed:<int>                     RNG seed for prob events (default 0)
     action := nan                           corrupt the step's batch to NaN
                                             (non-finite grads; pairs with the
@@ -71,6 +78,11 @@ CHAOS_ENV = "PDRNN_CHAOS"
 # around benchmark runs) - the ONE mechanism chaos and bench share
 FAULT_DELAY_ENV = "PDRNN_FAULT_DELAY_MS"
 FAULT_LOSS_ENV = "PDRNN_FAULT_LOSS_PROB"
+# connection-flap half of the same contract: consumers that own peer
+# connections (the serving TCP front end; reusable by MPMD/PS link
+# tests) drop every open connection each period - the flaky-replica
+# mode the router drill needs, distinct from killing the process
+FAULT_FLAP_ENV = "PDRNN_FAULT_FLAP_S"
 
 _ACTIONS = ("nan", "stall", "slow", "exc", "kill", "respawn", "preempt")
 _TRIGGERS = ("step", "epoch", "prob")
@@ -100,7 +112,11 @@ def fault_env(fault_type: str | None, fault_value: float) -> dict[str, str]:
         return {FAULT_DELAY_ENV: str(fault_value)}
     if fault_type == "loss":
         return {FAULT_LOSS_ENV: str(fault_value)}
-    raise ValueError(f"unknown fault type {fault_type!r} (delay|loss)")
+    if fault_type == "flap":
+        return {FAULT_FLAP_ENV: str(fault_value)}
+    raise ValueError(
+        f"unknown fault type {fault_type!r} (delay|loss|flap)"
+    )
 
 
 @dataclass(frozen=True)

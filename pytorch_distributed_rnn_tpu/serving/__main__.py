@@ -1,7 +1,8 @@
-"""``python -m pytorch_distributed_rnn_tpu.serving {serve,loadgen} ...``
-- the module form of the ``pdrnn-serve`` / ``pdrnn-loadgen`` console
-scripts (the drill spawns servers through this form so it works from a
-source checkout without an installed entry point)."""
+"""``python -m pytorch_distributed_rnn_tpu.serving
+{serve,loadgen,router} ...`` - the module form of the ``pdrnn-serve``
+/ ``pdrnn-loadgen`` / ``pdrnn-router`` console scripts (the drills
+spawn processes through this form so it works from a source checkout
+without an installed entry point)."""
 
 from __future__ import annotations
 
@@ -12,15 +13,21 @@ from pytorch_distributed_rnn_tpu.serving.cli import loadgen_main, serve_main
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
-    if not argv or argv[0] not in ("serve", "loadgen"):
+    if not argv or argv[0] not in ("serve", "loadgen", "router"):
         print(
             "usage: python -m pytorch_distributed_rnn_tpu.serving "
-            "{serve,loadgen} [options]",
+            "{serve,loadgen,router} [options]",
             file=sys.stderr,
         )
         return 2
     if argv[0] == "serve":
         return serve_main(argv[1:])
+    if argv[0] == "router":
+        from pytorch_distributed_rnn_tpu.serving.fleet.cli import (
+            router_main,
+        )
+
+        return router_main(argv[1:])
     return loadgen_main(argv[1:])
 
 

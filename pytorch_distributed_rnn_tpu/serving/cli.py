@@ -24,6 +24,16 @@ Load::
 through it, SIGTERM down, report (incl. the degradation window and the
 server's exit code) out.  Exit codes: 0 = SLO pass, 1 = SLO fail /
 errors, 2 = usage or spawn failure.
+
+``--spawn-fleet N`` runs the kill-mid-burst fleet drill
+(``serving/fleet/drill.py``): N supervised replicas behind a
+``pdrnn-router``, one SIGKILLed mid-burst, and the verdict is graceful
+degradation - rerouting, exactly-once accounting, a CLOSED degradation
+window - instead of a bare SLO pass::
+
+  pdrnn-loadgen --spawn-fleet 3 --replica-args "--checkpoint models/ \\
+      --model char --hidden-units 32" --fleet-kill-after-s 2 \\
+      --requests 120 --rate 40
 """
 
 from __future__ import annotations
@@ -115,7 +125,20 @@ def build_serve_parser() -> argparse.ArgumentParser:
         help="chaos schedule on the decode loop (resilience/faults.py "
         "grammar; step index = decode step): stall holds the loop, nan "
         "poisons in-flight logits (affected requests fail cleanly), "
-        "exc is absorbed, kill preempts the process",
+        "exc is absorbed, kill preempts the process; net:flap:<s> "
+        "drops every open client connection each period",
+    )
+    parser.add_argument(
+        "--replica-id", default=None, type=int, metavar="K",
+        help="fleet membership (serving/fleet/): this server is "
+        "replica K (1..N) behind a pdrnn-router - it pushes its live "
+        "digests to the router's aggregator instead of anchoring one, "
+        "announces itself via register/drain heartbeats, and SIGTERM "
+        "DRAINS (finish in-flight, reject new) instead of aborting",
+    )
+    parser.add_argument(
+        "--drain-timeout", default=30.0, type=float, metavar="S",
+        help="bound on the SIGTERM drain wait (fleet replicas)",
     )
     parser.add_argument("--metrics", default=None, type=Path, metavar="PATH")
     parser.add_argument("--metrics-sample-every", default=None, type=int)
@@ -206,8 +229,10 @@ def serve_main(argv=None) -> int:
         f"loss {meta['loss']:.4f})"
     )
 
+    replica_id = args.replica_id
     recorder = MetricsRecorder.resolve(
-        args, meta={"role": "serve", "argv": sys.argv[1:]},
+        args, rank=replica_id or 0,
+        meta={"role": "serve", "argv": sys.argv[1:]},
     )
     faults = FaultSchedule.resolve(args)
     if faults is not None:
@@ -228,27 +253,54 @@ def serve_main(argv=None) -> int:
     )
     # live plane: /metrics + /health + /events served from this process
     # (the serving engine IS the rank-0 anchor), with the engine's gauge
-    # block riding every digest
+    # block riding every digest.  A fleet REPLICA (--replica-id >= 1)
+    # pushes to the router's aggregator instead of anchoring its own -
+    # its digest doubles as the router's load signal
     from pytorch_distributed_rnn_tpu.obs.live import LivePlane
 
-    plane = LivePlane.resolve(args, recorder, rank=0, role="serve",
-                              faults=faults)
+    plane = LivePlane.resolve(args, recorder, rank=replica_id or 0,
+                              role="serve", faults=faults)
     if plane is not None:
         plane.exporter.add_source(engine.live_source)
+    pusher = None
+    if replica_id is not None:
+        # register/drain heartbeats ride the aggregator's /events feed
+        # (alert-only EventPusher - distinct id space from the digest
+        # exporter, so the membership announcements never collide with
+        # the replica's own gauge digests)
+        import os
+
+        from pytorch_distributed_rnn_tpu.obs.live import (
+            LIVE_ENV,
+            EventPusher,
+            parse_live_spec,
+            resolve_push_url,
+        )
+
+        spec = args.live or os.environ.get(LIVE_ENV)
+        if spec and recorder.enabled:
+            lhost, lport = parse_live_spec(spec)
+            pusher = EventPusher(
+                lambda: resolve_push_url(args, lhost, lport),
+                role="replica", rank=replica_id,
+            ).push
     if not args.no_warmup:
         engine.warmup()
     server = ServingServer(
         engine, host=args.host, port=args.port,
-        model_name=args.model, recorder=recorder,
+        model_name=args.model, recorder=recorder, pusher=pusher,
+        replica_id=replica_id,
     )
     if args.port_file is not None:
         args.port_file.parent.mkdir(parents=True, exist_ok=True)
         args.port_file.write_text(f"{server.host} {server.port}\n")
 
     stop = threading.Event()
+    received = {"signum": None}
 
     def _on_signal(signum, _frame):
         log.info(f"pdrnn-serve: signal {signum}, shutting down")
+        received["signum"] = signum
         stop.set()
 
     signal.signal(signal.SIGTERM, _on_signal)
@@ -259,7 +311,15 @@ def serve_main(argv=None) -> int:
           flush=True)
     while not stop.is_set():
         stop.wait(timeout=0.5)
-    server.shutdown()
+    # a fleet replica DRAINS on SIGTERM: finish what it owns, reject
+    # new work, and mark its digests drained so the aggregator (and
+    # `pdrnn-metrics health`) classifies the coming silence as a
+    # voluntary exit, never a death
+    drain = (replica_id is not None
+             and received["signum"] == signal.SIGTERM)
+    if drain and plane is not None:
+        plane.exporter.note_drained()
+    server.shutdown(drain=drain, drain_timeout_s=args.drain_timeout)
     if plane is not None:
         # after server.shutdown(): the recorder's close pushed the final
         # finished digest, so the last scrape-able state is honest
@@ -296,6 +356,32 @@ def build_loadgen_parser() -> argparse.ArgumentParser:
         "string), load it, SIGTERM it, and report - including the "
         "degradation window and the server's exit code",
     )
+    target.add_argument(
+        "--spawn-fleet", default=None, type=int, metavar="N",
+        help="kill-mid-burst fleet drill: spawn N supervised replicas "
+        "(--replica-args) behind a pdrnn-router (--router-args), load "
+        "through the router, optionally SIGKILL one replica mid-burst "
+        "(--fleet-kill-after-s), and assert rerouting + exactly-once "
+        "accounting + a CLOSED degradation window",
+    )
+    parser.add_argument(
+        "--replica-args", default=None, metavar="ARGS",
+        help="pdrnn-serve flags shared by every --spawn-fleet replica "
+        "(shell-quoted; identity/port flags are added by the drill)",
+    )
+    parser.add_argument(
+        "--router-args", default="", metavar="ARGS",
+        help="extra pdrnn-router flags for --spawn-fleet "
+        "(shell-quoted), e.g. '--retries 2 --hedge-after-ms 250'",
+    )
+    parser.add_argument(
+        "--fleet-kill-after-s", default=None, type=float, metavar="S",
+        help="SIGKILL one replica this long after load start",
+    )
+    parser.add_argument(
+        "--fleet-kill-index", default=1, type=int, metavar="K",
+        help="which replica slot (1..N) the kill hits",
+    )
     parser.add_argument("--requests", default=50, type=int)
     parser.add_argument(
         "--rate", default=25.0, type=float,
@@ -318,6 +404,21 @@ def build_loadgen_parser() -> argparse.ArgumentParser:
     parser.add_argument("--stream", action="store_true",
                         help="request streamed tokens")
     parser.add_argument("--timeout", default=120.0, type=float, metavar="S")
+    parser.add_argument(
+        "--connect-timeout", default=5.0, type=float, metavar="S",
+        help="dial bound per request connection (separate from "
+        "--timeout so a vanished target fails fast)",
+    )
+    parser.add_argument(
+        "--low-priority-fraction", default=0.0, type=float,
+        help="share of requests tagged priority=low (router QoS: low "
+        "sheds first under overload; plain servers ignore the tag)",
+    )
+    parser.add_argument(
+        "--deadline-ms", default=None, type=float,
+        help="per-request deadline_ms field (router QoS: bounds "
+        "dispatch + retries server-side)",
+    )
     parser.add_argument("--slo-p95-ms", default=2000.0, type=float)
     parser.add_argument("--slo-ttft-p95-ms", default=None, type=float)
     parser.add_argument(
@@ -347,8 +448,62 @@ def loadgen_main(argv=None) -> int:
         temperature=args.temperature,
         sampled_fraction=args.sampled_fraction,
         seed=args.seed, stream=args.stream, timeout_s=args.timeout,
+        connect_timeout_s=args.connect_timeout,
+        low_priority_fraction=args.low_priority_fraction,
+        deadline_ms=args.deadline_ms,
         slo_p95_ms=args.slo_p95_ms, slo_ttft_p95_ms=args.slo_ttft_p95_ms,
     )
+
+    if args.spawn_fleet is not None:
+        from pytorch_distributed_rnn_tpu.serving.fleet.drill import (
+            FleetSpawnError,
+            run_fleet_drill,
+        )
+
+        if args.replica_args is None:
+            print("pdrnn-loadgen: --spawn-fleet needs --replica-args",
+                  file=sys.stderr)
+            return 2
+        try:
+            report = run_fleet_drill(
+                shlex.split(args.replica_args), cfg,
+                n=args.spawn_fleet,
+                kill_after_s=args.fleet_kill_after_s,
+                kill_index=args.fleet_kill_index,
+                router_args=shlex.split(args.router_args),
+            )
+        except FleetSpawnError as exc:
+            print(f"pdrnn-loadgen: {exc}", file=sys.stderr)
+            return 2
+        if args.report is not None:
+            args.report.parent.mkdir(parents=True, exist_ok=True)
+            args.report.write_text(json.dumps(report, indent=1) + "\n")
+        fleet = report["fleet"]
+        if args.json:
+            print(json.dumps(report, indent=1))
+        else:
+            print(format_report(report))
+            print(
+                f"fleet: {fleet['replicas']} replicas, "
+                f"{fleet['respawns']} respawn(s), router rerouted "
+                f"{fleet['router']['rerouted']} "
+                f"({fleet['router']['retries']} retries, "
+                f"{fleet['router']['hedges']} hedges), accounting "
+                f"{'OK' if fleet['accounting_ok'] else 'BROKEN'}, "
+                f"window "
+                f"{'closed' if fleet['window_closed'] else 'OPEN'}"
+            )
+        # the drill's gate: degradation bounded + nothing lost or
+        # duplicated + the kill actually respawned + clean teardown
+        # (a killed stream may legitimately error, so `errors == 0`
+        # is NOT part of this verdict - accounting is)
+        ok = (
+            fleet["accounting_ok"] and fleet["window_closed"]
+            and fleet["router_exit"] == 0
+            and (args.fleet_kill_after_s is None
+                 or fleet["respawns"] >= 1)
+        )
+        return 0 if ok else 1
 
     if args.spawn_server is not None:
         from pytorch_distributed_rnn_tpu.serving.drill import (

@@ -1,0 +1,36 @@
+"""Serving fleet: a fault-tolerant router over ``pdrnn-serve`` replicas.
+
+The scale-out layer of the serving stack (ROADMAP item #3): a front-end
+TCP router (``pdrnn-router``) speaking the same JSON-lines protocol as
+a single ``pdrnn-serve``, dispatching over N engine replicas with
+
+- a health-checked replica pool (``pool.py``): periodic pings plus the
+  live plane's digests as the load signal, least-loaded dispatch, and a
+  per-replica circuit breaker (eject after consecutive failures,
+  half-open probing for re-admission);
+- per-request robustness (``router.py``): deadline propagation,
+  retry-budgeted re-dispatch of idempotent seeded requests to sibling
+  replicas (bit-identical by construction - the seed pins the decode),
+  tail-latency hedging behind ``--hedge-after-ms``, and QoS classes
+  with priority shedding past the admission budget;
+- degradation drills (``drill.py``): ``pdrnn-loadgen --spawn-fleet N``
+  runs replicas under a
+  :class:`~pytorch_distributed_rnn_tpu.launcher.supervisor.ReplicaSupervisor`,
+  kills one mid-burst, and asserts rerouting + exactly-once accounting
+  (done + shed + errors == submitted) + SLO recovery.
+
+A client that speaks to ``pdrnn-serve`` speaks to ``pdrnn-router``
+unchanged; the fleet is invisible until something fails.
+"""
+
+from pytorch_distributed_rnn_tpu.serving.fleet.pool import (  # noqa: F401
+    Replica,
+    ReplicaPool,
+    TcpReplicaConnection,
+)
+from pytorch_distributed_rnn_tpu.serving.fleet.router import (  # noqa: F401
+    QOS_ADMIT_FRAC,
+    QOS_CLASSES,
+    RouterCore,
+    RouterServer,
+)

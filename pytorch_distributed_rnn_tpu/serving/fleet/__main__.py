@@ -1,0 +1,13 @@
+"""``python -m pytorch_distributed_rnn_tpu.serving.fleet ...`` - the
+module form of the ``pdrnn-router`` console script (the drill spawns
+the router through this form so it works from a source checkout
+without an installed entry point)."""
+
+from __future__ import annotations
+
+import sys
+
+from pytorch_distributed_rnn_tpu.serving.fleet.cli import router_main
+
+if __name__ == "__main__":
+    sys.exit(router_main())

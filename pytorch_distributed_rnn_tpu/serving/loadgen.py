@@ -47,6 +47,9 @@ class LoadConfig:
     seed: int = 0
     stream: bool = False
     timeout_s: float = 120.0
+    connect_timeout_s: float = 5.0
+    low_priority_fraction: float = 0.0  # share tagged priority=low
+    deadline_ms: float | None = None  # server-side QoS deadline field
     slo_p95_ms: float = 2000.0
     slo_ttft_p95_ms: float | None = None
 
@@ -55,6 +58,7 @@ class LoadConfig:
 class RequestOutcome:
     index: int
     arrival_s: float  # offset from load start
+    priority: str = "normal"
     status: str = "pending"  # done | shed | error
     latency_ms: float | None = None
     ttft_ms: float | None = None
@@ -76,6 +80,10 @@ def plan_requests(cfg: LoadConfig, vocab_size: int,
     """The deterministic request schedule: arrival offsets + payloads,
     clamped to the server's advertised limits."""
     rng = np.random.RandomState(cfg.seed)
+    # priorities draw from their OWN stream: turning the QoS mix on or
+    # off must not shift the base plan (arrivals/prompts/seeds), which
+    # tests and cross-run comparisons pin by cfg.seed
+    prio_rng = np.random.RandomState(cfg.seed + 104729)
     gaps = rng.exponential(1.0 / max(cfg.rate, 1e-9), size=cfg.requests)
     arrivals = np.cumsum(gaps)
     plen_hi = min(cfg.prompt_len_max, max_prompt_len)
@@ -94,6 +102,11 @@ def plan_requests(cfg: LoadConfig, vocab_size: int,
                 if rng.random_sample() < cfg.sampled_fraction else 0.0
             ),
             "seed": int(rng.randint(0, 2 ** 31 - 1)),
+            "priority": (
+                "low"
+                if prio_rng.random_sample() < cfg.low_priority_fraction
+                else "normal"
+            ),
         })
     return plan
 
@@ -108,7 +121,8 @@ def run_load(cfg: LoadConfig, progress=None) -> dict:
         int(info["max_new_tokens"]),
     )
     outcomes = [
-        RequestOutcome(index=i, arrival_s=p["arrival_s"])
+        RequestOutcome(index=i, arrival_s=p["arrival_s"],
+                       priority=p.get("priority", "normal"))
         for i, p in enumerate(plan)
     ]
     t0 = time.perf_counter()
@@ -117,13 +131,23 @@ def run_load(cfg: LoadConfig, progress=None) -> dict:
         spec = plan[i]
         out = outcomes[i]
         try:
-            with ServingClient(cfg.host, cfg.port,
-                               timeout_s=cfg.timeout_s) as client:
+            # connect bounded separately from reads (a vanished target
+            # fails the dial in seconds), and deadline_s caps the WHOLE
+            # request - a stream dribbling tokens resets the per-read
+            # timeout forever and would pin this worker without it
+            with ServingClient(
+                cfg.host, cfg.port, timeout_s=cfg.timeout_s,
+                connect_timeout_s=cfg.connect_timeout_s,
+            ) as client:
                 reply = client.generate(
                     prompt=spec["prompt"],
                     max_new_tokens=spec["max_new_tokens"],
                     temperature=spec["temperature"], seed=spec["seed"],
                     stream=cfg.stream, request_id=str(i),
+                    priority=(spec["priority"]
+                              if cfg.low_priority_fraction > 0 else None),
+                    deadline_ms=cfg.deadline_ms,
+                    deadline_s=cfg.timeout_s,
                 )
         except (OSError, ProtocolError) as exc:
             out.status = "error"
@@ -209,6 +233,18 @@ def build_report(cfg: LoadConfig, outcomes: list[RequestOutcome],
         })
     degraded_seconds = [t["second"] for t in timeline if t["degraded"]]
 
+    # per-QoS-class breakdown: the fleet drill's shed-ordering check
+    # (low must shed first) reads these
+    by_priority: dict[str, dict] = {}
+    for o in outcomes:
+        bucket = by_priority.setdefault(
+            o.priority, {"requests": 0, "done": 0, "shed": 0,
+                         "errors": 0},
+        )
+        bucket["requests"] += 1
+        key = "errors" if o.status == "error" else o.status
+        bucket[key] = bucket.get(key, 0) + 1
+
     p95 = _percentile(lat, 0.95)
     ttft_p95 = _percentile(ttft, 0.95)
     slo = {
@@ -243,6 +279,7 @@ def build_report(cfg: LoadConfig, outcomes: list[RequestOutcome],
             "p95": _percentile(queue, 0.95),
         },
         "slo": slo,
+        "by_priority": by_priority,
         "timeline": timeline,
         "degraded_seconds": degraded_seconds,
         "degradation_window_s": (

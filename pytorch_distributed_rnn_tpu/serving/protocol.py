@@ -12,8 +12,15 @@ Client -> server::
      "max_new_tokens": 16, "temperature": 0.8, "seed": 7,
      "stream": true}
     {"op": "generate", "text": "To be, or", ...}   # byte-vocab models
+    {"op": "generate", "priority": "low", "deadline_ms": 2000, ...}
     {"op": "ping"}
     {"op": "stats"}
+
+``priority`` (``high`` | ``normal`` | ``low``) and ``deadline_ms`` are
+the fleet-router QoS fields (``serving/fleet/router.py``): the router
+sheds low priority first past its admission budget and bounds each
+request's dispatch + retries by its deadline.  A bare ``pdrnn-serve``
+ignores both - single-replica requests keep their exact old behavior.
 
 Server -> client::
 
@@ -34,6 +41,7 @@ from __future__ import annotations
 
 import json
 import socket
+import time
 
 
 def encode_line(obj: dict) -> bytes:
@@ -64,10 +72,24 @@ class ProtocolError(RuntimeError):
 
 
 class ServingClient:
-    """Blocking JSONL client: one in-flight request per connection."""
+    """Blocking JSONL client: one in-flight request per connection.
 
-    def __init__(self, host: str, port: int, timeout_s: float = 60.0):
-        self.sock = socket.create_connection((host, port), timeout=timeout_s)
+    ``timeout_s`` bounds each individual socket read; ``connect_timeout_s``
+    (default: ``timeout_s``) bounds the dial separately, so a vanished
+    or wedged target fails the CONNECT in seconds instead of holding a
+    whole request timeout.  Per-request wall deadlines are the
+    ``deadline_s`` argument of :meth:`generate` - a per-read timeout
+    alone never bounds a stream that keeps dribbling tokens."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 60.0,
+                 connect_timeout_s: float | None = None):
+        self.sock = socket.create_connection(
+            (host, port),
+            timeout=timeout_s if connect_timeout_s is None
+            else connect_timeout_s,
+        )
+        self.sock.settimeout(timeout_s)
+        self.timeout_s = float(timeout_s)
         self._rfile = self.sock.makefile("r", encoding="utf-8")
 
     def close(self):
@@ -114,10 +136,19 @@ class ServingClient:
     def generate(self, prompt=None, *, text: str | None = None,
                  max_new_tokens: int = 16, temperature: float = 0.0,
                  seed: int | None = None, stream: bool = False,
-                 request_id: str = "0", on_token=None) -> dict:
+                 request_id: str = "0", on_token=None,
+                 priority: str | None = None,
+                 deadline_ms: float | None = None,
+                 deadline_s: float | None = None) -> dict:
         """Run one generation; returns the final ``done``/``error``
         payload.  With ``stream=True``, ``on_token(index, token)`` fires
-        per streamed token before the final payload arrives."""
+        per streamed token before the final payload arrives.
+
+        ``priority``/``deadline_ms`` ride in the request (router QoS
+        fields; plain servers ignore them).  ``deadline_s`` is CLIENT-
+        side: a wall bound across every read of this request - without
+        it a stream emitting a token every few hundred ms resets the
+        per-read timeout forever and a wedged server pins the caller."""
         req: dict = {
             "op": "generate", "id": request_id,
             "max_new_tokens": int(max_new_tokens),
@@ -129,9 +160,35 @@ class ServingClient:
             req["prompt"] = [int(t) for t in (prompt or [])]
         if seed is not None:
             req["seed"] = int(seed)
+        if priority is not None:
+            req["priority"] = str(priority)
+        if deadline_ms is not None:
+            req["deadline_ms"] = float(deadline_ms)
         self._send(req)
+        expiry = (
+            None if deadline_s is None
+            else time.monotonic() + float(deadline_s)
+        )
         while True:
-            reply = self._recv()
+            if expiry is not None:
+                remaining = expiry - time.monotonic()
+                if remaining <= 0:
+                    raise ProtocolError(
+                        f"no final reply within the {deadline_s:g}s "
+                        f"request deadline"
+                    )
+                self.sock.settimeout(min(self.timeout_s, remaining))
+            try:
+                reply = self._recv()
+            except OSError as exc:
+                # a read armed with the residual deadline timing out IS
+                # the deadline expiring - name it that, not "timed out"
+                if expiry is not None and time.monotonic() >= expiry:
+                    raise ProtocolError(
+                        f"no final reply within the {deadline_s:g}s "
+                        f"request deadline"
+                    ) from exc
+                raise
             event = reply.get("event")
             if event == "token":
                 if on_token is not None:

@@ -219,6 +219,18 @@ class TestAggregatorHealth:
         assert report["ok"] is True
         assert report["roster"]["drained"] == 1
 
+    def test_self_drained_replica_is_drained_not_dead(self):
+        """The serving-fleet story: a SIGTERMed `--replica-id` replica
+        calls ``LiveExporter.note_drained()`` before its last push, so
+        its digest says ``drained`` and the silence that follows is a
+        voluntary leave - never graded dead, even once stale."""
+        agg = Aggregator(stale_after_s=0.05)
+        agg.ingest(_digest("serve-2", rank=2, role="serve", drained=True))
+        time.sleep(0.1)
+        report = agg.health()
+        assert report["sources"][0]["status"] == "drained"
+        assert report["ok"] is True
+
     def test_finished_beats_staleness(self):
         agg = Aggregator(stale_after_s=0.05)
         agg.ingest(_digest(finished=True))

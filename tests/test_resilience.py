@@ -150,6 +150,14 @@ class TestFaultSchedule:
             "PDRNN_FAULT_DELAY_MS"
         ]
 
+    def test_net_flap_rides_the_same_env_contract(self):
+        """``net:flap:<s>`` joins delay/loss on the PDRNN_FAULT_* env -
+        consumed by connection-owning servers (pdrnn-serve) instead of
+        the transport, but declared through the one shared bridge."""
+        s = FaultSchedule.parse("net:flap:0.5")
+        assert s.network_env() == fault_env("flap", 0.5)
+        assert s.network_env() == {"PDRNN_FAULT_FLAP_S": "0.5"}
+
     def test_prob_draws_deterministic_and_thread_order_free(self):
         s = FaultSchedule.parse("prob:0.5:nan,seed:3")
         hits = [bool(list(s._matches(("prob",), i))) for i in range(50)]
