@@ -74,6 +74,10 @@ def _dataset_parameters(args):
 
 
 def main(argv=None):
+    from pytorch_distributed_rnn_tpu.utils import leakcheck
+
+    # resolve PDRNN_LEAKCHECK before the first socket/thread/file
+    leakcheck.maybe_install()
     parser = argparse.ArgumentParser(prog="pytorch_distributed_rnn_tpu.launcher")
     sub = parser.add_subparsers(dest="task", required=True)
 

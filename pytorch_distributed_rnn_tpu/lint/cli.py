@@ -3,19 +3,22 @@
 ::
 
     python -m pytorch_distributed_rnn_tpu.lint [paths...]
-        [--deep] [--no-concurrency] [--format text|json]
+        [--deep] [--no-concurrency] [--no-lifecycle]
+        [--format text|json|sarif]
         [--select PD101,PD201] [--ignore PD103] [--stats]
         [--baseline lint_baseline.json | --no-baseline]
         [--write-baseline | --prune-baseline] [--known-axes dp,tp]
         [--list-rules]
 
-Three layers share one reporting path: the AST rules (PD1xx) and the
+Four layers share one reporting path: the AST rules (PD1xx), the
 concurrency lock-discipline rules (PD3xx, ``lint/concurrency.py``,
-skippable with ``--no-concurrency``) always run; ``--deep`` adds the
-jaxpr-level rules (PD2xx) by tracing every registered trainer entry
-point on CPU (abstract inputs, no compile, no TPU - see
+skippable with ``--no-concurrency``), and the wire-contract/
+resource-lifecycle rules (PD4xx, ``lint/lifecycle.py``, skippable with
+``--no-lifecycle``) always run; ``--deep`` adds the jaxpr-level rules
+(PD2xx) by tracing every registered trainer entry point on CPU
+(abstract inputs, no compile, no TPU - see
 ``lint/trace_registry.py``).  Baseline, ``# noqa``, select/ignore and
-the JSON schema apply identically to all layers.
+the JSON/SARIF schemas apply identically to all layers.
 
 Exit status: 0 = clean (all findings baselined or none), 1 = new
 findings, 2 = usage error.
@@ -36,6 +39,7 @@ from pytorch_distributed_rnn_tpu.lint.baseline import (
 from pytorch_distributed_rnn_tpu.lint.concurrency import concurrency_rules
 from pytorch_distributed_rnn_tpu.lint.core import all_rules, run_lint
 from pytorch_distributed_rnn_tpu.lint.jaxpr_pass import deep_rules
+from pytorch_distributed_rnn_tpu.lint.lifecycle import lifecycle_rules
 
 _DEFAULT_BASELINE = "lint_baseline.json"
 
@@ -82,10 +86,15 @@ def build_parser() -> argparse.ArgumentParser:
              "write/prune then preserves PD3xx entries, exactly as "
              "PD2xx entries are preserved without --deep)")
     parser.add_argument(
+        "--no-lifecycle", action="store_true",
+        help="skip the PD4xx wire-contract/resource-lifecycle rules "
+             "(baseline write/prune then preserves PD4xx entries, "
+             "same semantics as --no-concurrency)")
+    parser.add_argument(
         "--stats", action="store_true",
         help="append a per-rule count summary (new + baselined) to the "
              "text output - CI log readability")
-    parser.add_argument("--format", choices=("text", "json"),
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
                         default="text", dest="fmt")
     parser.add_argument("--select", type=_csv, default=None, metavar="RULES",
                         help="comma-separated rule codes to run exclusively")
@@ -110,6 +119,57 @@ def build_parser() -> argparse.ArgumentParser:
     return parser
 
 
+def _sarif_report(result) -> dict:
+    """SARIF 2.1.0 document covering all four layers - the shape GitHub
+    code scanning ingests, so lint findings annotate PR diffs.  Only
+    NEW findings become results (baselined ones are accepted debt)."""
+    descriptors = []
+    for code, rule in sorted({**all_rules(), **deep_rules()}.items()):
+        descriptors.append({
+            "id": code,
+            "name": rule.name,
+            "shortDescription": {"text": rule.name},
+            "fullDescription": {"text": rule.description},
+            "defaultConfiguration": {"level": "warning"},
+        })
+    results = []
+    for f in result.findings:
+        results.append({
+            "ruleId": f.rule,
+            "level": "warning",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path,
+                        "uriBaseId": "SRCROOT",
+                    },
+                    "region": {
+                        "startLine": f.line,
+                        "startColumn": f.col + 1,
+                    },
+                },
+            }],
+            "partialFingerprints": {
+                "pdrnnLintFingerprint": f.to_dict()["fingerprint"],
+            },
+        })
+    return {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/"
+                   "sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "pdrnn-lint",
+                "informationUri":
+                    "https://github.com/jkhlr/pytorch-distributed-rnn",
+                "rules": descriptors,
+            }},
+            "results": results,
+        }],
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
 
@@ -117,6 +177,7 @@ def main(argv: list[str] | None = None) -> int:
         for code, rule in sorted({**all_rules(), **deep_rules()}.items()):
             layer = ("jaxpr" if code.startswith("PD2")
                      else "concurrency" if code.startswith("PD3")
+                     else "lifecycle" if code.startswith("PD4")
                      else "ast")
             print(f"{code} [{layer}] {rule.name}: {rule.description}")
         return 0
@@ -146,6 +207,14 @@ def main(argv: list[str] | None = None) -> int:
     if conc_selected and args.no_concurrency:
         print(f"pdrnn-lint: --select {', '.join(sorted(conc_selected))} "
               "conflicts with --no-concurrency (the PD3xx layer would "
+              "not run)", file=sys.stderr)
+        return 2
+
+    # ... and for the lifecycle layer
+    life_selected = set(args.select or ()) & set(lifecycle_rules())
+    if life_selected and args.no_lifecycle:
+        print(f"pdrnn-lint: --select {', '.join(sorted(life_selected))} "
+              "conflicts with --no-lifecycle (the PD4xx layer would "
               "not run)", file=sys.stderr)
         return 2
 
@@ -183,6 +252,7 @@ def main(argv: list[str] | None = None) -> int:
             root=baseline_path.resolve().parent,
             deep=args.deep,
             concurrency=not args.no_concurrency,
+            lifecycle=not args.no_lifecycle,
         )
     except FileNotFoundError as e:
         print(f"pdrnn-lint: {e}", file=sys.stderr)
@@ -197,11 +267,14 @@ def main(argv: list[str] | None = None) -> int:
         # preservation guards keep a narrowed run from deleting accepted
         # entries it could not have re-observed: entries for files
         # outside the linted paths, PD2xx entries when the jaxpr layer
-        # never ran (no --deep), and PD3xx entries when the concurrency
-        # layer was skipped (--no-concurrency)
+        # never ran (no --deep), PD3xx entries when the concurrency
+        # layer was skipped (--no-concurrency), and PD4xx entries when
+        # the lifecycle layer was skipped (--no-lifecycle)
         keep_rules = () if args.deep else tuple(deep_rules())
         if args.no_concurrency:
             keep_rules = tuple(keep_rules) + tuple(concurrency_rules())
+        if args.no_lifecycle:
+            keep_rules = tuple(keep_rules) + tuple(lifecycle_rules())
         scanned = _scanned_paths(args.paths, baseline_path)
 
     if args.write_baseline:
@@ -225,7 +298,9 @@ def main(argv: list[str] | None = None) -> int:
               f"in {baseline_path}")
         return 0
 
-    if args.fmt == "json":
+    if args.fmt == "sarif":
+        print(json.dumps(_sarif_report(result), indent=2))
+    elif args.fmt == "json":
         report = {
             "version": 1,
             "files": result.files,

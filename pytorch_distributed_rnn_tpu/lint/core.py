@@ -214,10 +214,12 @@ def register(code: str, name: str, description: str):
 
 
 def all_rules() -> dict[str, Rule]:
-    # import for side effect: rule registration (PD1xx AST rules and the
-    # PD3xx concurrency layer; the PD2xx jaxpr layer keeps its own
-    # registry in lint/jaxpr_pass.py because its check signature differs)
+    # import for side effect: rule registration (PD1xx AST rules, the
+    # PD3xx concurrency layer, and the PD4xx lifecycle layer; the PD2xx
+    # jaxpr layer keeps its own registry in lint/jaxpr_pass.py because
+    # its check signature differs)
     from pytorch_distributed_rnn_tpu.lint import concurrency  # noqa: F401
+    from pytorch_distributed_rnn_tpu.lint import lifecycle  # noqa: F401
     from pytorch_distributed_rnn_tpu.lint import rules  # noqa: F401
 
     return dict(_REGISTRY)
@@ -282,6 +284,7 @@ def run_lint(
     root: str | Path | None = None,
     deep: bool = False,
     concurrency: bool = True,
+    lifecycle: bool = True,
 ) -> LintResult:
     """Lint ``paths`` (files or directories) and return the result.
 
@@ -295,6 +298,8 @@ def run_lint(
     lock-discipline layer (:mod:`.concurrency`), mirroring how the
     PD2xx layer is absent without ``deep`` - the CLI's baseline
     write/prune then preserves PD3xx entries instead of dropping them.
+    ``lifecycle=False`` does the same for the PD4xx wire-contract/
+    resource-lifecycle layer (:mod:`.lifecycle`).
     """
     from pytorch_distributed_rnn_tpu.lint.axes import collect_known_axes
     from pytorch_distributed_rnn_tpu.lint.baseline import apply_baseline
@@ -327,6 +332,12 @@ def run_lint(
         )
 
         active -= set(concurrency_rules())
+    if not lifecycle:
+        from pytorch_distributed_rnn_tpu.lint.lifecycle import (
+            lifecycle_rules,
+        )
+
+        active -= set(lifecycle_rules())
     if select:
         active &= set(select)
     if ignore:

@@ -1,0 +1,735 @@
+"""The lifecycle rule plugins (PD4xx): wire-contract & resource lint.
+
+Fourth lint layer, same machinery: pure ``ast`` like PD1xx/PD3xx
+(never imports the checked code), registered through
+:func:`lint.core.register` so ``# noqa``, the baseline,
+``--select``/``--ignore`` and the JSON/SARIF reports apply unchanged.
+The repo speaks four hand-rolled wire protocols (PS binary ops,
+serving JSONL, framed MPMD links, the fleet router) and every one of
+them grew by hand-reviewed convention: op-codes with handlers found by
+grep, sockets whose timeout discipline lives in docstrings, resources
+whose error-path cleanup was checked by eye.  These rules make the
+wire and lifecycle contracts machine-checked.
+
+Contracts are declared in source comments the rules parse (the same
+idiom as PD3xx's ``# guards:`` / ``# lock-order:``):
+
+- ``# protocol: <proto> op <NAME> [oneway]`` declares an op of wire
+  protocol ``<proto>`` (trailing the op constant / documented op
+  string in the protocol module).  ``oneway`` marks fire-and-forget
+  ops that need no reply path.
+- ``# protocol: <proto> handles <NAME>[, NAME...]`` registers the
+  module (a dispatch loop) as a handler of the named ops.
+- ``# protocol: <proto> request <NAME>`` marks a request-send site.
+- ``# protocol: <proto> reply <NAME>[, NAME...]`` marks the matching
+  reply/error-send site.
+- ``# owner: <who>`` trailing a resource acquisition transfers
+  ownership: someone else closes it, PD403 stands down.
+
+Rules:
+
+- **PD401 unhandled-protocol-op** - a declared op no registered
+  handler dispatches, a request-send site with no reply/error path
+  declared anywhere in the package, or a ``handles``/``request``/
+  ``reply`` naming an op the protocol never declared (typo guard).
+- **PD402 blocking-socket-no-timeout** - a blocking socket op
+  (``recv``/``recv_into``/``accept``/``connect``/``sendall``) on a
+  socket that was created without a timeout and never gets a
+  ``settimeout``.  Deliberate deadline-free contracts (an accept loop
+  unblocked by ``close()``, client-paced connection writes) are
+  suppressed in place with ``# noqa: PD402`` plus a rationale comment.
+- **PD403 resource-leak** - a ``socket``/``open``/
+  ``TemporaryDirectory`` acquisition with an exit path that skips
+  ``close``: a local whose only close is straight-line (an exception
+  between acquire and close leaks it) or absent, and the
+  partial-construction form - ``self.x = socket.socket(...)`` in
+  ``__init__`` followed by fallible construction steps with no
+  except/finally close.  ``with``, try/finally, a close-and-reraise
+  handler, escape (returned/stored/passed on), or a declared
+  ``# owner:`` transfer all satisfy it.
+- **PD404 unjoined-thread** - a non-daemon ``threading.Thread`` that
+  is ``start()``ed but never ``join()``ed (and never handed off).
+- **PD405 swallowed-loop-exception** - an ``except`` inside a
+  connection/ingest loop that neither re-raises, exits the loop,
+  replies an error, records an event, nor feeds a failure counter -
+  the handler that turns a systematic fault into silence.
+
+The runtime half of this pass is ``utils/leakcheck.py``: the same
+drain-by-exit contracts, enforced live on the repo's socket/thread/
+file/tempdir factories when ``PDRNN_LEAKCHECK`` is set.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from pytorch_distributed_rnn_tpu.lint.core import (
+    Finding,
+    ModuleInfo,
+    PackageIndex,
+    register,
+)
+
+# rule codes this module registers, in one place for the CLI's layer
+# label and the baseline preservation guard (mirrors concurrency_rules)
+LIFECYCLE_RULES = ("PD401", "PD402", "PD403", "PD404", "PD405")
+
+
+def lifecycle_rules() -> tuple[str, ...]:
+    return LIFECYCLE_RULES
+
+
+_PROTOCOL_RE = re.compile(
+    r"#\s*protocol:\s*(?P<proto>[\w.-]+)\s+"
+    r"(?P<verb>op|handles|request|reply)\s+"
+    r"(?P<names>[A-Za-z_]\w*(?:\s*,\s*[A-Za-z_]\w*)*)"
+    r"(?P<oneway>\s+oneway)?"
+)
+_OWNER_RE = re.compile(r"#\s*owner:\s*(\S.*)$")
+
+_BLOCKING_SOCKET_TAILS = ("recv", "recv_into", "accept", "connect",
+                          "sendall")
+# calls that make a function "network code" for PD405's loop scan
+_NET_TAILS = {
+    "recv", "recv_into", "accept", "sendall", "send", "readline",
+    "makefile", "create_connection", "connect",
+    "recv_request", "recv_params", "recv_state_sync",
+    "recv_experience_ext", "recv_experience_reply", "recv_params_at",
+    "send_request", "send_params", "send_state_sync",
+    "send_experience", "send_experience_reply", "send_params_at",
+}
+_COUNTER_NAME_RE = re.compile(
+    r"(fail|error|drop|reject|poison|abort|shed|dedup)", re.I
+)
+_MUTATOR_METHODS = {"append", "add", "update", "setdefault", "extend"}
+
+
+def _anchor(lineno: int) -> ast.AST:
+    node = ast.Constant(value=None)
+    node.lineno, node.col_offset = lineno, 0
+    return node
+
+
+def _has_owner(mod: ModuleInfo, lineno: int) -> bool:
+    return bool(_OWNER_RE.search(mod.line_text(lineno)))
+
+
+# ---------------------------------------------------------------------------
+# PD401 unhandled-protocol-op
+
+
+def _protocol_tables(index: PackageIndex) -> dict:
+    """Package-wide ``# protocol:`` registry, cached on the index:
+    ``proto -> {"ops": {name: (oneway, path, line)}, "handles":
+    {name: [(path, line)]}, "requests": [(name, path, line)],
+    "replies": {name: [(path, line)]}}``."""
+    cached = getattr(index, "_lifecycle_protocols", None)
+    if cached is not None:
+        return cached
+    tables: dict = {}
+    for mod in index.modules:
+        for lineno, text in enumerate(mod.lines, start=1):
+            m = _PROTOCOL_RE.search(text)
+            if not m:
+                continue
+            proto = tables.setdefault(m.group("proto"), {
+                "ops": {}, "handles": {}, "requests": [], "replies": {},
+            })
+            names = [n.strip() for n in m.group("names").split(",")
+                     if n.strip()]
+            verb = m.group("verb")
+            for name in names:
+                if verb == "op":
+                    proto["ops"][name] = (
+                        bool(m.group("oneway")), mod.path, lineno,
+                    )
+                elif verb == "handles":
+                    proto["handles"].setdefault(name, []).append(
+                        (mod.path, lineno))
+                elif verb == "request":
+                    proto["requests"].append((name, mod.path, lineno))
+                else:
+                    proto["replies"].setdefault(name, []).append(
+                        (mod.path, lineno))
+    index._lifecycle_protocols = tables  # type: ignore[attr-defined]
+    return tables
+
+
+@register(
+    "PD401", "unhandled-protocol-op",
+    "a declared protocol op with no registered handler, a request-send "
+    "site with no reply/error path, or a `# protocol:` reference to an "
+    "undeclared op (declare ops/handlers/requests/replies with "
+    "`# protocol:` registry comments)",
+)
+def check_unhandled_protocol_op(mod: ModuleInfo,
+                                index: PackageIndex) -> Iterator[Finding]:
+    tables = _protocol_tables(index)
+    for proto_name, proto in tables.items():
+        ops = proto["ops"]
+        for name, (oneway, path, lineno) in ops.items():
+            if path != mod.path:
+                continue
+            if name not in proto["handles"]:
+                yield mod.finding(
+                    "PD401", _anchor(lineno),
+                    f"protocol '{proto_name}' op {name} has no "
+                    f"registered handler (`# protocol: {proto_name} "
+                    f"handles {name}` at the dispatch site)",
+                )
+        for name, path, lineno in proto["requests"]:
+            if path != mod.path:
+                continue
+            if name not in ops:
+                yield mod.finding(
+                    "PD401", _anchor(lineno),
+                    f"request declares op {name} which protocol "
+                    f"'{proto_name}' never declared (`# protocol: "
+                    f"{proto_name} op {name}` in the protocol module)",
+                )
+            elif not ops[name][0] and name not in proto["replies"]:
+                yield mod.finding(
+                    "PD401", _anchor(lineno),
+                    f"request-send of '{proto_name}' op {name} has no "
+                    f"matching reply/error path anywhere (`# protocol: "
+                    f"{proto_name} reply {name}` at the reply site, or "
+                    f"declare the op oneway)",
+                )
+        for table in ("handles", "replies"):
+            for name, sites in proto[table].items():
+                if name in ops:
+                    continue
+                for path, lineno in sites:
+                    if path != mod.path:
+                        continue
+                    yield mod.finding(
+                        "PD401", _anchor(lineno),
+                        f"`{table}` declares op {name} which protocol "
+                        f"'{proto_name}' never declared (typo, or add "
+                        f"`# protocol: {proto_name} op {name}`)",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# PD402 blocking-socket-no-timeout
+
+
+def _socket_key(node: ast.AST) -> str | None:
+    """A stable per-module key for a socket-holding expression: bare
+    names key by name, attribute chains by the attribute tail (so
+    ``self._listener`` and ``server._listener`` share discipline)."""
+    if isinstance(node, ast.Name):
+        return f"n:{node.id}"
+    if isinstance(node, ast.Attribute):
+        return f"a:{node.attr}"
+    return None
+
+
+def _socket_factory(mod: ModuleInfo, value: ast.AST) -> tuple | None:
+    """``(kind, timed)`` when ``value`` constructs a socket: a bare
+    ``socket.socket(...)`` is untimed; ``socket.create_connection``
+    is timed iff a timeout argument rides the call."""
+    if not isinstance(value, ast.Call):
+        return None
+    resolved = mod.resolve(value.func) or ""
+    if resolved == "socket.socket":
+        return ("socket", False)
+    if resolved == "socket.create_connection":
+        timed = len(value.args) >= 2 or any(
+            kw.arg == "timeout" for kw in value.keywords)
+        return ("create_connection", timed)
+    return None
+
+
+def _scopes_related(a: str, b: str) -> bool:
+    """True when one qualname scope encloses the other (or matches):
+    a binding is visible in nested closures, and a ``settimeout`` in
+    either direction along the chain covers the binding."""
+    return (a == b or a == "" or b == ""
+            or a.startswith(b + ".") or b.startswith(a + "."))
+
+
+def _module_sockets(mod: ModuleInfo) -> tuple[set, set, dict, dict]:
+    """Socket bindings of this module.  Attribute sockets
+    (``self._listener``) key by attribute tail module-wide (the repo's
+    convention is one meaning per attr name per module); bare names are
+    scoped by their enclosing function qualname so ``conn`` in one
+    class's handler does not taint ``conn`` in another's."""
+    attr_sockets: set[str] = set()
+    attr_timed: set[str] = set()
+    name_bindings: dict[str, list[str]] = {}
+    name_timeouts: dict[str, list[str]] = {}
+
+    def bind(target: ast.AST, node: ast.AST, timed: bool) -> None:
+        if isinstance(target, ast.Attribute):
+            attr_sockets.add(target.attr)
+            if timed:
+                attr_timed.add(target.attr)
+        elif isinstance(target, ast.Name) and not timed:
+            name_bindings.setdefault(target.id, []).append(
+                mod.enclosing_function(node))
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign):
+            fac = _socket_factory(mod, node.value)
+            for target in node.targets:
+                if fac is not None:
+                    bind(target, node, fac[1])
+                # x, addr = listener.accept() binds a fresh socket
+                if (isinstance(target, ast.Tuple) and target.elts
+                        and isinstance(node.value, ast.Call)
+                        and isinstance(node.value.func, ast.Attribute)
+                        and node.value.func.attr == "accept"):
+                    bind(target.elts[0], node, False)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qual = mod.enclosing_function(node)
+            scope = f"{qual}.{node.name}" if qual else node.name
+            for arg in (node.args.args + node.args.kwonlyargs):
+                if arg.annotation is not None and (
+                        mod.resolve(arg.annotation) == "socket.socket"):
+                    name_bindings.setdefault(arg.arg, []).append(scope)
+        elif isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute) and node.func.attr == "settimeout":
+            base = node.func.value
+            if isinstance(base, ast.Attribute):
+                attr_timed.add(base.attr)
+            elif isinstance(base, ast.Name):
+                name_timeouts.setdefault(base.id, []).append(
+                    mod.enclosing_function(node))
+    return attr_sockets, attr_timed, name_bindings, name_timeouts
+
+
+@register(
+    "PD402", "blocking-socket-no-timeout",
+    "blocking socket op (recv/recv_into/accept/connect/sendall) on a "
+    "socket created without a timeout and never given a settimeout - "
+    "a wedged peer then hangs the caller forever",
+)
+def check_blocking_socket_no_timeout(
+        mod: ModuleInfo, index: PackageIndex) -> Iterator[Finding]:
+    attr_sockets, attr_timed, name_bindings, name_timeouts = (
+        _module_sockets(mod))
+    if not attr_sockets and not name_bindings:
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not isinstance(func, ast.Attribute) \
+                or func.attr not in _BLOCKING_SOCKET_TAILS:
+            continue
+        base = func.value
+        if isinstance(base, ast.Attribute):
+            if base.attr not in attr_sockets or base.attr in attr_timed:
+                continue
+            shown = base.attr
+        elif isinstance(base, ast.Name):
+            qual = mod.enclosing_function(node)
+            if not any(_scopes_related(b, qual)
+                       for b in name_bindings.get(base.id, ())):
+                continue
+            if any(_scopes_related(t, qual)
+                   for t in name_timeouts.get(base.id, ())):
+                continue
+            shown = base.id
+        else:
+            continue
+        yield mod.finding(
+            "PD402", node,
+            f".{func.attr}() on `{shown}` can block forever: the "
+            f"socket has no timeout (settimeout it, pass timeout= at "
+            f"create_connection, or state the deadline-free contract "
+            f"with `# noqa: PD402` + a comment)",
+        )
+
+
+# ---------------------------------------------------------------------------
+# PD403 resource-leak
+
+_ACQUIRE_KINDS = {
+    "socket.socket": "socket",
+    "socket.create_connection": "socket",
+    "open": "file",
+    "tempfile.TemporaryDirectory": "tempdir",
+}
+_CLOSE_TAILS = ("close", "cleanup")
+
+
+def _acquisition_kind(mod: ModuleInfo, value: ast.AST) -> str | None:
+    if not isinstance(value, ast.Call):
+        return None
+    resolved = mod.resolve(value.func) or ""
+    kind = _ACQUIRE_KINDS.get(resolved)
+    if kind is not None:
+        return kind
+    if isinstance(value.func, ast.Attribute) \
+            and value.func.attr == "accept":
+        return "socket"
+    return None
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _escaped_names(fn: ast.AST) -> set[str]:
+    """Names whose object leaves the function's custody: returned or
+    yielded, passed to another call, or stored into an attribute/
+    subscript - the new owner closes it (PD403 stands down)."""
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and node.value is not None:
+            out |= _names_in(node.value)
+        elif isinstance(node, (ast.Yield, ast.YieldFrom)) \
+                and node.value is not None:
+            out |= _names_in(node.value)
+        elif isinstance(node, ast.Call):
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                out |= _names_in(arg)
+        elif isinstance(node, ast.Assign):
+            if any(isinstance(t, (ast.Attribute, ast.Subscript))
+                   for t in node.targets):
+                out |= _names_in(node.value)
+    return out
+
+
+def _close_context(mod: ModuleInfo, node: ast.AST) -> str:
+    """Where a close call sits: ``finally`` / ``except`` survive an
+    exception between acquire and close, ``straight`` does not."""
+    cur: ast.AST | None = node
+    while cur is not None:
+        par = mod.parents.get(cur)
+        if isinstance(par, ast.Try) and cur in par.finalbody:
+            return "finally"
+        if isinstance(par, ast.ExceptHandler):
+            return "except"
+        cur = par
+    return "straight"
+
+
+def _close_calls(fn: ast.AST, name: str) -> list[ast.Call]:
+    out = []
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _CLOSE_TAILS
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == name):
+            out.append(node)
+    return out
+
+
+def _function_defs(mod: ModuleInfo) -> Iterator[ast.FunctionDef]:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node  # type: ignore[misc]
+
+
+@register(
+    "PD403", "resource-leak",
+    "socket/open/TemporaryDirectory acquired on a path that can skip "
+    "its close: straight-line-only (or missing) close on a local, or "
+    "a partially-constructed __init__ attribute with no except/finally "
+    "close (use with/try-finally, close-and-reraise, or `# owner:`)",
+)
+def check_resource_leak(mod: ModuleInfo,
+                        index: PackageIndex) -> Iterator[Finding]:
+    # -- locals: acquire -> must close on every exit path ------------
+    for fn in _function_defs(mod):
+        nested = {n for sub in ast.walk(fn)
+                  if isinstance(sub, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))
+                  and sub is not fn
+                  for n in ast.walk(sub)}
+        escapes = _escaped_names(fn)
+        for node in ast.walk(fn):
+            if node in nested or not isinstance(node, ast.Assign):
+                continue
+            kind = _acquisition_kind(mod, node.value)
+            if kind is None:
+                continue
+            target = node.targets[0]
+            if isinstance(target, ast.Tuple) and target.elts:
+                target = target.elts[0]
+            if not isinstance(target, ast.Name):
+                continue  # attribute targets: the __init__ prong below
+            name = target.id
+            if _has_owner(mod, node.lineno) or name in escapes:
+                continue
+            contexts = {_close_context(mod, c)
+                        for c in _close_calls(fn, name)}
+            if "finally" in contexts or "except" in contexts:
+                continue
+            if contexts:
+                yield mod.finding(
+                    "PD403", node,
+                    f"`{name}` ({kind}) is closed only on the "
+                    f"straight-line path - an exception between "
+                    f"acquire and close leaks it (use `with` or "
+                    f"try/finally)",
+                )
+            else:
+                yield mod.finding(
+                    "PD403", node,
+                    f"`{name}` ({kind}) is acquired but never closed "
+                    f"in `{fn.name}` (close it, use `with`, or "
+                    f"declare the transfer with `# owner:`)",
+                )
+    # -- __init__: partial construction must not strand the resource -
+    for cls in ast.walk(mod.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        init = next((n for n in cls.body
+                     if isinstance(n, ast.FunctionDef)
+                     and n.name == "__init__"), None)
+        if init is None:
+            continue
+        protected = _init_protected_attrs(init)
+        for idx, stmt in enumerate(init.body):
+            if not isinstance(stmt, ast.Assign):
+                continue
+            kind = _acquisition_kind(mod, stmt.value)
+            if kind is None:
+                continue
+            target = stmt.targets[0]
+            if not (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"):
+                continue
+            attr = target.attr
+            if _has_owner(mod, stmt.lineno) or attr in protected:
+                continue
+            fallible = any(
+                isinstance(sub, ast.Call)
+                for later in init.body[idx + 1:]
+                for sub in ast.walk(later)
+            )
+            if fallible:
+                yield mod.finding(
+                    "PD403", stmt,
+                    f"`self.{attr}` ({kind}) leaks when a later "
+                    f"__init__ step raises: the object is never "
+                    f"published, nobody can close it (wrap the tail "
+                    f"in try/except closing `self.{attr}`, or "
+                    f"declare `# owner:`)",
+                )
+
+
+def _init_protected_attrs(init: ast.FunctionDef) -> set[str]:
+    """self-attrs that some except/finally inside __init__ closes."""
+    out: set[str] = set()
+    for node in ast.walk(init):
+        if not isinstance(node, ast.Try):
+            continue
+        regions = list(node.finalbody)
+        for handler in node.handlers:
+            regions.extend(handler.body)
+        for region in regions:
+            for sub in ast.walk(region):
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr in _CLOSE_TAILS
+                        and isinstance(sub.func.value, ast.Attribute)
+                        and isinstance(sub.func.value.value, ast.Name)
+                        and sub.func.value.value.id == "self"):
+                    out.add(sub.func.value.attr)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# PD404 unjoined-thread
+
+
+def _is_thread_ctor(mod: ModuleInfo, value: ast.AST) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    resolved = mod.resolve(value.func) or ""
+    return resolved == "threading.Thread" \
+        or resolved.rsplit(".", 1)[-1] == "Thread"
+
+
+def _daemon_kwarg(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return False
+
+
+@register(
+    "PD404", "unjoined-thread",
+    "non-daemon thread start()ed but never join()ed (and never handed "
+    "off) - process exit then blocks on it forever",
+)
+def check_unjoined_thread(mod: ModuleInfo,
+                          index: PackageIndex) -> Iterator[Finding]:
+    # chained Thread(...).start() can never be joined at all
+    for node in ast.walk(mod.tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "start"
+                and _is_thread_ctor(mod, node.func.value)
+                and not _daemon_kwarg(node.func.value)):
+            yield mod.finding(
+                "PD404", node,
+                "non-daemon `Thread(...).start()` is unbound - it can "
+                "never be joined (bind it and join, or daemon=True)",
+            )
+    # bound threads: started, non-daemon, no join on the binding name
+    bindings: dict[str, tuple[ast.Assign, bool]] = {}
+    daemon_marked: set[str] = set()
+    started: set[str] = set()
+    joined: set[str] = set()
+    escaped: set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign):
+            if _is_thread_ctor(mod, node.value):
+                for target in node.targets:
+                    key = _socket_key(target)
+                    if key is not None:
+                        bindings[key] = (node, _daemon_kwarg(node.value))
+            else:
+                # t.daemon = True before start
+                for target in node.targets:
+                    if isinstance(target, ast.Attribute) \
+                            and target.attr == "daemon":
+                        key = _socket_key(target.value)
+                        if key is not None and isinstance(
+                                node.value, ast.Constant) \
+                                and node.value.value:
+                            daemon_marked.add(key)
+                # ownership transfer: self.x = t / d[k] = t
+                if any(isinstance(t, (ast.Attribute, ast.Subscript))
+                       for t in node.targets) \
+                        and isinstance(node.value, ast.Name):
+                    escaped.add(f"n:{node.value.id}")
+        elif isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute):
+                key = _socket_key(node.func.value)
+                if key is not None:
+                    if node.func.attr == "start":
+                        started.add(key)
+                    elif node.func.attr == "join":
+                        joined.add(key)
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name):
+                    escaped.add(f"n:{arg.id}")
+                elif isinstance(arg, ast.Attribute):
+                    escaped.add(f"a:{arg.attr}")
+        elif isinstance(node, ast.Return) and node.value is not None:
+            for n in _names_in(node.value):
+                escaped.add(f"n:{n}")
+    for key, (node, daemon) in bindings.items():
+        if daemon or key in daemon_marked or key not in started:
+            continue
+        if key in joined or key in escaped:
+            continue
+        shown = key.split(":", 1)[1]
+        yield mod.finding(
+            "PD404", node,
+            f"non-daemon thread `{shown}` is start()ed but never "
+            f"join()ed (join it, mark daemon=True, or transfer "
+            f"ownership)",
+        )
+
+
+# ---------------------------------------------------------------------------
+# PD405 swallowed-loop-exception
+
+
+def _is_net_function(mod: ModuleInfo, fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _NET_TAILS:
+            return True
+        resolved = mod.resolve(node.func) or ""
+        if resolved.rsplit(".", 1)[-1] in _NET_TAILS and "." in resolved:
+            return True
+    return False
+
+
+def _handler_accounts(handler: ast.ExceptHandler) -> bool:
+    """A handler accounts for the failure when it re-raises, exits the
+    loop, replies (send*), records an event, or feeds a counter whose
+    name says failure."""
+    for node in ast.walk(handler):
+        if isinstance(node, (ast.Raise, ast.Return, ast.Break)):
+            return True
+        if isinstance(node, ast.Call):
+            func = node.func
+            tail = None
+            if isinstance(func, ast.Attribute):
+                tail = func.attr
+            elif isinstance(func, ast.Name):
+                tail = func.id
+            if tail is not None and (
+                    tail == "record" or tail.startswith("send")
+                    or tail.startswith("reply")):
+                return True
+            if isinstance(func, ast.Attribute) \
+                    and func.attr in _MUTATOR_METHODS \
+                    and isinstance(func.value, (ast.Name, ast.Attribute)):
+                base = func.value
+                name = base.id if isinstance(base, ast.Name) else base.attr
+                if _COUNTER_NAME_RE.search(name):
+                    return True
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for target in targets:
+                base = target
+                while isinstance(base, ast.Subscript):
+                    # counters keyed by name: stats["recv_failures"] += 1
+                    sl = base.slice
+                    if isinstance(sl, ast.Constant) \
+                            and isinstance(sl.value, str) \
+                            and _COUNTER_NAME_RE.search(sl.value):
+                        return True
+                    base = base.value
+                name = None
+                if isinstance(base, ast.Name):
+                    name = base.id
+                elif isinstance(base, ast.Attribute):
+                    name = base.attr
+                if name is not None and _COUNTER_NAME_RE.search(name):
+                    return True
+    return False
+
+
+@register(
+    "PD405", "swallowed-loop-exception",
+    "except inside a connection/ingest loop that neither re-raises, "
+    "exits, replies an error, records an event, nor feeds a failure "
+    "counter - a systematic fault becomes silence",
+)
+def check_swallowed_loop_exception(
+        mod: ModuleInfo, index: PackageIndex) -> Iterator[Finding]:
+    for fn in _function_defs(mod):
+        if not _is_net_function(mod, fn):
+            continue
+        nested = {n for sub in ast.walk(fn)
+                  if isinstance(sub, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))
+                  and sub is not fn
+                  for n in ast.walk(sub)}
+        for loop in ast.walk(fn):
+            if not isinstance(loop, (ast.While, ast.For)) \
+                    or loop in nested:
+                continue
+            for stmt in loop.body:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Try) or node in nested:
+                        continue
+                    for handler in node.handlers:
+                        if not _handler_accounts(handler):
+                            yield mod.finding(
+                                "PD405", handler,
+                                f"exception swallowed inside the "
+                                f"connection/ingest loop of "
+                                f"`{fn.name}`: count it "
+                                f"(*_failed/errors), record() it, "
+                                f"reply an error, or re-raise",
+                            )

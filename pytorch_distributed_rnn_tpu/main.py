@@ -283,6 +283,10 @@ def main(argv=None):
     # parse first (no JAX computation happens there) so --help and bad
     # command lines fail fast instead of blocking on a rendezvous
     args = build_parser().parse_args(argv)
+    from pytorch_distributed_rnn_tpu.utils import leakcheck
+
+    # resolve PDRNN_LEAKCHECK before the first socket/thread/file
+    leakcheck.maybe_install()
     # env-gated multi-host rendezvous (PDRNN_COORDINATOR, or MASTER_ADDR
     # under PDRNN_MULTIHOST=1): must run before the first JAX computation;
     # no-op single-controller otherwise.  The mpirun analogue - SURVEY.md §5.

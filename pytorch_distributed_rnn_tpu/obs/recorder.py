@@ -292,6 +292,11 @@ class MetricsRecorder:
             # the sentinel's violation alerts land in THIS sidecar, and
             # its faulthandler dumps next to it (stacks_path_for)
             threadcheck.install(recorder=self)
+        from pytorch_distributed_rnn_tpu.utils import leakcheck
+
+        if leakcheck.installed():
+            # same self-register contract for the leak sentinel
+            leakcheck.install(recorder=self)
 
     # -- construction --------------------------------------------------------
 

@@ -132,6 +132,11 @@ def install_stack_dump_handler(sidecar_path) -> Path | None:
         except OSError:  # pragma: no cover
             pass
     _signal_dump_file = f
+    # the handler file deliberately lives until process exit (replaced
+    # only by a re-install above) - exempt it from the leak sentinel;
+    # lazy import: leakcheck's violation path imports this module
+    from pytorch_distributed_rnn_tpu.utils import leakcheck
+    leakcheck.adopt(f, reason="sigusr2 stack-dump sink")
     log.info(f"stack-dump handler: SIGUSR2 -> {path}")
     return path
 

@@ -330,6 +330,7 @@ class ParameterServerMaster:
                     # to the replacement thread - exit instead of racing
                     # it on the wire framing
                     return
+            # protocol: ps handles DONE, REGISTER, DEREGISTER, PULL, PUSH
             opcode, grads, seq = protocol.recv_request(
                 self.comm, worker, self.num_params
             )
@@ -353,6 +354,7 @@ class ParameterServerMaster:
                     # could interleave with a concurrent update and ship
                     # a half-applied view (per-worker sockets keep the
                     # send short and uncontended)
+                    # protocol: ps reply PULL
                     protocol.send_params(self.comm, worker,  # noqa: PD302 - deliberate send-under-lock, see comment
                                          self.params)
                 continue
@@ -392,6 +394,7 @@ class ParameterServerMaster:
                 )
                 with self.lock:
                     # same hold contract as the OP_PULL reply above
+                    # protocol: ps reply PUSH
                     protocol.send_params(self.comm, worker,  # noqa: PD302 - deliberate send-under-lock, see OP_PULL
                                          self.params)
                 continue
@@ -435,6 +438,7 @@ class ParameterServerMaster:
         with self.lock:
             step_watermark = self.updates_applied
             seq_watermark = member.push_seq
+            # protocol: ps reply REGISTER
             protocol.send_state_sync(
                 self.comm, worker, self.params, step_watermark,
                 seq_watermark,

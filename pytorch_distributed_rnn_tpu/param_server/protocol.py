@@ -70,14 +70,18 @@ from __future__ import annotations
 
 import numpy as np
 
-OP_PULL = 1
-OP_PUSH = 2
-OP_DONE = 3
-OP_REGISTER = 4
-OP_DEREGISTER = 5
-OP_STATE_SYNC = 6
-OP_EXPERIENCE = 7
-OP_PARAMS_AT = 8
+# The `# protocol: ps ...` trailers are the PD401 wire-contract
+# registry (lint/lifecycle.py): every op declared here must name at
+# least one `handles` site, and every `request` site must pair with a
+# `reply` site unless the op is `oneway` (fire-and-forget).
+OP_PULL = 1          # protocol: ps op PULL
+OP_PUSH = 2          # protocol: ps op PUSH
+OP_DONE = 3          # protocol: ps op DONE oneway
+OP_REGISTER = 4      # protocol: ps op REGISTER
+OP_DEREGISTER = 5    # protocol: ps op DEREGISTER oneway
+OP_STATE_SYNC = 6    # protocol: ps op STATE_SYNC
+OP_EXPERIENCE = 7    # protocol: ps op EXPERIENCE
+OP_PARAMS_AT = 8     # protocol: ps op PARAMS_AT
 
 # EXPERIENCE reply statuses (the first float of the verdict header)
 EXP_OK = 0

@@ -154,7 +154,7 @@ class ParameterServerWorkerTrainer(Trainer):
             )
 
     def _pull_params(self):
-        protocol.send_request(self.comm, protocol.OP_PULL)
+        protocol.send_request(self.comm, protocol.OP_PULL)  # protocol: ps request PULL
         return protocol.recv_params(self.comm, self.num_params)
 
     def _state_sync(self):
@@ -164,9 +164,11 @@ class ParameterServerWorkerTrainer(Trainer):
         off instead of re-pushing every epoch from scratch."""
 
         def register():
+            # protocol: ps request REGISTER
             protocol.send_request(
                 self.comm, protocol.OP_REGISTER, seq=self.worker_id
             )
+            # protocol: ps handles STATE_SYNC
             return protocol.recv_state_sync(self.comm, self.num_params)
 
         t0 = time.perf_counter()
@@ -257,6 +259,7 @@ class ParameterServerWorkerTrainer(Trainer):
         )
 
         def push_pull(flat_grads, seq):
+            # protocol: ps request PUSH
             protocol.send_request(
                 self.comm, protocol.OP_PUSH, grads=flat_grads, seq=seq
             )
@@ -284,13 +287,14 @@ class ParameterServerWorkerTrainer(Trainer):
         return step
 
     def finish(self):
-        protocol.send_request(self.comm, protocol.OP_DONE)
+        protocol.send_request(self.comm, protocol.OP_DONE)  # protocol: ps request DONE
 
     def deregister(self):
         """Voluntary leave (the drain path): tell the master this worker
         is exiting on purpose - the roster shrinks without burning the
         quorum budget - and record the drain on this rank's sidecar so
         ``pdrnn-metrics health`` classifies it drained, not dead."""
+        # protocol: ps request DEREGISTER
         protocol.send_request(
             self.comm, protocol.OP_DEREGISTER, seq=self._push_seq
         )

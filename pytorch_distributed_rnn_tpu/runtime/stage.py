@@ -103,7 +103,8 @@ class LinkEnd:
         self.on_event = on_event
         self.peer = 1 if mode == self.HOST else 0
         self.recv_next = 0
-        self.stats = {"reconnects": 0, "replayed": 0, "dup_drops": 0}
+        self.stats = {"reconnects": 0, "replayed": 0, "dup_drops": 0,
+                      "recv_failures": 0}
         self._buf: dict[int, np.ndarray] = {}
         self._sent_next = 0  # highest seq handed to send() + 1
         # the host end binds its listener at construction time, before
@@ -168,6 +169,15 @@ class LinkEnd:
         return replayed
 
     def _handshake(self) -> int:
+        # The link wire contract (PD401 registry, lint/lifecycle.py):
+        # a watermark HANDSHAKE exchange, then FRAME fire-and-forget
+        # (loss is repaired by the next handshake's replay, not by a
+        # per-frame ack).
+        # protocol: link op HANDSHAKE
+        # protocol: link op FRAME oneway
+        # protocol: link request HANDSHAKE
+        # protocol: link reply HANDSHAKE - the peer's watermark below
+        # protocol: link handles HANDSHAKE
         mine = np.array([self.recv_next], dtype=np.int64)
         self._comm.send(self.peer, mine)
         peer_next = int(self._comm.recv(self.peer, (1,), np.int64)[0])
@@ -199,6 +209,7 @@ class LinkEnd:
     # -- framed exchange -----------------------------------------------------
 
     def _wire_send(self, seq: int, array: np.ndarray):
+        # protocol: link request FRAME
         header = np.array([seq, array.nbytes], dtype=np.int64)
         self._comm.send(self.peer, header)
         self._comm.send(self.peer, array)
@@ -226,6 +237,7 @@ class LinkEnd:
         )
         while True:
             try:
+                # protocol: link handles FRAME
                 header = self._comm.recv(self.peer, (2,), np.int64)
                 seq, nbytes = int(header[0]), int(header[1])
                 if nbytes != expected_nbytes:
@@ -238,6 +250,7 @@ class LinkEnd:
             except LinkBroken:
                 raise
             except RuntimeError:
+                self.stats["recv_failures"] += 1
                 log.warning(f"{self.name}: recv hit a dead peer; reconnecting")
                 self.connect()
                 continue

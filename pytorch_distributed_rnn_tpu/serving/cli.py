@@ -47,6 +47,8 @@ import sys
 import threading
 from pathlib import Path
 
+from pytorch_distributed_rnn_tpu.utils import leakcheck
+
 log = logging.getLogger(__name__)
 
 
@@ -207,6 +209,8 @@ def _resolve_checkpoint(path: Path) -> Path:
 def serve_main(argv=None) -> int:
     args = build_serve_parser().parse_args(argv)
     logging.basicConfig(level=args.log.upper())
+    # before any socket/thread/file exists, so every acquisition is seen
+    leakcheck.maybe_install()
 
     import jax
 
@@ -439,6 +443,7 @@ def loadgen_main(argv=None) -> int:
 
     args = build_loadgen_parser().parse_args(argv)
     logging.basicConfig(level="INFO")
+    leakcheck.maybe_install()
     cfg = LoadConfig(
         requests=args.requests, rate=args.rate,
         prompt_len_min=args.prompt_len_min,

@@ -28,6 +28,8 @@ import threading
 import time
 from pathlib import Path
 
+from pytorch_distributed_rnn_tpu.utils import leakcheck
+
 log = logging.getLogger(__name__)
 
 
@@ -151,6 +153,8 @@ def _await_port_files(paths: list[Path],
 def router_main(argv=None) -> int:
     args = build_router_parser().parse_args(argv)
     logging.basicConfig(level=args.log.upper())
+    # before any socket/thread/file exists, so every acquisition is seen
+    leakcheck.maybe_install()
 
     from pytorch_distributed_rnn_tpu.obs.live import LivePlane
     from pytorch_distributed_rnn_tpu.obs.recorder import MetricsRecorder

@@ -71,8 +71,12 @@ class TcpReplicaConnection:
         self.sock = socket.create_connection(
             (host, port), timeout=connect_timeout_s
         )
-        self.sock.settimeout(io_timeout_s)
-        self._rfile = self.sock.makefile("r", encoding="utf-8")
+        try:
+            self.sock.settimeout(io_timeout_s)
+            self._rfile = self.sock.makefile("r", encoding="utf-8")
+        except Exception:
+            self.sock.close()
+            raise
 
     def send(self, obj: dict) -> None:
         self.sock.sendall(encode_line(obj))
@@ -384,7 +388,7 @@ class ReplicaPool:
         except (OSError, ProtocolError):
             return False, None
         try:
-            conn.send({"op": "ping"})
+            conn.send({"op": "ping"})  # protocol: serve request ping
             reply = conn.recv()
             if reply.get("event") != "pong":
                 return False, None

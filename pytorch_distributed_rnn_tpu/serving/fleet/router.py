@@ -60,7 +60,7 @@ from pytorch_distributed_rnn_tpu.serving.protocol import (
     ProtocolError,
     encode_line,
 )
-from pytorch_distributed_rnn_tpu.utils import threadcheck
+from pytorch_distributed_rnn_tpu.utils import leakcheck, threadcheck
 
 log = logging.getLogger(__name__)
 
@@ -345,7 +345,7 @@ class RouterCore:
             cancel_box["conn"] = conn
         ok: bool | None = None
         try:
-            conn.send(msg)
+            conn.send(msg)  # protocol: serve request generate
             while True:
                 if expiry is not None:
                     remaining = expiry - time.monotonic()
@@ -528,11 +528,18 @@ class RouterServer:
         self.core = core
         self.recorder = recorder if recorder is not None else NULL_RECORDER
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._listener.bind((host, port))
-        self._listener.listen(128)
-        self.host, self.port = self._listener.getsockname()[:2]
+        try:
+            self._listener.setsockopt(
+                socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._listener.bind((host, port))
+            self._listener.listen(128)
+            self.host, self.port = self._listener.getsockname()[:2]
+        except Exception:
+            self._listener.close()
+            raise
         self._stop = threading.Event()
+        self._conns_lock = threadcheck.lock(threading.Lock(), "router.conns")  # guards: _conns
+        self._conns: set[socket.socket] = set()
         self._threads: list[threading.Thread] = []
         self._started = False
         self._t_start = time.perf_counter()
@@ -573,6 +580,18 @@ class RouterServer:
         for thread in self._threads:
             thread.join(timeout=10.0)
         self.core.pool.close()
+        # force-drop any client connection whose reader has not exited
+        # yet: after this, nothing of ours may still hold a socket -
+        # which is exactly what the leak sentinel now verifies
+        with self._conns_lock:
+            victims = list(self._conns)
+            self._conns.clear()
+        for sock in victims:
+            try:
+                sock.close()
+            except OSError:  # pragma: no cover - already closed
+                pass
+        leakcheck.check_drained("router.shutdown")
         if self.recorder.enabled:
             self.recorder.record(
                 "router_drain",
@@ -597,7 +616,9 @@ class RouterServer:
     def _accept_loop(self):
         while not self._stop.is_set():
             try:
-                conn, _addr = self._listener.accept()
+                # deadline-free by contract: shutdown() closing the
+                # listener unblocks this accept with OSError
+                conn, _addr = self._listener.accept()  # noqa: PD402
             except OSError:  # listener closed = shutdown
                 return
             handler = threading.Thread(
@@ -609,6 +630,8 @@ class RouterServer:
     def _handle(self, conn: socket.socket):
         wlock = threadcheck.lock(threading.Lock(), "router.conn.write")
         alive = {"ok": True}
+        with self._conns_lock:
+            self._conns.add(conn)
 
         def send(obj: dict):
             # dispatch threads (hedges) and the reader both write here;
@@ -617,7 +640,10 @@ class RouterServer:
                 if not alive["ok"]:
                     return
                 try:
-                    conn.sendall(encode_line(obj))
+                    # client-paced by contract: a timeout here would
+                    # drop slow-but-alive clients; dead peers surface
+                    # as OSError and just mark the conn down
+                    conn.sendall(encode_line(obj))  # noqa: PD402
                 except OSError:
                     alive["ok"] = False
 
@@ -641,6 +667,8 @@ class RouterServer:
             pass
         finally:
             alive["ok"] = False
+            with self._conns_lock:
+                self._conns.discard(conn)
             try:
                 rfile.close()
             finally:
@@ -649,6 +677,8 @@ class RouterServer:
     # -- ops -----------------------------------------------------------------
 
     def _dispatch_op(self, msg: dict, send):
+        # protocol: serve handles ping, stats, generate
+        # protocol: serve reply ping - pong/error below
         op = msg.get("op")
         if op == "ping":
             info = self.core.pool.pong_info()
@@ -667,8 +697,10 @@ class RouterServer:
                 },
             })
         elif op == "stats":
-            send({"event": "stats", **self.core.stats()})
+            send({"event": "stats", **self.core.stats()})  # protocol: serve reply stats
         elif op == "generate":
+            # protocol: serve reply generate - relayed token stream +
+            # terminal done/error from handle_generate
             self.core.handle_generate(msg, send)
         else:
             send({

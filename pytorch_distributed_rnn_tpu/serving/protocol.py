@@ -43,6 +43,13 @@ import json
 import socket
 import time
 
+# The serve wire contract - the PD401 registry (lint/lifecycle.py):
+# every op below must name a `handles` dispatch site, every `request`
+# site must pair with a `reply` site.
+# protocol: serve op generate
+# protocol: serve op ping
+# protocol: serve op stats
+
 
 def encode_line(obj: dict) -> bytes:
     return (json.dumps(obj) + "\n").encode()
@@ -88,9 +95,13 @@ class ServingClient:
             timeout=timeout_s if connect_timeout_s is None
             else connect_timeout_s,
         )
-        self.sock.settimeout(timeout_s)
-        self.timeout_s = float(timeout_s)
-        self._rfile = self.sock.makefile("r", encoding="utf-8")
+        try:
+            self.sock.settimeout(timeout_s)
+            self.timeout_s = float(timeout_s)
+            self._rfile = self.sock.makefile("r", encoding="utf-8")
+        except Exception:
+            self.sock.close()
+            raise
 
     def close(self):
         try:
@@ -122,13 +133,13 @@ class ServingClient:
     # -- ops -----------------------------------------------------------------
 
     def ping(self) -> dict:
-        reply = self.request({"op": "ping"})
+        reply = self.request({"op": "ping"})  # protocol: serve request ping
         if reply.get("event") != "pong":
             raise ProtocolError(f"expected pong, got {reply}")
         return reply
 
     def stats(self) -> dict:
-        reply = self.request({"op": "stats"})
+        reply = self.request({"op": "stats"})  # protocol: serve request stats
         if reply.get("event") != "stats":
             raise ProtocolError(f"expected stats, got {reply}")
         return reply
@@ -164,7 +175,7 @@ class ServingClient:
             req["priority"] = str(priority)
         if deadline_ms is not None:
             req["deadline_ms"] = float(deadline_ms)
-        self._send(req)
+        self._send(req)  # protocol: serve request generate
         expiry = (
             None if deadline_s is None
             else time.monotonic() + float(deadline_s)

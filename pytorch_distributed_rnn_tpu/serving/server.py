@@ -46,7 +46,7 @@ from pytorch_distributed_rnn_tpu.serving.protocol import (
     tokens_to_text,
 )
 from pytorch_distributed_rnn_tpu.serving.scheduler import ServeRequest
-from pytorch_distributed_rnn_tpu.utils import threadcheck
+from pytorch_distributed_rnn_tpu.utils import leakcheck, threadcheck
 
 log = logging.getLogger(__name__)
 
@@ -67,10 +67,15 @@ class ServingServer:
             flap_s = float(os.environ.get(FAULT_FLAP_ENV, 0) or 0)
         self.flap_s = float(flap_s)
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._listener.bind((host, port))
-        self._listener.listen(128)
-        self.host, self.port = self._listener.getsockname()[:2]
+        try:
+            self._listener.setsockopt(
+                socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._listener.bind((host, port))
+            self._listener.listen(128)
+            self.host, self.port = self._listener.getsockname()[:2]
+        except Exception:
+            self._listener.close()
+            raise
         self._stop = threading.Event()
         self._draining = threading.Event()
         self._conns_lock = threadcheck.lock(threading.Lock(), "server.conns")  # guards: _conns
@@ -139,6 +144,18 @@ class ServingServer:
         for thread in self._threads:
             thread.join(timeout=10.0)
         self.engine.close()
+        # force-drop any client connection whose reader has not exited
+        # yet: after this, nothing of ours may still hold a socket -
+        # which is exactly what the leak sentinel now verifies
+        with self._conns_lock:
+            victims = list(self._conns)
+            self._conns.clear()
+        for sock in victims:
+            try:
+                sock.close()
+            except OSError:  # pragma: no cover - already closed
+                pass
+        leakcheck.check_drained("serve.shutdown")
         if self.pusher is not None:
             self.pusher(
                 "replica_drain", severity="info",
@@ -160,7 +177,9 @@ class ServingServer:
     def _accept_loop(self):
         while not self._stop.is_set():
             try:
-                conn, _addr = self._listener.accept()
+                # deadline-free by contract: shutdown() closing the
+                # listener unblocks this accept with OSError
+                conn, _addr = self._listener.accept()  # noqa: PD402
             except OSError:  # listener closed = shutdown
                 return
             handler = threading.Thread(
@@ -201,7 +220,10 @@ class ServingServer:
                 if not alive["ok"]:
                     return
                 try:
-                    conn.sendall(encode_line(obj))
+                    # client-paced by contract: a timeout here would
+                    # drop slow-but-alive clients; dead peers surface
+                    # as OSError/flap and just mark the conn down
+                    conn.sendall(encode_line(obj))  # noqa: PD402
                 except OSError:
                     alive["ok"] = False
 
@@ -235,8 +257,10 @@ class ServingServer:
     # -- ops -----------------------------------------------------------------
 
     def _dispatch(self, msg: dict, send):
+        # protocol: serve handles ping, stats, generate
         op = msg.get("op")
         if op == "ping":
+            # protocol: serve reply ping
             send({
                 "event": "pong", "model": self.model_name,
                 "vocab_size": self.engine.adapter.vocab_size,
@@ -248,8 +272,10 @@ class ServingServer:
         elif op == "stats":
             stats = self.engine.stats()
             stats.pop("trace_counts", None)
-            send({"event": "stats", **stats})
+            send({"event": "stats", **stats})  # protocol: serve reply stats
         elif op == "generate":
+            # protocol: serve reply generate - done/error/token events
+            # (the draining rejection below and every _generate exit)
             if self._draining.is_set():
                 # a draining replica finishes what it owns but accepts
                 # nothing new - an EXPLICIT rejection (never a silent

@@ -163,9 +163,11 @@ class StreamingActor:
         )
 
         def register():
+            # protocol: ps request REGISTER
             protocol.send_request(
                 self.comm, protocol.OP_REGISTER, seq=self.worker_id
             )
+            # protocol: ps handles STATE_SYNC
             return protocol.recv_state_sync(self.comm, self.num_params)
 
         t0 = time.perf_counter()
@@ -239,7 +241,7 @@ class StreamingActor:
 
     def _refresh_params(self) -> None:
         def params_at():
-            protocol.send_request(self.comm, protocol.OP_PARAMS_AT)
+            protocol.send_request(self.comm, protocol.OP_PARAMS_AT)  # protocol: ps request PARAMS_AT
             return protocol.recv_params_at(self.comm, self.num_params)
 
         flat, version = self._exchange(params_at, what="params refresh")
@@ -274,7 +276,7 @@ class StreamingActor:
         version = self.version
 
         def push():
-            protocol.send_experience(self.comm, seq, version, payload)
+            protocol.send_experience(self.comm, seq, version, payload)  # protocol: ps request EXPERIENCE
             return protocol.recv_experience_reply(self.comm)
 
         return self._exchange(push, what="experience push", seq=seq)
@@ -349,6 +351,7 @@ class StreamingActor:
                 # SIGTERM here, so the last push is applied exactly once
                 self._drain.check()
         self._exchange(
+            # protocol: ps request DONE
             lambda: protocol.send_request(self.comm, protocol.OP_DONE),
             what="done",
         )
@@ -371,6 +374,7 @@ class StreamingActor:
     def deregister(self) -> None:
         """Voluntary leave (the drain path): the roster shrinks without
         burning respawn budget; ``health`` reads the drain, not a death."""
+        # protocol: ps request DEREGISTER
         protocol.send_request(
             self.comm, protocol.OP_DEREGISTER, seq=self.seq
         )

@@ -313,6 +313,7 @@ class ExperienceLearner:
             t0 = time.perf_counter()
             version = self.version
             seq_watermark = member.push_seq
+            # protocol: ps reply REGISTER
             protocol.send_state_sync(  # noqa: PD302 - deliberate: the reply must quote the params/version pair it snapshotted (see comment above)
                 self.comm, rank, self.params, version, seq_watermark
             )
@@ -340,6 +341,7 @@ class ExperienceLearner:
                 # the rank's socket slot was re-accepted: the new fd
                 # belongs to the replacement thread
                 return
+            # protocol: ps handles DONE, REGISTER, DEREGISTER, PARAMS_AT, EXPERIENCE
             opcode, _, seq = protocol.recv_request(
                 self.comm, rank, self.num_params
             )
@@ -361,6 +363,7 @@ class ExperienceLearner:
                     # hold contract: version and params are one atomic
                     # pair; a send outside the lock could quote a version
                     # the params no longer match
+                    # protocol: ps reply PARAMS_AT
                     protocol.send_params_at(  # noqa: PD302 - deliberate send-under-lock, see comment
                         self.comm, rank, self.version, self.params
                     )
@@ -372,6 +375,7 @@ class ExperienceLearner:
                 status, current, throttle = self.ingest(
                     rank, seq, version, payload
                 )
+                # protocol: ps reply EXPERIENCE
                 protocol.send_experience_reply(
                     self.comm, rank, status, current, throttle
                 )

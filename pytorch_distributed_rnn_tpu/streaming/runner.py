@@ -273,6 +273,10 @@ def build_parser(parser=None):
 
 def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
+    from pytorch_distributed_rnn_tpu.utils import leakcheck
+
+    # before any socket/thread/file exists, so every acquisition is seen
+    leakcheck.maybe_install()
     run(args)
 
 

@@ -1,0 +1,415 @@
+"""Runtime resource-leak sentinel (``PDRNN_LEAKCHECK``).
+
+The dynamic half of the PD4xx lifecycle pass (``lint/lifecycle.py`` is
+the static half): where the lint proves close-on-every-path about the
+acquisitions it can SEE, the sentinel checks the ones that actually
+HAPPEN.  With the sentinel off nothing is patched - ``socket.socket``,
+``builtins.open``, ``tempfile.TemporaryDirectory`` and
+``threading.Thread.start`` keep their stdlib identity, no extra
+threads, no per-acquire bookkeeping; the same zero-overhead-when-off
+doctrine as :mod:`utils.threadcheck` and ``NULL_RECORDER``.  With
+``PDRNN_LEAKCHECK=1`` (on in the CI chaos, serving, streaming and
+fleet jobs) the factories become tracking wrappers and every
+acquisition records its creation stack:
+
+- **sockets** - created via ``socket.socket(...)`` / everything built
+  on it (``create_connection``, ``accept``); drained when closed or
+  detached.
+- **files** - ``open(...)`` returns; drained when ``.closed``.
+- **tempdirs** - ``tempfile.TemporaryDirectory``; drained on
+  ``cleanup()`` (or when the directory is gone).
+- **threads** - non-daemon ``Thread.start()``; drained once no longer
+  alive (a successful ``join`` therefore drains it).
+
+:func:`check_drained` is the drain boundary: server/router SIGTERM
+shutdowns call it after closing their listeners/conns/threads, and an
+``atexit`` hook runs it at process exit.  Anything still live raises
+(or, at non-raising boundaries, alerts): a structured ``alert`` event
+(``alert=resource_leak``) carrying each leak's kind, name, age and
+creation stack goes through whatever recorder :func:`install` was
+given, is flushed, and a faulthandler all-thread dump lands in the
+watchdog's sidecar-adjacent stacks file - the post-mortem is on disk
+before the exception unwinds.
+
+Deliberately long-lived resources (a cached connection owned by a
+pool, a module-lifetime log file) are excused with :func:`adopt` - the
+runtime spelling of the lint's ``# owner:`` comment.
+
+Activation mirrors threadcheck: the first :func:`maybe_install` call
+(every CLI entry point makes one) resolves ``PDRNN_LEAKCHECK`` once;
+:func:`install` forces the sentinel on (tests, drills) and
+:func:`uninstall` restores the original factories.  The metrics
+recorder self-registers on construction, so alerts reach the rank's
+sidecar without extra wiring.
+"""
+
+from __future__ import annotations
+
+import builtins
+import logging
+import os
+import socket as socket_mod
+import tempfile
+import threading
+import time
+import traceback
+import weakref
+
+log = logging.getLogger(__name__)
+
+LEAKCHECK_ENV = "PDRNN_LEAKCHECK"
+_OFF_VALUES = ("", "0", "false", "off", "no")
+# lazy prune threshold: registries of short-lived trackables (files!)
+# must not grow without bound over a long run
+_PRUNE_AT = 512
+
+
+class LeakError(RuntimeError):
+    """A drain boundary found resources still live: some exit path
+    skipped a close/join (the runtime PD403/PD404)."""
+
+
+def _creation_stack() -> list[str]:
+    """Trimmed creation stack: the wrapper frames themselves are
+    noise, the caller's frames are the evidence."""
+    frames = traceback.format_stack(limit=18)[:-2]
+    return [ln.rstrip("\n") for ln in frames][-12:]
+
+
+class _Sentinel:
+    """Process-wide tracking state.  Its mutex is a leaf, only held
+    for dict surgery - never while closing or joining anything - so
+    the sentinel cannot deadlock the patient."""
+
+    def __init__(self, recorder=None):
+        from pytorch_distributed_rnn_tpu.obs.recorder import NULL_RECORDER
+
+        self.recorder = recorder if recorder is not None else NULL_RECORDER
+        self._mu = threading.Lock()
+        # id(obj) -> entry dict (kind, name, ref, stack, t0)
+        self.entries: dict[int, dict] = {}
+        self.created: dict[str, int] = {
+            "socket": 0, "file": 0, "tempdir": 0, "thread": 0,
+        }
+        self.adopted = 0
+        self.seq = 0
+        self.violations: list[dict] = []
+        self._reporting = threading.local()
+        self._originals: dict = {}
+        self.patched = False
+
+    # -- registry ------------------------------------------------------
+
+    def track(self, kind: str, obj, name: str) -> None:
+        try:
+            ref = weakref.ref(obj)
+        except TypeError:
+            return  # not weakrefable: cannot track without pinning it
+        entry = {
+            "kind": kind, "name": name, "ref": ref,
+            "stack": _creation_stack(), "t0": time.monotonic(),
+        }
+        with self._mu:
+            self.created[kind] += 1
+            self.entries[id(obj)] = entry
+            if len(self.entries) > _PRUNE_AT:
+                self._prune_locked()
+
+    def untrack(self, obj) -> None:
+        with self._mu:
+            self.entries.pop(id(obj), None)
+
+    def adopt(self, obj, reason: str = "") -> None:
+        with self._mu:
+            if self.entries.pop(id(obj), None) is not None:
+                self.adopted += 1
+
+    def _is_leaked(self, entry: dict):
+        """The live object when the entry still holds a leak, else
+        None (GC'd, closed, finished - all count as drained)."""
+        obj = entry["ref"]()
+        if obj is None:
+            return None
+        kind = entry["kind"]
+        try:
+            if kind == "socket":
+                return obj if obj.fileno() != -1 else None
+            if kind == "file":
+                return obj if not obj.closed else None
+            if kind == "tempdir":
+                return obj if os.path.exists(obj.name) else None
+            if kind == "thread":
+                if (obj.is_alive() and not obj.daemon
+                        and obj is not threading.current_thread()
+                        and obj is not threading.main_thread()):
+                    return obj
+                return None
+        except Exception:  # pragma: no cover - defensive
+            return None
+        return None
+
+    def _prune_locked(self) -> None:
+        dead = [key for key, entry in self.entries.items()
+                if self._is_leaked(entry) is None]
+        for key in dead:
+            del self.entries[key]
+
+    def leaks(self) -> list[dict]:
+        now = time.monotonic()
+        with self._mu:
+            entries = list(self.entries.values())
+        out = []
+        for entry in entries:
+            if self._is_leaked(entry) is not None:
+                out.append({
+                    "kind": entry["kind"], "name": entry["name"],
+                    "age_s": round(now - entry["t0"], 3),
+                    "stack": entry["stack"],
+                })
+        return out
+
+    def check(self, boundary: str, raise_on_leak: bool) -> list[dict]:
+        found = self.leaks()
+        if found:
+            self._violation(boundary, found, raise_on_leak)
+        return found
+
+    # -- reporting -----------------------------------------------------
+
+    def _alert(self, severity: str = "error", **fields):
+        with self._mu:
+            self.seq += 1
+            seq = self.seq
+        payload = dict(alert="resource_leak", severity=severity,
+                       seq=seq, source="leakcheck", **fields)
+        try:
+            self.recorder.record("alert", **payload)
+            self.recorder.flush()
+        except Exception:  # diagnosis must never kill the patient
+            log.exception("leakcheck: alert emission failed")
+        return payload
+
+    def _violation(self, boundary: str, found: list[dict],
+                   raise_on_leak: bool) -> None:
+        msg = (
+            f"leakcheck: {len(found)} resource(s) still live at "
+            f"drain boundary '{boundary}': "
+            + ", ".join(f"{f['kind']} {f['name']} ({f['age_s']}s)"
+                        for f in found[:8])
+        )
+        if getattr(self._reporting, "active", False):
+            if raise_on_leak:
+                raise LeakError(msg)
+            return
+        self._reporting.active = True
+        try:
+            payload = self._alert(boundary=boundary, count=len(found),
+                                  leaks=found)
+            self.violations.append(payload)
+            path = getattr(self.recorder, "path", None)
+            if path is not None:
+                try:
+                    from pytorch_distributed_rnn_tpu.obs import watchdog
+
+                    watchdog.dump_stacks(
+                        watchdog.stacks_path_for(path),
+                        reason=f"leakcheck:resource_leak:{boundary}",
+                    )
+                except Exception:
+                    log.exception("leakcheck: stack dump failed")
+        finally:
+            self._reporting.active = False
+        log.error(msg)
+        for f in found:
+            log.error("leakcheck: %s %r created at:\n%s", f["kind"],
+                      f["name"], "\n".join(f["stack"]))
+        if raise_on_leak:
+            raise LeakError(msg)
+
+    # -- factory patches -----------------------------------------------
+
+    def patch(self) -> None:
+        if self.patched:
+            return
+        self.patched = True
+        sentinel = self
+        raw_socket = socket_mod.socket
+        raw_open = builtins.open
+        raw_tempdir = tempfile.TemporaryDirectory
+        raw_start = threading.Thread.start
+        self._originals = {
+            "socket": raw_socket, "open": raw_open,
+            "tempdir": raw_tempdir, "start": raw_start,
+        }
+
+        class TrackedSocket(raw_socket):  # type: ignore[valid-type,misc]
+            # patching the MODULE attribute covers every construction
+            # path: create_connection and accept() both build their
+            # sockets through the module-global `socket` name
+
+            def __init__(self, *a, **kw):
+                super().__init__(*a, **kw)
+                sentinel.track("socket", self, _sock_label(self))
+
+            def close(self):
+                sentinel.untrack(self)
+                super().close()
+
+            def detach(self):
+                sentinel.untrack(self)
+                return super().detach()
+
+        def tracked_open(file, *a, **kw):
+            fh = raw_open(file, *a, **kw)
+            try:
+                sentinel.track("file", fh, str(file))
+            except Exception:  # pragma: no cover - defensive
+                pass
+            return fh
+
+        class TrackedTempDir(raw_tempdir):  # type: ignore[valid-type,misc]
+            def __init__(self, *a, **kw):
+                super().__init__(*a, **kw)
+                sentinel.track("tempdir", self, self.name)
+
+            def cleanup(self):
+                sentinel.untrack(self)
+                super().cleanup()
+
+        def tracked_start(thread, *a, **kw):
+            if not thread.daemon:
+                sentinel.track("thread", thread, thread.name)
+            return raw_start(thread, *a, **kw)
+
+        socket_mod.socket = TrackedSocket  # type: ignore[misc]
+        builtins.open = tracked_open  # type: ignore[assignment]
+        tempfile.TemporaryDirectory = TrackedTempDir  # type: ignore[misc]
+        threading.Thread.start = tracked_start  # type: ignore[assignment]
+
+    def unpatch(self) -> None:
+        if not self.patched:
+            return
+        self.patched = False
+        socket_mod.socket = self._originals["socket"]
+        builtins.open = self._originals["open"]
+        tempfile.TemporaryDirectory = self._originals["tempdir"]
+        threading.Thread.start = self._originals["start"]
+        self._originals = {}
+
+
+def _sock_label(sock) -> str:
+    try:
+        return f"socket(fd={sock.fileno()})"
+    except OSError:  # pragma: no cover - defensive
+        return "socket(fd=?)"
+
+
+# ---------------------------------------------------------------------------
+# module-level switchboard (threadcheck's shape)
+
+_STATE: _Sentinel | None = None
+_RESOLVED = False
+_ATEXIT_REGISTERED = False
+
+
+def _register_atexit() -> None:
+    global _ATEXIT_REGISTERED
+    if _ATEXIT_REGISTERED:
+        return
+    _ATEXIT_REGISTERED = True
+    import atexit
+
+    def _at_exit():
+        st = _STATE
+        if st is not None:
+            # report-only: raising inside atexit is noise, the alert +
+            # dump on the sidecar are the useful artifacts
+            st.check("process_exit", raise_on_leak=False)
+
+    atexit.register(_at_exit)
+
+
+def installed() -> bool:
+    return _STATE is not None
+
+
+def maybe_install() -> None:
+    """Lazy env resolve - every CLI entry point calls this once.
+    Unlike threadcheck there is no lock()-style chokepoint to hide
+    the resolve in, so activation is an explicit entry-point call."""
+    global _RESOLVED
+    if _RESOLVED:
+        return
+    _RESOLVED = True
+    if os.environ.get(LEAKCHECK_ENV, "").lower() not in _OFF_VALUES:
+        install()
+
+
+def install(recorder=None) -> _Sentinel:
+    """Force the sentinel on (tests, drills, recorder self-register);
+    idempotent - re-install updates the recorder but keeps the
+    registry and patches."""
+    global _STATE, _RESOLVED
+    _RESOLVED = True
+    if _STATE is None:
+        _STATE = _Sentinel(recorder)
+        _STATE.patch()
+        _register_atexit()
+    elif recorder is not None:
+        _STATE.recorder = recorder
+    return _STATE
+
+
+def uninstall() -> None:
+    """Restore the stdlib factories and reset to unresolved (tests).
+    Objects created while tracked stay alive and functional - they
+    just stop being watched."""
+    global _STATE, _RESOLVED
+    if _STATE is not None:
+        _STATE.unpatch()
+    _STATE = None
+    _RESOLVED = False
+
+
+def adopt(obj, reason: str = "") -> None:
+    """Transfer ownership out of the sentinel's custody - the runtime
+    spelling of the lint's ``# owner:`` comment.  Off: a single global
+    read."""
+    st = _STATE
+    if st is not None:
+        st.adopt(obj, reason)
+
+
+def check_drained(boundary: str) -> list[dict]:
+    """Non-raising drain boundary (server/router SIGTERM shutdown):
+    anything still live emits the structured alert + creation-site
+    dump and is returned.  Off: a single global read."""
+    st = _STATE
+    if st is None:
+        return []
+    return st.check(boundary, raise_on_leak=False)
+
+
+def assert_drained(boundary: str) -> None:
+    """Raising drain boundary (tests, drills): still-live resources
+    alert, dump, then raise :class:`LeakError`."""
+    st = _STATE
+    if st is None:
+        return
+    st.check(boundary, raise_on_leak=True)
+
+
+def stats() -> dict:
+    """Sentinel introspection for tests: per-kind creation counts,
+    live tracked entries, violations."""
+    st = _STATE
+    if st is None:
+        return {"installed": False}
+    with st._mu:
+        return {
+            "installed": True,
+            "created": dict(st.created),
+            "tracked": len(st.entries),
+            "adopted": st.adopted,
+            "violations": len(st.violations),
+        }

@@ -1,0 +1,473 @@
+"""Runtime resource-leak sentinel (``utils/leakcheck.py``) - the
+dynamic half of the PD4xx lifecycle pass.
+
+Three layers of pins, same doctrine as ``tests/test_threadcheck.py``:
+
+- **zero-overhead-when-off** - the stdlib factories keep their
+  identity, no extra threads, and a byte-identical trainer step jaxpr
+  with the sentinel installed;
+- **sentinel semantics** - tracked acquire/release for all four kinds,
+  creation-stack capture, :func:`adopt` ownership transfer, the
+  structured ``resource_leak`` alert + faulthandler dump through the
+  obs sidecar path, factory restoration on uninstall;
+- **drills** - a seeded deliberate leak is detected with its creation
+  site on the sidecar, a clean in-process serving run drains
+  alert-free through ``shutdown()``'s ``check_drained`` boundary, and
+  the four constructor leak sites PD403 caught stay fixed (failed
+  construction leaves no socket behind).
+"""
+
+from __future__ import annotations
+
+import builtins
+import json
+import socket
+import tempfile
+import threading
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_rnn_tpu.utils import leakcheck
+
+
+@pytest.fixture(autouse=True)
+def _reset_sentinel(monkeypatch):
+    """Every test starts unresolved with the env clear; no sentinel
+    state (or patched factory) leaks across tests."""
+    monkeypatch.delenv(leakcheck.LEAKCHECK_ENV, raising=False)
+    leakcheck.uninstall()
+    yield
+    leakcheck.uninstall()
+
+
+def _sidecar_events(path):
+    return [json.loads(line) for line in
+            path.read_text().splitlines()]
+
+
+def _leak_alerts(path):
+    return [e for e in _sidecar_events(path)
+            if e.get("kind") == "alert"
+            and e.get("alert") == "resource_leak"]
+
+
+# -- zero overhead when off ---------------------------------------------------
+
+
+class TestZeroOverheadOff:
+    def test_factories_keep_stdlib_identity_when_off(self):
+        raw_socket = socket.socket
+        raw_open = builtins.open
+        raw_tempdir = tempfile.TemporaryDirectory
+        raw_start = threading.Thread.start
+        assert not leakcheck.installed()
+        assert leakcheck.stats() == {"installed": False}
+        assert leakcheck.check_drained("noop") == []
+        leakcheck.assert_drained("noop")  # must not raise
+        leakcheck.adopt(object())  # must not raise
+        assert socket.socket is raw_socket
+        assert builtins.open is raw_open
+        assert tempfile.TemporaryDirectory is raw_tempdir
+        assert threading.Thread.start is raw_start
+
+    def test_off_means_no_new_threads(self):
+        before = {t.name for t in threading.enumerate()}
+        leakcheck.check_drained("noop")
+        after = {t.name for t in threading.enumerate()} - before
+        assert not after, after
+
+    def test_trainer_jaxpr_is_byte_identical_under_sentinel(self):
+        """The sentinel must not touch the step program: the trainer
+        builds the same jaxpr bytes with leakcheck installed (same pin
+        style as the threadcheck/recorder guards)."""
+        import jax
+
+        from pytorch_distributed_rnn_tpu.data import MotionDataset
+        from pytorch_distributed_rnn_tpu.data.synthetic import (
+            generate_har_arrays,
+        )
+        from pytorch_distributed_rnn_tpu.models import MotionModel
+        from pytorch_distributed_rnn_tpu.training import Trainer
+
+        X, y = generate_har_arrays(48, seq_length=12, seed=0)
+        train_set = MotionDataset(X, y)
+        model = lambda: MotionModel(input_dim=9, hidden_dim=8,  # noqa: E731
+                                    layer_dim=1, output_dim=6)
+        features = np.asarray(train_set.features)
+        labels = np.asarray(train_set.labels).reshape(-1)
+        idx = np.arange(24)
+
+        def jaxpr():
+            t = Trainer(model(), train_set, batch_size=24,
+                        learning_rate=2.5e-3, seed=7)
+            return str(jax.make_jaxpr(t._make_idx_train_step())(
+                t.params, t.opt_state, features, labels, idx
+            ))
+
+        plain = jaxpr()
+        leakcheck.install()
+        checked = jaxpr()
+        assert plain == checked
+
+
+# -- sentinel semantics -------------------------------------------------------
+
+
+class TestSentinel:
+    def test_env_resolves_on_maybe_install(self, monkeypatch):
+        monkeypatch.setenv(leakcheck.LEAKCHECK_ENV, "1")
+        leakcheck.uninstall()  # back to unresolved with the env set
+        leakcheck.maybe_install()
+        assert leakcheck.installed()
+        # resolved once: clearing the env does not uninstall
+        monkeypatch.delenv(leakcheck.LEAKCHECK_ENV)
+        leakcheck.maybe_install()
+        assert leakcheck.installed()
+
+    def test_off_values_stay_off(self, monkeypatch):
+        for value in ("", "0", "false", "off", "no"):
+            monkeypatch.setenv(leakcheck.LEAKCHECK_ENV, value)
+            leakcheck.uninstall()
+            leakcheck.maybe_install()
+            assert not leakcheck.installed(), value
+
+    def test_socket_tracked_until_closed(self):
+        leakcheck.install()
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        assert leakcheck.stats()["tracked"] == 1
+        with pytest.raises(leakcheck.LeakError) as exc:
+            leakcheck.assert_drained("boundary-x")
+        assert "boundary-x" in str(exc.value)
+        assert "socket" in str(exc.value)
+        s.close()
+        leakcheck.assert_drained("after-close")
+        assert leakcheck.stats()["created"]["socket"] == 1
+
+    def test_accept_and_create_connection_are_tracked(self):
+        leakcheck.install()
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(1)
+        port = listener.getsockname()[1]
+        dialed = socket.create_connection(("127.0.0.1", port),
+                                          timeout=5.0)
+        accepted, _addr = listener.accept()
+        assert leakcheck.stats()["tracked"] == 3
+        for s in (dialed, accepted, listener):
+            s.close()
+        leakcheck.assert_drained("all-closed")
+
+    def test_file_and_tempdir_and_thread_tracked(self, tmp_path):
+        leakcheck.install()
+        f = open(tmp_path / "x.txt", "w")
+        d = tempfile.TemporaryDirectory()
+        ev = threading.Event()
+        t = threading.Thread(target=ev.wait)
+        t.start()
+        leaked = leakcheck.check_drained("triple")
+        assert sorted(l["kind"] for l in leaked) == \
+            ["file", "tempdir", "thread"]
+        # every leak carries its creation stack
+        assert all(l["stack"] for l in leaked)
+        f.close()
+        d.cleanup()
+        ev.set()
+        t.join()
+        leakcheck.assert_drained("all-released")
+
+    def test_daemon_threads_are_not_tracked(self):
+        leakcheck.install()
+        ev = threading.Event()
+        t = threading.Thread(target=ev.wait, daemon=True)
+        t.start()
+        try:
+            leakcheck.assert_drained("daemon-running")
+        finally:
+            ev.set()
+            t.join()
+
+    def test_adopt_transfers_ownership(self):
+        leakcheck.install()
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        leakcheck.adopt(s, "pool-owned")
+        leakcheck.assert_drained("adopted")
+        assert leakcheck.stats()["adopted"] == 1
+        s.close()
+
+    def test_gc_drains_an_entry(self):
+        # a GC'd object cannot leak an fd forever (CPython closes it);
+        # the registry must not hold it alive or report it
+        leakcheck.install()
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        del s
+        leakcheck.assert_drained("collected")
+
+    def test_leak_alerts_on_sidecar_with_creation_stack(self, tmp_path):
+        """The structured post-mortem: the resource_leak alert lands in
+        the sidecar with each leak's creation stack, and a faulthandler
+        dump appears next to it - the watchdog's path."""
+        from pytorch_distributed_rnn_tpu.obs.recorder import (
+            MetricsRecorder,
+        )
+        from pytorch_distributed_rnn_tpu.obs.watchdog import (
+            stacks_path_for,
+        )
+
+        leakcheck.install()
+        rec = MetricsRecorder(tmp_path / "m.jsonl")  # self-registers
+        try:
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            found = leakcheck.check_drained("drill")
+            assert len(found) == 1
+            s.close()
+        finally:
+            rec.close()
+        (alert,) = _leak_alerts(tmp_path / "m.jsonl")
+        assert alert["source"] == "leakcheck"
+        assert alert["severity"] == "error"
+        assert alert["boundary"] == "drill"
+        assert alert["count"] == 1
+        (leak,) = alert["leaks"]
+        assert leak["kind"] == "socket"
+        # the creation site - THIS test - rides the alert
+        assert any("test_leakcheck" in frame for frame in leak["stack"])
+        stacks = stacks_path_for(tmp_path / "m.jsonl")
+        assert stacks.exists()
+        assert "leakcheck:resource_leak:drill" in stacks.read_text()
+        assert leakcheck.stats()["violations"] == 1
+
+    def test_uninstall_restores_factories(self):
+        raw_socket = socket.socket
+        raw_open = builtins.open
+        raw_tempdir = tempfile.TemporaryDirectory
+        raw_start = threading.Thread.start
+        leakcheck.install()
+        assert socket.socket is not raw_socket
+        assert builtins.open is not raw_open
+        assert tempfile.TemporaryDirectory is not raw_tempdir
+        assert threading.Thread.start is not raw_start
+        leakcheck.uninstall()
+        assert socket.socket is raw_socket
+        assert builtins.open is raw_open
+        assert tempfile.TemporaryDirectory is raw_tempdir
+        assert threading.Thread.start is raw_start
+
+    def test_tracked_objects_survive_uninstall(self, tmp_path):
+        leakcheck.install()
+        f = open(tmp_path / "x.txt", "w")
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        leakcheck.uninstall()
+        # still functional, just unwatched
+        f.write("ok")
+        f.close()
+        s.close()
+
+    def test_reinstall_keeps_registry_but_updates_recorder(self):
+        st = leakcheck.install()
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+
+        class FakeRec:
+            def record(self, *a, **k):
+                pass
+
+            def flush(self):
+                pass
+
+        rec = FakeRec()
+        assert leakcheck.install(recorder=rec) is st
+        assert st.recorder is rec
+        assert leakcheck.stats()["tracked"] == 1
+        s.close()
+
+    def test_summarize_counts_leak_alerts(self, tmp_path):
+        # `pdrnn-metrics summarize` aggregates alerts generically by
+        # kind; this pins that resource_leak alerts surface there
+        from pytorch_distributed_rnn_tpu.obs.recorder import (
+            MetricsRecorder,
+        )
+        from pytorch_distributed_rnn_tpu.obs.summary import (
+            summarize_file,
+        )
+
+        leakcheck.install()
+        rec = MetricsRecorder(tmp_path / "m.jsonl")
+        try:
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            leakcheck.check_drained("summary-drill")
+            s.close()
+        finally:
+            rec.close()
+        summary = summarize_file(tmp_path / "m.jsonl")
+        assert summary["alerts_by_kind"].get("resource_leak") == 1
+
+
+# -- fixed-site regression pins ----------------------------------------------
+
+
+class TestFixedLeakSites:
+    """The four PD403 partial-construction leaks this PR fixed: a
+    constructor that fails AFTER acquiring its socket must close it
+    on the way out.  The sentinel is the assertion surface - a failed
+    construction leaves nothing tracked."""
+
+    def _listener(self):
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(4)
+        return listener, listener.getsockname()[1]
+
+    def test_serving_client_ctor_failure_leaks_nothing(self, monkeypatch):
+        from pytorch_distributed_rnn_tpu.serving.protocol import (
+            ServingClient,
+        )
+
+        leakcheck.install()
+        listener, port = self._listener()
+
+        def boom(self, *a, **kw):
+            raise RuntimeError("makefile exploded")
+
+        monkeypatch.setattr(socket.socket, "makefile", boom)
+        with pytest.raises(RuntimeError, match="makefile exploded"):
+            ServingClient("127.0.0.1", port, timeout_s=5.0)
+        listener.close()
+        leakcheck.assert_drained("client-ctor")
+
+    def test_replica_connection_ctor_failure_leaks_nothing(
+            self, monkeypatch):
+        from pytorch_distributed_rnn_tpu.serving.fleet.pool import (
+            TcpReplicaConnection,
+        )
+
+        leakcheck.install()
+        listener, port = self._listener()
+
+        def boom(self, *a, **kw):
+            raise RuntimeError("makefile exploded")
+
+        monkeypatch.setattr(socket.socket, "makefile", boom)
+        with pytest.raises(RuntimeError, match="makefile exploded"):
+            TcpReplicaConnection("127.0.0.1", port)
+        listener.close()
+        leakcheck.assert_drained("replica-ctor")
+
+    def test_serving_server_listener_failure_leaks_nothing(self):
+        from pytorch_distributed_rnn_tpu.serving.server import (
+            ServingServer,
+        )
+
+        leakcheck.install()
+        with pytest.raises(OSError):
+            ServingServer(engine=object(), host="256.1.1.1", port=0)
+        leakcheck.assert_drained("server-ctor")
+
+    def test_router_server_listener_failure_leaks_nothing(self):
+        from pytorch_distributed_rnn_tpu.serving.fleet.router import (
+            RouterServer,
+        )
+
+        leakcheck.install()
+        with pytest.raises(OSError):
+            RouterServer(core=object(), host="256.1.1.1", port=0)
+        leakcheck.assert_drained("router-ctor")
+
+    def test_sigusr2_dump_sink_is_adopted_not_leaked(self, tmp_path):
+        # the stack-dump handler file lives until process exit by
+        # design; a clean `pdrnn-serve` SIGTERM must not report it
+        from pytorch_distributed_rnn_tpu.obs import watchdog
+
+        leakcheck.install()
+        path = watchdog.install_stack_dump_handler(tmp_path / "m.jsonl")
+        if path is None:  # pragma: no cover - non-POSIX
+            pytest.skip("no SIGUSR2 on this platform")
+        assert leakcheck.check_drained("serve.shutdown") == []
+        assert leakcheck.stats()["adopted"] >= 1
+
+
+# -- drills -------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestLeakcheckDrill:
+    def _engine(self):
+        import jax
+
+        from pytorch_distributed_rnn_tpu.models import CharRNN
+        from pytorch_distributed_rnn_tpu.serving.adapters import (
+            adapter_for,
+        )
+        from pytorch_distributed_rnn_tpu.serving.buckets import BucketSpec
+        from pytorch_distributed_rnn_tpu.serving.engine import (
+            ServingEngine,
+        )
+
+        model = CharRNN(vocab_size=32, embed_dim=8, hidden_dim=12,
+                        layer_dim=1, cell="lstm", impl="scan")
+        params = model.init(jax.random.PRNGKey(1))
+        return ServingEngine(adapter_for(model), params, num_slots=2,
+                             bucket_spec=BucketSpec((8,)),
+                             max_new_tokens=6)
+
+    def test_clean_serving_run_drains_alert_free(self, tmp_path):
+        """The SIGTERM-drain contract under the sentinel: a served
+        request, client closed, ``shutdown()`` - whose
+        ``check_drained('serve.shutdown')`` boundary runs with the
+        sentinel live - must emit NO resource_leak alert."""
+        from pytorch_distributed_rnn_tpu.obs.recorder import (
+            MetricsRecorder,
+        )
+        from pytorch_distributed_rnn_tpu.serving.protocol import (
+            ServingClient,
+        )
+        from pytorch_distributed_rnn_tpu.serving.server import (
+            ServingServer,
+        )
+
+        leakcheck.install()
+        rec = MetricsRecorder(tmp_path / "serve.jsonl")
+        server = ServingServer(self._engine(), port=0, recorder=rec)
+        server.start()
+        with ServingClient("127.0.0.1", server.port,
+                           timeout_s=30.0) as client:
+            pong = client.ping()
+            assert pong["event"] == "pong"
+            reply = client.generate([1, 2, 3], max_new_tokens=4,
+                                    seed=11)
+            assert reply["status"] == "done"
+        server.shutdown(drain=True, drain_timeout_s=10.0)
+        assert _leak_alerts(tmp_path / "serve.jsonl") == []
+        assert leakcheck.stats()["violations"] == 0
+
+    def test_seeded_leak_is_detected_and_dumped(self, tmp_path):
+        """The drill's negative control: a deliberately leaked socket
+        among real serving traffic is caught at the shutdown boundary
+        with its creation site - proof the clean run above is
+        meaningful."""
+        from pytorch_distributed_rnn_tpu.obs.recorder import (
+            MetricsRecorder,
+        )
+        from pytorch_distributed_rnn_tpu.obs.watchdog import (
+            stacks_path_for,
+        )
+        from pytorch_distributed_rnn_tpu.serving.server import (
+            ServingServer,
+        )
+
+        leakcheck.install()
+        rec = MetricsRecorder(tmp_path / "serve.jsonl")
+        server = ServingServer(self._engine(), port=0, recorder=rec)
+        server.start()
+        leaked = socket.create_connection(
+            ("127.0.0.1", server.port), timeout=5.0)  # never closed
+        server.shutdown()
+        alerts = _leak_alerts(tmp_path / "serve.jsonl")
+        assert alerts, "seeded leak not detected at the drain boundary"
+        assert any(l["kind"] == "socket" and
+                   any("test_leakcheck" in fr for fr in l["stack"])
+                   for a in alerts for l in a["leaks"])
+        stacks = stacks_path_for(tmp_path / "serve.jsonl")
+        assert stacks.exists()
+        assert "leakcheck:resource_leak:serve.shutdown" \
+            in stacks.read_text()
+        leaked.close()

@@ -581,7 +581,9 @@ class TestPackageGate:
         assert sorted(all_rules()) == ["PD101", "PD102", "PD103",
                                        "PD104", "PD105",
                                        "PD301", "PD302", "PD303",
-                                       "PD304", "PD305"]
+                                       "PD304", "PD305",
+                                       "PD401", "PD402", "PD403",
+                                       "PD404", "PD405"]
 
     def test_package_has_zero_non_baselined_findings(self):
         baseline = load_baseline(BASELINE)

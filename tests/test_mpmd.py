@@ -272,7 +272,8 @@ class TestLinkTransport:
         t.join(timeout=30)
         assert not t.is_alive() and not errors
         assert [seq for seq, _ in host_got] == list(range(frames))
-        assert stats == {"reconnects": 0, "replayed": 0, "dup_drops": 0}
+        assert stats == {"reconnects": 0, "replayed": 0, "dup_drops": 0,
+                         "recv_failures": 0}
 
     def test_reconnect_past_deadline_budget_is_loud(self):
         """Nobody ever dials: the deadline-budgeted retry contract must
