@@ -23,6 +23,10 @@ idiom as PD3xx's ``# guards:`` / ``# lock-order:``):
 - ``# protocol: <proto> request <NAME>`` marks a request-send site.
 - ``# protocol: <proto> reply <NAME>[, NAME...]`` marks the matching
   reply/error-send site.
+- ``# protocol: <proto> field <NAME>`` marks a site that writes or
+  reads an OPTIONAL wire field riding the protocol's messages (the
+  serve ``trace`` carry): fields have no handler obligation, but a
+  field naming a protocol with no declared ops is a typo.
 - ``# owner: <who>`` trailing a resource acquisition transfers
   ownership: someone else closes it, PD403 stands down.
 
@@ -83,7 +87,7 @@ def lifecycle_rules() -> tuple[str, ...]:
 
 _PROTOCOL_RE = re.compile(
     r"#\s*protocol:\s*(?P<proto>[\w.-]+)\s+"
-    r"(?P<verb>op|handles|request|reply)\s+"
+    r"(?P<verb>op|handles|request|reply|field)\s+"
     r"(?P<names>[A-Za-z_]\w*(?:\s*,\s*[A-Za-z_]\w*)*)"
     r"(?P<oneway>\s+oneway)?"
 )
@@ -124,7 +128,8 @@ def _protocol_tables(index: PackageIndex) -> dict:
     """Package-wide ``# protocol:`` registry, cached on the index:
     ``proto -> {"ops": {name: (oneway, path, line)}, "handles":
     {name: [(path, line)]}, "requests": [(name, path, line)],
-    "replies": {name: [(path, line)]}}``."""
+    "replies": {name: [(path, line)]},
+    "fields": {name: [(path, line)]}}``."""
     cached = getattr(index, "_lifecycle_protocols", None)
     if cached is not None:
         return cached
@@ -136,6 +141,7 @@ def _protocol_tables(index: PackageIndex) -> dict:
                 continue
             proto = tables.setdefault(m.group("proto"), {
                 "ops": {}, "handles": {}, "requests": [], "replies": {},
+                "fields": {},
             })
             names = [n.strip() for n in m.group("names").split(",")
                      if n.strip()]
@@ -150,6 +156,9 @@ def _protocol_tables(index: PackageIndex) -> dict:
                         (mod.path, lineno))
                 elif verb == "request":
                     proto["requests"].append((name, mod.path, lineno))
+                elif verb == "field":
+                    proto["fields"].setdefault(name, []).append(
+                        (mod.path, lineno))
                 else:
                     proto["replies"].setdefault(name, []).append(
                         (mod.path, lineno))
@@ -209,6 +218,19 @@ def check_unhandled_protocol_op(mod: ModuleInfo,
                         f"`{table}` declares op {name} which protocol "
                         f"'{proto_name}' never declared (typo, or add "
                         f"`# protocol: {proto_name} op {name}`)",
+                    )
+        if not ops:
+            # a field riding a protocol that declares no ops anywhere
+            # is a misspelled protocol name, not an extension point
+            for name, sites in proto["fields"].items():
+                for path, lineno in sites:
+                    if path != mod.path:
+                        continue
+                    yield mod.finding(
+                        "PD401", _anchor(lineno),
+                        f"field {name} rides protocol '{proto_name}' "
+                        "which declares no ops anywhere (typo in the "
+                        "protocol name?)",
                     )
 
 

@@ -10,6 +10,11 @@
   per-rank liveness (``rank_health``).
 - :mod:`.timeline`: cross-rank clock alignment, Chrome-trace/Perfetto
   export + validator, phase attribution.
+- :mod:`.tracectx`: the distributed request-trace context
+  (:class:`TraceContext`) minted at the serving edge and carried on the
+  serve wire protocol; :mod:`.trace` assembles the recorded spans from
+  router + replica sidecars into trees with critical-path attribution
+  (``pdrnn-metrics trace``).
 - :mod:`.flops`: analytic per-step FLOP/byte counts off abstract
   jaxprs (no data, no compile) - the efficiency ledger's MFU numerator.
 - :mod:`.ledger`: the efficiency ledger - exhaustive wall-clock phase
@@ -39,6 +44,7 @@ from pytorch_distributed_rnn_tpu.obs.aggregator import (
 )
 from pytorch_distributed_rnn_tpu.obs.live import (
     LIVE_ENV,
+    LatencyHistogram,
     LiveExporter,
     LivePlane,
     RollingWindow,
@@ -86,6 +92,19 @@ from pytorch_distributed_rnn_tpu.obs.watchdog import (
     dump_stacks,
     install_stack_dump_handler,
 )
+from pytorch_distributed_rnn_tpu.obs.trace import (
+    MalformedTraceError,
+    TraceTree,
+    assemble_traces,
+    build_trace_tree,
+    collect_trace_spans,
+    format_trace_tree,
+    validate_trace_tree,
+)
+from pytorch_distributed_rnn_tpu.obs.tracectx import (
+    TraceContext,
+    should_sample,
+)
 from pytorch_distributed_rnn_tpu.obs.timeline import (
     attribute_rank,
     attribute_run,
@@ -110,26 +129,34 @@ __all__ = [
     "NULL_RECORDER",
     "RollingWindow",
     "SCHEMA_VERSION",
+    "LatencyHistogram",
     "MalformedMetricsError",
+    "MalformedTraceError",
     "MetricsRecorder",
     "NullRecorder",
     "StepTraceCapture",
+    "TraceContext",
+    "TraceTree",
     "dump_stacks",
     "install_stack_dump_handler",
     "render_prometheus",
     "FRACTION_TOL",
     "LEDGER_PHASES",
     "append_history",
+    "assemble_traces",
     "attribute_rank",
     "attribute_run",
     "attribute_stragglers",
     "build_chrome_trace",
+    "build_trace_tree",
     "check_history",
     "closed_jaxpr_flop_stats",
+    "collect_trace_spans",
     "detect_stragglers",
     "diff_summaries",
     "entry_flop_report",
     "estimate_clock_offsets",
+    "format_trace_tree",
     "history_record",
     "ledger_events",
     "ledger_file",
@@ -141,6 +168,7 @@ __all__ = [
     "rank_files",
     "rank_health",
     "rank_suffixed",
+    "should_sample",
     "summarize_events",
     "summarize_file",
     "summarize_run",

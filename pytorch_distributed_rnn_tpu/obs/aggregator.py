@@ -63,7 +63,19 @@ pdrnn_router_shed_total{qos=...}                counter      router
 pdrnn_router_errors_total                       counter      router
 pdrnn_router_request_rate_per_s                 gauge        window
 pdrnn_router_latency_seconds{quantile=...}      gauge        window
+pdrnn_request_latency_seconds{le=...}           histogram    histogram
 =============================================== ============ ==========
+
+``pdrnn_request_latency_seconds`` is the request-latency histogram
+(``obs/live.LatencyHistogram``): the serving engine and the router each
+carry one in their digests, exported as cumulative ``_bucket{le=...}``
+series plus ``_sum``/``_count``, distinguished by the ``role`` label.
+Buckets that last saw a TRACED request carry an OpenMetrics-style
+exemplar suffix (``# {trace_id="..."} value timestamp``) so a latency
+spike on a dashboard links straight to ``pdrnn-metrics trace --request``
+on that trace id.  Prometheus's classic text parser ignores everything
+after ``#``, so the suffix is backward-compatible noise to a 0.0.4
+scraper and an exemplar to an OpenMetrics one.
 """
 
 from __future__ import annotations
@@ -101,6 +113,71 @@ def escape_label_value(value) -> str:
     )
 
 
+def _render_value(value: float) -> str:
+    # integers render without a fraction (counter idiom); floats use
+    # repr for round-trip fidelity
+    if value == int(value) and abs(value) < 2 ** 53:
+        return str(int(value))
+    return repr(value)
+
+
+def _render_labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{escape_label_value(v)}"'
+        for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+def _exemplar_suffix(exemplar: dict) -> str:
+    """OpenMetrics exemplar: `` # {trace_id="..."} value timestamp``.
+    A classic text-format parser stops at the ``#`` (comment), so the
+    suffix degrades to nothing on scrapers that predate exemplars."""
+    trace_id = exemplar.get("trace_id")
+    value = exemplar.get("value")
+    if trace_id is None or value is None:
+        return ""
+    suffix = (
+        f' # {{trace_id="{escape_label_value(trace_id)}"}} '
+        f"{_render_value(float(value))}"
+    )
+    if exemplar.get("t") is not None:
+        suffix += f" {_render_value(float(exemplar['t']))}"
+    return suffix
+
+
+def _histogram_lines(name: str, labels: dict, snapshot: dict) -> list[str]:
+    """One ``LatencyHistogram.snapshot()`` as exposition lines: the
+    cumulative ``_bucket{le=...}`` series (finite buckets carry their
+    exemplar when one was observed), the spec-mandated ``+Inf`` bucket,
+    then ``_sum`` and ``_count``."""
+    lines = []
+    for bucket in snapshot.get("buckets") or ():
+        line = (
+            f"{name}_bucket"
+            f'{_render_labels({**labels, "le": format(float(bucket["le"]), "g")})}'
+            f" {int(bucket['count'])}"
+        )
+        exemplar = bucket.get("exemplar")
+        if exemplar:
+            line += _exemplar_suffix(exemplar)
+        lines.append(line)
+    lines.append(
+        f'{name}_bucket{_render_labels({**labels, "le": "+Inf"})} '
+        f"{int(snapshot['count'])}"
+    )
+    lines.append(
+        f"{name}_sum{_render_labels(labels)} "
+        f"{_render_value(float(snapshot['sum']))}"
+    )
+    lines.append(
+        f"{name}_count{_render_labels(labels)} {int(snapshot['count'])}"
+    )
+    return lines
+
+
 def render_prometheus(samples) -> str:
     """``[(name, labels-dict, value, type), ...]`` -> exposition text.
 
@@ -108,33 +185,36 @@ def render_prometheus(samples) -> str:
     occurrence's type wins), escapes label values, and DROPS any sample
     whose value is not finite - a NaN gauge poisons every downstream
     ``avg()``/``sum()``, and absence is the Prometheus idiom for "no
-    observation"."""
+    observation".  A sample whose type is ``"histogram"`` carries a
+    ``LatencyHistogram.snapshot()`` dict as its value and expands into
+    the ``_bucket``/``_sum``/``_count`` series under one ``# TYPE``
+    line, with per-bucket exemplars when present."""
     by_name: dict[str, tuple[str, list[str]]] = {}
     order: list[str] = []
+
+    def series_for(name: str, mtype: str) -> list[str]:
+        if name not in by_name:
+            by_name[name] = (mtype, [])
+            order.append(name)
+        return by_name[name][1]
+
     for name, labels, value, mtype in samples:
+        if mtype == "histogram":
+            if not isinstance(value, dict) or value.get("count") is None:
+                continue
+            series_for(name, mtype).extend(
+                _histogram_lines(name, labels or {}, value)
+            )
+            continue
         try:
             value = float(value)
         except (TypeError, ValueError):
             continue
         if not math.isfinite(value):
             continue
-        if name not in by_name:
-            by_name[name] = (mtype, [])
-            order.append(name)
-        label_s = ""
-        if labels:
-            inner = ",".join(
-                f'{k}="{escape_label_value(v)}"'
-                for k, v in sorted(labels.items())
-            )
-            label_s = "{" + inner + "}"
-        # integers render without a fraction (counter idiom); floats use
-        # repr for round-trip fidelity
-        if value == int(value) and abs(value) < 2 ** 53:
-            rendered = str(int(value))
-        else:
-            rendered = repr(value)
-        by_name[name][1].append(f"{name}{label_s} {rendered}")
+        series_for(name, mtype).append(
+            f"{name}{_render_labels(labels or {})} {_render_value(value)}"
+        )
     lines = []
     for name in order:
         mtype, series = by_name[name]
@@ -442,6 +522,8 @@ class Aggregator:
             for q, key in (("0.5", "ttft_s_p50"), ("0.95", "ttft_s_p95")):
                 add("pdrnn_serving_ttft_seconds",
                     {**labels, "quantile": q}, serving.get(key))
+            add("pdrnn_request_latency_seconds", labels,
+                serving.get("latency_hist"), "histogram")
             router = digest.get("router") or {}
             add("pdrnn_router_inflight", labels, router.get("inflight"))
             for state, count in (router.get("replicas") or {}).items():
@@ -468,6 +550,8 @@ class Aggregator:
                                                      "latency_s_p95")):
                 add("pdrnn_router_latency_seconds",
                     {**labels, "quantile": q}, router.get(key))
+            add("pdrnn_request_latency_seconds", labels,
+                router.get("latency_hist"), "histogram")
         return render_prometheus(samples)
 
 
@@ -481,6 +565,14 @@ class AggregatorServer:
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True
         self.host, self.port = self._httpd.server_address[:2]
+        # the listener deliberately outlives the server drain boundary
+        # (the CLI mains close the plane AFTER shutdown so the final
+        # flushed digest stays scrape-able) - exempt it from the leak
+        # sentinel like the sigusr2 dump sink; lazy import: leakcheck's
+        # violation path reaches back into obs
+        from pytorch_distributed_rnn_tpu.utils import leakcheck
+        leakcheck.adopt(self._httpd.socket,
+                        reason="live-plane listener, closed post-drain")
         self._thread = threading.Thread(
             # 0.1s shutdown poll: close() returns promptly (the default
             # 0.5s poll costs half a second per server teardown)

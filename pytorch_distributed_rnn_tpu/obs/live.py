@@ -73,6 +73,7 @@ serving             serving-engine gauge block (queue depth, windowed
 
 from __future__ import annotations
 
+import bisect
 import json
 import logging
 import math
@@ -170,6 +171,68 @@ class RollingWindow:
             "p95": percentile(ordered, 0.95),
             "last": values[-1],
         }
+
+
+# Prometheus' conventional latency buckets; the +Inf bucket is implicit
+# (it equals ``count``).  The aggregator renders these as the
+# ``pdrnn_request_latency_seconds`` histogram series.
+LATENCY_BUCKETS_S = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+class LatencyHistogram:
+    """Fixed-bucket latency histogram with OpenMetrics exemplars.
+
+    Cumulative counts over :data:`LATENCY_BUCKETS_S` (``le`` inclusive,
+    the Prometheus convention); each finite bucket remembers the LAST
+    traced observation that landed in it (trace_id + value + wall
+    stamp), so a slow-tail bucket on ``/metrics`` links straight to a
+    trace pullable with ``pdrnn-metrics trace``.  Untraced observations
+    still count - they just carry no exemplar.  Thread-safe."""
+
+    def __init__(self, buckets=LATENCY_BUCKETS_S):
+        self.buckets = tuple(float(b) for b in buckets)
+        self._counts = [0] * (len(self.buckets) + 1)  # last = overflow
+        self._sum = 0.0
+        self._count = 0
+        self._exemplars: list[dict | None] = [None] * len(self.buckets)
+        self._lock = threadcheck.lock(threading.Lock(), "live.histogram")  # guards: _counts, _sum, _count, _exemplars
+
+    def observe(self, seconds: float,
+                trace_id: str | None = None) -> None:
+        seconds = float(seconds)
+        index = bisect.bisect_left(self.buckets, seconds)
+        with self._lock:
+            self._counts[index] += 1
+            self._sum += seconds
+            self._count += 1
+            if trace_id is not None and index < len(self.buckets):
+                self._exemplars[index] = {
+                    "trace_id": str(trace_id), "value": seconds,
+                    "t": time.time(),
+                }
+
+    def snapshot(self) -> dict | None:
+        """Digest form: cumulative ``buckets`` (le/count/exemplar?),
+        ``sum``, ``count``; None while empty (an idle source should not
+        export an all-zero histogram)."""
+        with self._lock:
+            if self._count == 0:
+                return None
+            counts = list(self._counts)
+            exemplars = [
+                None if e is None else dict(e) for e in self._exemplars
+            ]
+            total, count = self._sum, self._count
+        buckets, running = [], 0
+        for i, le in enumerate(self.buckets):
+            running += counts[i]
+            entry: dict = {"le": le, "count": running}
+            if exemplars[i] is not None:
+                entry["exemplar"] = exemplars[i]
+            buckets.append(entry)
+        return {"buckets": buckets, "sum": total, "count": count}
 
 
 def parse_live_spec(spec: str) -> tuple[str, int]:

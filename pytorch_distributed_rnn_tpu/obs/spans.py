@@ -64,6 +64,12 @@ SUBSYSTEM_TIDS = {
     # (replica_eject / replica_readmit), shed and drain instants
     # (serving/fleet/router.py)
     "router": 14,
+    # distributed-tracing lane: per-request route/attempt/queue_wait/
+    # decode spans carrying TraceContext ids (obs/tracectx.py).  These
+    # overlap freely - concurrent requests share the row - so the
+    # timeline exporter renders them as ASYNC events (ph b/e keyed by
+    # trace id), not complete-event spans
+    "trace": 15,
 }
 
 

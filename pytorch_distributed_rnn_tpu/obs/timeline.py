@@ -22,7 +22,12 @@ into a single timeline:
    events that carry a duration (``step`` dispatch/fence/data-wait,
    ``checkpoint_*`` seconds, ``ps_exchange`` seconds, ``epoch`` wall_s,
    ``run_summary`` duration_s) are synthesized into spans; the rest
-   become instants;
+   become instants.  Request-trace spans (``cat="trace"``, carrying a
+   ``trace`` id from ``obs/tracectx.py``) are the exception: concurrent
+   requests overlap freely on one row, so they export as ASYNC begin/end
+   pairs (``ph: b/e`` keyed by trace id) on the ``trace`` lane, and every
+   trace that crosses a process boundary gets a flow arrow (``ph: s/f``)
+   from the pid that started it to each pid it visited;
 4. :func:`validate_chrome_trace` - the strict structural validator the
    tests and the CI smoke step run on every exported trace;
 5. :func:`attribute_rank` / :func:`attribute_stragglers` - per-rank
@@ -284,6 +289,43 @@ class _TraceBuilder:
             "ts": self._us(wall), "s": scope, "args": args,
         })
 
+    def async_span(self, pid: int, cat: str, name: str, span_id: str,
+                   wall_start: float, dur_s: float, args: dict) -> int:
+        """One async begin/end pair (``ph: b``/``e``): the export shape
+        for request-trace spans, whose concurrent instances overlap
+        arbitrarily on one lane - complete events (``X``) would trip the
+        validator's nesting check.  Returns the begin ts (µs)."""
+        tid, cat = self._thread(pid, cat)
+        ts = self._us(wall_start)
+        end = ts + max(0, int(round(dur_s * _US)))
+        common = {
+            "pid": pid, "tid": tid, "name": name, "cat": cat,
+            "id": span_id,
+        }
+        self.events.append({"ph": "b", "ts": ts, "args": args, **common})
+        self.events.append({"ph": "e", "ts": end, "args": {}, **common})
+        return ts
+
+    def flow(self, cat: str, name: str, flow_id: str,
+             src: tuple[int, int], dst: tuple[int, int]) -> None:
+        """One flow arrow: ``ph: s`` at ``src=(pid, ts)`` binding to
+        ``ph: f`` at ``dst=(pid, ts)``, both on ``cat``'s lane.  The
+        finish is clamped to never precede its start (cross-host clock
+        skew up to the alignment tolerance)."""
+        src_pid, src_ts = src
+        dst_pid, dst_ts = dst
+        src_tid, cat = self._thread(src_pid, cat)
+        dst_tid, _ = self._thread(dst_pid, cat)
+        common = {"name": name, "cat": cat, "id": flow_id}
+        self.events.append({
+            "ph": "s", "pid": src_pid, "tid": src_tid, "ts": src_ts,
+            **common,
+        })
+        self.events.append({
+            "ph": "f", "bp": "e", "pid": dst_pid, "tid": dst_tid,
+            "ts": max(dst_ts, src_ts), **common,
+        })
+
 
 def _args(event: dict, *skip: str) -> dict:
     drop = {"kind", "t", "tm", "rank", *skip}
@@ -317,6 +359,9 @@ def build_chrome_trace(by_rank: dict[int, list[dict]],
         for r, events in by_rank.items() for e in events
     )
     tb = _TraceBuilder(t0)
+    # trace id -> [(begin ts µs, pid)]: the visits each request trace
+    # paid to each process, feeding the flow-arrow synthesis below
+    trace_visits: dict[str, list[tuple[int, int]]] = {}
 
     for rank, events in by_rank.items():
         for e in events:
@@ -325,6 +370,16 @@ def build_chrome_trace(by_rank: dict[int, list[dict]],
             if kind == "meta":
                 continue
             if kind == "span":
+                if e.get("cat") == "trace" and e.get("trace"):
+                    ts = tb.async_span(
+                        rank, "trace", str(e.get("name", "span")),
+                        str(e["trace"]), w, float(e.get("dur_s", 0.0)),
+                        _args(e, "name", "cat", "dur_s"),
+                    )
+                    trace_visits.setdefault(str(e["trace"]), []).append(
+                        (ts, rank)
+                    )
+                    continue
                 tb.span(
                     rank, e.get("cat", "train"), str(e.get("name", "span")),
                     w, float(e.get("dur_s", 0.0)),
@@ -394,6 +449,22 @@ def build_chrome_trace(by_rank: dict[int, list[dict]],
                     "router_drain": "router",
                 }.get(kind, "sys")
                 tb.instant(rank, cat, kind, w, _args(e), scope)
+
+    # flow arrows: one s->f pair from the pid where a trace BEGAN to
+    # each other pid it visited, so Perfetto draws the request's hop
+    # across process rows (router -> replica).  The flow id is scoped
+    # per destination pid - Chrome flow semantics bind exactly one s to
+    # one f per (cat, id)
+    for trace_id, visits in sorted(trace_visits.items()):
+        visits.sort()
+        src_ts, src_pid = visits[0]
+        linked = {src_pid}
+        for ts, pid in visits:
+            if pid in linked:
+                continue
+            linked.add(pid)
+            tb.flow("trace", trace_id, f"{trace_id}/{pid}",
+                    (src_pid, src_ts), (pid, ts))
 
     trace_events = []
     for rank, events in sorted(by_rank.items()):
@@ -467,6 +538,12 @@ _REQUIRED_BY_PH = {
     "X": ("ts", "dur", "name", "pid", "tid"),
     "B": ("ts", "name", "pid", "tid"),
     "E": ("ts", "pid", "tid"),
+    # async begin/end + flow start/finish (the request-trace export):
+    # both are keyed by (cat, id), so those fields are required
+    "b": ("ts", "name", "pid", "tid", "cat", "id"),
+    "e": ("ts", "name", "pid", "tid", "cat", "id"),
+    "s": ("ts", "name", "pid", "tid", "cat", "id"),
+    "f": ("ts", "name", "pid", "tid", "cat", "id"),
     "i": ("ts", "name", "pid", "tid", "s"),
     "M": ("name", "pid"),
 }
@@ -477,8 +554,11 @@ def validate_chrome_trace(trace) -> None:
     raises ``ValueError`` naming the first violation.  Enforced: the
     required fields per phase type, non-negative finite µs timestamps
     and durations, pid<->rank and tid<->subsystem metadata mapping, B/E
-    balance per (pid, tid), and proper nesting (no partial overlap) of
-    the complete-event spans sharing one thread row."""
+    balance per (pid, tid), proper nesting (no partial overlap) of the
+    complete-event spans sharing one thread row, async b/e balance per
+    (cat, id) with begun/ended name multisets agreeing, and flow-arrow
+    pairing: exactly one ``s`` and one ``f`` per (cat, id), same name,
+    finish never before start - a dangling arrow is a broken trace."""
     if not isinstance(trace, dict) or not isinstance(
         trace.get("traceEvents"), list
     ) or not trace["traceEvents"]:
@@ -489,6 +569,8 @@ def validate_chrome_trace(trace) -> None:
     used_tids: set[tuple[int, int]] = set()
     be_stacks: dict[tuple[int, int], list[str]] = {}
     x_by_tid: dict[tuple[int, int], list[tuple[int, int]]] = {}
+    async_open: dict[tuple[str, str], dict] = {}
+    flows: dict[tuple[str, str], dict] = {}
 
     for i, e in enumerate(trace["traceEvents"]):
         where = f"traceEvents[{i}]"
@@ -539,7 +621,61 @@ def validate_chrome_trace(trace) -> None:
                     f"tid={e['tid']}"
                 )
             stack.pop()
+        elif ph == "b":
+            st = async_open.setdefault(
+                (e["cat"], str(e["id"])), {"open": 0, "names": {}}
+            )
+            st["open"] += 1
+            st["names"][e["name"]] = st["names"].get(e["name"], 0) + 1
+        elif ph == "e":
+            st = async_open.get((e["cat"], str(e["id"])))
+            if st is None or st["open"] == 0:
+                raise ValueError(
+                    f"{where}: async e without an open b for "
+                    f"cat={e['cat']!r} id={e['id']!r}"
+                )
+            st["open"] -= 1
+            if st["names"].get(e["name"], 0) == 0:
+                raise ValueError(
+                    f"{where}: async e name {e['name']!r} was never begun "
+                    f"on cat={e['cat']!r} id={e['id']!r}"
+                )
+            st["names"][e["name"]] -= 1
+        elif ph in ("s", "f"):
+            fl = flows.setdefault((e["cat"], str(e["id"])), {})
+            if ph in fl:
+                raise ValueError(
+                    f"{where}: duplicate flow {ph!r} for "
+                    f"cat={e['cat']!r} id={e['id']!r}"
+                )
+            fl[ph] = (e["ts"], e["name"])
 
+    for (cat, async_id), st in async_open.items():
+        if st["open"]:
+            raise ValueError(
+                f"unbalanced async b/e on cat={cat!r} id={async_id!r}: "
+                f"{st['open']} unclosed"
+            )
+    for (cat, flow_id), fl in flows.items():
+        if "s" not in fl:
+            raise ValueError(
+                f"flow cat={cat!r} id={flow_id!r}: f without s"
+            )
+        if "f" not in fl:
+            raise ValueError(
+                f"flow cat={cat!r} id={flow_id!r}: s without f "
+                "(dangling arrow)"
+            )
+        if fl["f"][0] < fl["s"][0]:
+            raise ValueError(
+                f"flow cat={cat!r} id={flow_id!r}: finish at "
+                f"ts={fl['f'][0]} precedes start at ts={fl['s'][0]}"
+            )
+        if fl["f"][1] != fl["s"][1]:
+            raise ValueError(
+                f"flow cat={cat!r} id={flow_id!r}: start name "
+                f"{fl['s'][1]!r} != finish name {fl['f'][1]!r}"
+            )
     for key, stack in be_stacks.items():
         if stack:
             raise ValueError(

@@ -426,6 +426,12 @@ def build_loadgen_parser() -> argparse.ArgumentParser:
     parser.add_argument("--slo-p95-ms", default=2000.0, type=float)
     parser.add_argument("--slo-ttft-p95-ms", default=None, type=float)
     parser.add_argument(
+        "--trace-sample", default=0.0, type=float, metavar="RATE",
+        help="head-sample this fraction of requests into distributed "
+        "traces (deterministic, does not shift the seeded plan); the "
+        "report then names trace ids pullable with pdrnn-metrics trace",
+    )
+    parser.add_argument(
         "--report", default=None, type=Path, metavar="PATH",
         help="also write the full JSON report here",
     )
@@ -457,6 +463,7 @@ def loadgen_main(argv=None) -> int:
         low_priority_fraction=args.low_priority_fraction,
         deadline_ms=args.deadline_ms,
         slo_p95_ms=args.slo_p95_ms, slo_ttft_p95_ms=args.slo_ttft_p95_ms,
+        trace_sample=args.trace_sample,
     )
 
     if args.spawn_fleet is not None:

@@ -13,6 +13,12 @@ shutdown under chaos is part of the contract.
 the degradation window the fault opened; the drill's acceptance is that
 the window CLOSES - load is shed or queued while the fault holds, and
 service recovers when it passes.
+
+A failed drill is actionable, not just red: the report names the
+slowest and every SLO-violating request (``slowest`` /
+``slo_violations``, each with its request id and - when tracing is on -
+trace id), and ``trace_handles`` collects the distinct trace ids to
+pull with ``pdrnn-metrics trace``.
 """
 
 from __future__ import annotations
@@ -85,4 +91,18 @@ def run_drill(serve_args: list[str], cfg: LoadConfig,
         report = run_load(cfg)
     report["server_exit"] = proc.returncode
     report["server_pid"] = proc.pid
+    report["trace_handles"] = trace_handles(report)
     return report, proc.returncode
+
+
+def trace_handles(report: dict) -> list[str]:
+    """The distinct trace ids a failed drill should pull with
+    ``pdrnn-metrics trace``: slowest requests first, then every SLO
+    violation (order-preserving dedup)."""
+    handles: list[str] = []
+    for entry in [*report.get("slowest", ()),
+                  *report.get("slo_violations", ())]:
+        trace_id = entry.get("trace_id")
+        if trace_id and trace_id not in handles:
+            handles.append(trace_id)
+    return handles

@@ -51,7 +51,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from pytorch_distributed_rnn_tpu.obs.live import RATE_HORIZON_S, RollingWindow
+from pytorch_distributed_rnn_tpu.obs.live import (
+    RATE_HORIZON_S,
+    LatencyHistogram,
+    RollingWindow,
+)
 from pytorch_distributed_rnn_tpu.obs.recorder import NULL_RECORDER
 from pytorch_distributed_rnn_tpu.obs.summary import percentile
 from pytorch_distributed_rnn_tpu.resilience.faults import ChaosError
@@ -199,6 +203,10 @@ class ServingEngine:
         # yields both req/s and tokens/s), sheds observe 1
         self._completions = RollingWindow(RATE_HORIZON_S)
         self._sheds = RollingWindow(RATE_HORIZON_S)
+        # request-latency histogram behind the aggregator's
+        # pdrnn_request_latency_seconds series; traced completions stamp
+        # their bucket's exemplar with their trace_id
+        self._latency_hist = LatencyHistogram()
 
     # -- construction helpers ------------------------------------------------
 
@@ -366,10 +374,16 @@ class ServingEngine:
             jnp.float32(request.temperature), jnp.int32(slot),
         )
         if self.recorder.enabled:
+            tm_done = time.perf_counter()
+            request.prefill_done_tm = tm_done
+            # traced requests thread their context into the span so the
+            # cross-process assembler (obs/trace.py) can re-join it
+            extra = ({} if request.trace is None
+                     else request.trace.child().span_fields())
             self.recorder.emit_span(
-                "prefill", t0, time.perf_counter() - t0, cat="serving",
+                "prefill", t0, tm_done - t0, cat="serving",
                 request=request.id or request.seq, bucket=request.bucket,
-                prompt_len=len(request.prompt), slot=slot,
+                prompt_len=len(request.prompt), slot=slot, **extra,
             )
 
     def _finish(self, slot: int, request: ServeRequest, now: float,
@@ -394,6 +408,12 @@ class ServingEngine:
                 self._ttfts.append(request.ttft_s)
             if request.queue_wait_s is not None:
                 self._queue_waits.append(request.queue_wait_s)
+        if request.latency_s is not None:
+            self._latency_hist.observe(
+                request.latency_s,
+                trace_id=None if request.trace is None
+                else request.trace.trace_id,
+            )
         if self.recorder.enabled:
             self.recorder.record(
                 "request", request=request.id or request.seq,
@@ -402,8 +422,47 @@ class ServingEngine:
                 queue_s=request.queue_wait_s, bucket=request.bucket,
                 error=request.error,
             )
+        if request.trace is not None:
+            self._emit_trace_spans(request, now)
         if request.on_done is not None:
             request.on_done(request)
+
+    def _emit_trace_spans(self, request: ServeRequest, now: float):
+        """The replica's lifecycle spans of one TRACED request, emitted
+        at completion as children of the router's dispatch-attempt span
+        (``request.trace``): queue_wait (admission -> slot), decode
+        (prefill end -> done; prefill itself is the cat="serving" span
+        ``_do_join`` stamps with its own child context), and stream_emit
+        (first token -> done) under decode for streamed requests.
+        Only reachable when the request arrived traced AND the engine
+        records, so the untraced path allocates nothing."""
+        ctx = request.trace
+        ident = request.id or request.seq
+        if request.arrival_tm is not None \
+                and request.service_tm is not None:
+            self.recorder.emit_span(
+                "queue_wait", request.arrival_tm,
+                request.service_tm - request.arrival_tm, cat="trace",
+                request=ident, **ctx.child().span_fields(),
+            )
+        decode_start = (request.prefill_done_tm
+                        if request.prefill_done_tm is not None
+                        else request.service_tm)
+        if decode_start is not None:
+            decode_ctx = ctx.child()
+            self.recorder.emit_span(
+                "decode", decode_start, max(0.0, now - decode_start),
+                cat="trace", request=ident, slot=request.slot,
+                tokens=len(request.tokens), status=request.status,
+                **decode_ctx.span_fields(),
+            )
+            if request.stream and request.first_token_tm is not None:
+                self.recorder.emit_span(
+                    "stream_emit", request.first_token_tm,
+                    max(0.0, now - request.first_token_tm), cat="trace",
+                    request=ident, tokens=len(request.tokens),
+                    **decode_ctx.child().span_fields(),
+                )
 
     def _apply_faults(self, step_index: int):
         """Trainer-style chaos hooks on the decode loop: stall holds the
@@ -509,7 +568,7 @@ class ServingEngine:
         watchdog's SLO detector - the same numbers the ``stats`` op
         serves, under one ``serving`` key."""
         stats = self.stats()
-        return {"serving": {
+        block = {
             k: stats.get(k) for k in (
                 "requests", "requests_shed", "requests_failed",
                 "tokens_out", "queue_depth", "active",
@@ -517,7 +576,11 @@ class ServingEngine:
                 "latency_s_p50", "latency_s_p95",
                 "ttft_s_p50", "ttft_s_p95",
             )
-        }}
+        }
+        hist = self._latency_hist.snapshot()
+        if hist is not None:
+            block["latency_hist"] = hist
+        return {"serving": block}
 
     def close(self):
         """Abort queued AND in-flight requests (their clients get an

@@ -79,6 +79,13 @@ def build_router_parser() -> argparse.ArgumentParser:
         help="default per-request deadline when the client sends none",
     )
     parser.add_argument(
+        "--trace-sample", default=0.0, type=float, metavar="RATE",
+        help="head-sample this fraction of untraced requests into "
+        "distributed traces (0 = off; requests arriving with a trace "
+        "field are always traced; needs --metrics for the spans to "
+        "land anywhere)",
+    )
+    parser.add_argument(
         "--eject-after", default=3, type=int,
         help="consecutive failures (ping or dispatch) opening a "
         "replica's circuit breaker",
@@ -213,6 +220,7 @@ def router_main(argv=None) -> int:
         default_deadline_ms=args.deadline_ms,
         connect_timeout_s=args.connect_timeout,
         io_timeout_s=args.io_timeout, recorder=recorder,
+        trace_sample=args.trace_sample,
     )
     if plane is not None:
         plane.exporter.add_source(core.live_source)

@@ -36,6 +36,7 @@ from pathlib import Path
 from pytorch_distributed_rnn_tpu.launcher.supervisor import (
     ReplicaSupervisor,
 )
+from pytorch_distributed_rnn_tpu.serving.drill import trace_handles
 from pytorch_distributed_rnn_tpu.serving.loadgen import (
     LoadConfig,
     run_load,
@@ -301,4 +302,5 @@ def run_fleet_drill(replica_args: list[str], cfg: LoadConfig, *,
         "supervision": supervision,
         "router_exit": fleet.router_proc.returncode,
     }
+    report["trace_handles"] = trace_handles(report)
     return report

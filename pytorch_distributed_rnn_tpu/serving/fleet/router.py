@@ -40,6 +40,17 @@ The robustness contracts, in order of importance:
   (connection closed, pool release neutral - a slow replica is not a
   failed one).  Stream requests never hedge: two streams cannot be
   merged token-wise.
+
+Distributed tracing (``obs/tracectx.py``): the router is the fleet's
+trace EDGE.  A request arriving with a ``trace`` wire field extends the
+client's context; otherwise ``--trace-sample RATE`` head-samples fresh
+roots.  A traced request gets a ``route`` span covering its whole stay,
+one ``attempt`` child span per dispatch (retries and both hedge legs
+each their own - sibling re-dispatches are finally distinguishable in
+replica sidecars), and the re-minted per-attempt context rides the
+forwarded message so the replica's queue_wait/prefill/decode spans nest
+under the attempt that caused them.  Untraced requests allocate no
+context and their forwarded bytes are untouched.
 """
 
 from __future__ import annotations
@@ -52,8 +63,15 @@ import socket
 import threading
 import time
 
-from pytorch_distributed_rnn_tpu.obs.live import RollingWindow
+from pytorch_distributed_rnn_tpu.obs.live import (
+    LatencyHistogram,
+    RollingWindow,
+)
 from pytorch_distributed_rnn_tpu.obs.recorder import NULL_RECORDER
+from pytorch_distributed_rnn_tpu.obs.tracectx import (
+    TraceContext,
+    should_sample,
+)
 from pytorch_distributed_rnn_tpu.resilience.retry import backoff_delays
 from pytorch_distributed_rnn_tpu.serving.fleet.pool import Replica
 from pytorch_distributed_rnn_tpu.serving.protocol import (
@@ -91,7 +109,8 @@ class RouterCore:
                  default_deadline_ms: float | None = None,
                  connect_timeout_s: float = 2.0,
                  io_timeout_s: float = 30.0,
-                 recorder=None, seed: int = 0):
+                 recorder=None, seed: int = 0,
+                 trace_sample: float = 0.0):
         self.pool = pool
         self.max_inflight = int(max_inflight)
         self.retries = int(retries)
@@ -107,7 +126,11 @@ class RouterCore:
         self.io_timeout_s = float(io_timeout_s)
         self.recorder = recorder if recorder is not None else NULL_RECORDER
         self.seed = int(seed)
+        self.trace_sample = float(trace_sample)
         self._seed_seq = itertools.count()
+        # head-sampling sequence for router-minted trace roots (only
+        # consumed when sampling is on and the request arrived untraced)
+        self._trace_seq = itertools.count(1)
         self._lock = threadcheck.lock(threading.Lock(), "router.stats")  # guards: _inflight, _submitted, _done, _errors, _shed, _drain_rejected, _retries, _rerouted, _hedges, _hedge_wins, _stream_aborts, _draining, _route_span_open
         self._inflight = 0
         self._submitted = 0
@@ -130,6 +153,10 @@ class RouterCore:
         # thread-safe on their own: read outside the stats lock
         self._completions = RollingWindow()
         self._latency_s = RollingWindow()
+        # request-latency histogram behind the aggregator's
+        # pdrnn_request_latency_seconds series; traced completions stamp
+        # their bucket's exemplar with their trace_id
+        self._latency_hist = LatencyHistogram()
 
     # -- admission -----------------------------------------------------------
 
@@ -192,6 +219,21 @@ class RouterCore:
             send(final)
             return final
 
+        # trace edge: extend the sender's context, or head-sample a
+        # fresh root when --trace-sample is on.  An untraced request
+        # constructs NO context (the zero-overhead pin) and is forwarded
+        # byte-identical.
+        route_ctx = None
+        if self.recorder.enabled:
+            if "trace" in msg:
+                # protocol: serve field trace
+                incoming = TraceContext.from_wire(msg.get("trace"))
+                if incoming is not None:
+                    route_ctx = incoming.child()
+            elif self.trace_sample > 0.0 and should_sample(
+                    next(self._trace_seq), self.trace_sample):
+                route_ctx = TraceContext.mint(qos=qos)
+
         deadline_ms = msg.get("deadline_ms", self.default_deadline_ms)
         expiry = (
             None if deadline_ms is None
@@ -207,7 +249,7 @@ class RouterCore:
                 # its end-time measurement), so candidate spans nest
                 span_t0 = time.perf_counter()
         try:
-            final, meta = self._route(msg, send, expiry)
+            final, meta = self._route(msg, send, expiry, route_ctx)
         finally:
             if span_t0 is not None:
                 span_dur = time.perf_counter() - span_t0
@@ -229,6 +271,10 @@ class RouterCore:
         if ok:
             self._completions.observe(1.0)
             self._latency_s.observe(elapsed)
+            self._latency_hist.observe(
+                elapsed, trace_id=None if route_ctx is None
+                else route_ctx.trace_id,
+            )
             if span_t0 is not None and \
                     self.recorder.is_sample_step(submitted):
                 self.recorder.emit_span(
@@ -236,11 +282,29 @@ class RouterCore:
                     replica=meta.get("replica"),
                     attempts=meta.get("attempts"), qos=qos,
                 )
+        if route_ctx is not None:
+            # the request-level trace span: emitted for EVERY traced
+            # request (unlike the sampled timeline-lane span above) so
+            # the assembled tree never misses its router root, and the
+            # client learns its trace_id from the final payload
+            self.recorder.emit_span(
+                "route", t0, elapsed, cat="trace", request=request_id,
+                qos=qos, replica=meta.get("replica"),
+                attempts=meta.get("attempts"),
+                outcome=final.get("event"),
+                **route_ctx.span_fields(),
+            )
+            final["trace_id"] = route_ctx.trace_id
         send(final)
         return final
 
-    def _route(self, msg: dict, send, expiry) -> tuple[dict, dict]:
-        """Dispatch with retry/hedge; returns (final-payload, meta)."""
+    def _route(self, msg: dict, send, expiry,
+               route_ctx=None) -> tuple[dict, dict]:
+        """Dispatch with retry/hedge; returns (final-payload, meta).
+        With a ``route_ctx`` every dispatch attempt forks a child
+        context, forwards it on a COPIED message, and emits an
+        ``attempt`` span - the original ``msg`` is never mutated, so
+        untraced forwarding stays byte-identical."""
         stream = bool(msg.get("stream"))
         relayed = {"tokens": 0}
         relay = send if stream else None
@@ -270,16 +334,36 @@ class RouterCore:
                 break
             tried.append(replica.replica_id)
             attempts += 1
+            hedge_now = hedge_first and attempt == 0
+            att_ctx = att_msg = att_t0 = None
+            if route_ctx is not None and not hedge_now:
+                att_ctx = route_ctx.child()
+                # protocol: serve field trace
+                att_msg = {**msg, "trace": att_ctx.to_wire()}
+                att_t0 = time.perf_counter()
             try:
-                if hedge_first and attempt == 0:
+                if hedge_now:
                     reply, hedge_replica, hedged = self._dispatch_hedged(
-                        replica, msg, expiry, tried
+                        replica, msg, expiry, tried,
+                        route_ctx=route_ctx, attempt_index=attempts,
                     )
                     replica = hedge_replica
                 else:
-                    reply = self._dispatch(replica, msg, relay, relayed,
-                                           expiry)
+                    reply = self._dispatch(
+                        replica, msg if att_msg is None else att_msg,
+                        relay, relayed, expiry,
+                    )
+                    if att_ctx is not None:
+                        self._emit_attempt_span(
+                            att_ctx, att_t0, replica.replica_id,
+                            attempts, reply.get("event"),
+                        )
             except DispatchError as exc:
+                if att_ctx is not None:
+                    self._emit_attempt_span(
+                        att_ctx, att_t0, replica.replica_id, attempts,
+                        "transport_error",
+                    )
                 last_error = str(exc)
                 if relayed["tokens"]:
                     # the stream already reached the client: a replay
@@ -320,6 +404,18 @@ class RouterCore:
             "error": f"retry budget exhausted after {attempts} "
                      f"attempt(s): {last_error}",
         }, {"attempts": attempts})
+
+    def _emit_attempt_span(self, ctx, t0: float, replica_id: int,
+                           attempt: int, outcome,
+                           hedge: bool = False) -> None:
+        """One dispatch attempt's trace span (child of the route span):
+        retries and hedge legs each carry their own context, so sibling
+        re-dispatches stay distinguishable in the assembled tree."""
+        self.recorder.emit_span(
+            "attempt", t0, time.perf_counter() - t0, cat="trace",
+            replica=replica_id, attempt=attempt, outcome=outcome,
+            hedge=True if hedge else None, **ctx.span_fields(),
+        )
 
     # -- single dispatch -----------------------------------------------------
 
@@ -379,29 +475,52 @@ class RouterCore:
     # -- hedging -------------------------------------------------------------
 
     def _dispatch_hedged(self, primary: Replica, msg: dict, expiry,
-                         tried: list):
+                         tried: list, route_ctx=None,
+                         attempt_index: int = 1):
         """Primary dispatch with a tail-latency hedge: when the primary
         is silent past ``hedge_after_ms``, dispatch a sibling; the
         first FINAL reply wins and the loser is cancelled (socket
-        closed, neutral pool release).  Returns ``(reply, winning
-        replica, hedged?)``; raises :class:`DispatchError` when every
-        launched dispatch failed."""
+        closed, neutral pool release).  With a ``route_ctx`` each leg
+        forwards its OWN child context and emits its own ``attempt``
+        span (the loser's with outcome ``cancelled``).  Returns
+        ``(reply, winning replica, hedged?)``; raises
+        :class:`DispatchError` when every launched dispatch failed."""
         results: queue.Queue = queue.Queue()
         runners: list[tuple[Replica, dict]] = []
 
-        def launch(replica: Replica):
+        def launch(replica: Replica, hedge: bool = False):
             box = {"conn": None, "cancelled": False}
             runners.append((replica, box))
+            ctx, leg_msg = None, msg
+            if route_ctx is not None:
+                ctx = route_ctx.child()
+                # protocol: serve field trace
+                leg_msg = {**msg, "trace": ctx.to_wire()}
 
             def run():
+                t0 = None if ctx is None else time.perf_counter()
                 state = {"tokens": 0}
                 try:
-                    reply = self._dispatch(replica, msg, None, state,
+                    reply = self._dispatch(replica, leg_msg, None, state,
                                            expiry, cancel_box=box)
+                    if ctx is not None:
+                        self._emit_attempt_span(
+                            ctx, t0, replica.replica_id, attempt_index,
+                            reply.get("event"), hedge=hedge,
+                        )
                     results.put((replica, reply, None))
                 except _Cancelled:
-                    pass
+                    if ctx is not None:
+                        self._emit_attempt_span(
+                            ctx, t0, replica.replica_id, attempt_index,
+                            "cancelled", hedge=hedge,
+                        )
                 except DispatchError as exc:
+                    if ctx is not None:
+                        self._emit_attempt_span(
+                            ctx, t0, replica.replica_id, attempt_index,
+                            "transport_error", hedge=hedge,
+                        )
                     results.put((replica, None, exc))
 
             threading.Thread(
@@ -433,7 +552,7 @@ class RouterCore:
                     secondary=secondary.replica_id,
                     request=str(msg.get("id", "")),
                 )
-                launch(secondary)
+                launch(secondary, hedge=True)
             first = get(budget)
         if first is not None and first[1] is None and len(runners) == 2:
             # the first finisher FAILED; give the other dispatch its
@@ -486,7 +605,7 @@ class RouterCore:
         """The ``router`` gauge block riding every live digest (the
         aggregator exports it as ``pdrnn_router_*``)."""
         stats = self.stats()
-        return {"router": {
+        block = {
             "inflight": stats["inflight"], "routed": stats["done"],
             "rerouted": stats["rerouted"], "retries": stats["retries"],
             "hedges": stats["hedges"],
@@ -497,7 +616,11 @@ class RouterCore:
             "req_per_s_60s": stats["req_per_s_60s"],
             "latency_s_p50": stats["latency_s_p50"],
             "latency_s_p95": stats["latency_s_p95"],
-        }}
+        }
+        hist = self._latency_hist.snapshot()
+        if hist is not None:
+            block["latency_hist"] = hist
+        return {"router": block}
 
     def summary_fields(self) -> dict:
         """The ``run_summary`` contribution (``ROUTER_SUMMARY_KEYS`` in

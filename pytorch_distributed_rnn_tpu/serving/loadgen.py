@@ -26,10 +26,19 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from pytorch_distributed_rnn_tpu.obs.summary import percentile
+from pytorch_distributed_rnn_tpu.obs.tracectx import (
+    TraceContext,
+    should_sample,
+)
 from pytorch_distributed_rnn_tpu.serving.protocol import (
     ProtocolError,
     ServingClient,
 )
+
+# report caps: how many slowest / violating requests the report NAMES
+# (ids + trace ids - the handles `pdrnn-metrics trace` pulls)
+SLOWEST_NAMED = 5
+VIOLATIONS_NAMED = 20
 
 
 @dataclass(frozen=True)
@@ -52,6 +61,9 @@ class LoadConfig:
     deadline_ms: float | None = None  # server-side QoS deadline field
     slo_p95_ms: float = 2000.0
     slo_ttft_p95_ms: float | None = None
+    # head-sample this fraction of requests into distributed traces
+    # (deterministic, RNG-free: sampling must not shift the seeded plan)
+    trace_sample: float = 0.0
 
 
 @dataclass
@@ -66,6 +78,10 @@ class RequestOutcome:
     tokens: int = 0
     error: str | None = None
     done_at_s: float | None = None
+    request_id: str | None = None
+    # loadgen-minted (--trace-sample) or router-assigned trace id - the
+    # handle the report prints for pdrnn-metrics trace
+    trace_id: str | None = None
     _reply: dict | None = field(default=None, repr=False)
 
 
@@ -130,6 +146,15 @@ def run_load(cfg: LoadConfig, progress=None) -> dict:
     def fire(i: int):
         spec = plan[i]
         out = outcomes[i]
+        out.request_id = str(i)
+        # trace minting at the loadgen edge: deterministic head
+        # sampling (no RNG - the seeded request plan must not shift
+        # when tracing turns on)
+        ctx = None
+        if cfg.trace_sample > 0.0 \
+                and should_sample(i + 1, cfg.trace_sample):
+            ctx = TraceContext.mint(qos=spec.get("priority"))
+            out.trace_id = ctx.trace_id
         try:
             # connect bounded separately from reads (a vanished target
             # fails the dial in seconds), and deadline_s caps the WHOLE
@@ -148,6 +173,7 @@ def run_load(cfg: LoadConfig, progress=None) -> dict:
                               if cfg.low_priority_fraction > 0 else None),
                     deadline_ms=cfg.deadline_ms,
                     deadline_s=cfg.timeout_s,
+                    trace=ctx,
                 )
         except (OSError, ProtocolError) as exc:
             out.status = "error"
@@ -156,6 +182,11 @@ def run_load(cfg: LoadConfig, progress=None) -> dict:
             return
         out.done_at_s = time.perf_counter() - t0
         out._reply = reply
+        # a router tracing via --trace-sample echoes ITS minted trace
+        # id on the final payload - adopt it so the report names a
+        # pullable trace even when the loadgen sent none
+        if reply.get("trace_id"):
+            out.trace_id = str(reply["trace_id"])
         if reply.get("event") == "done":
             out.status = "done"
             out.latency_ms = reply.get("latency_ms")
@@ -245,6 +276,30 @@ def build_report(cfg: LoadConfig, outcomes: list[RequestOutcome],
         key = "errors" if o.status == "error" else o.status
         bucket[key] = bucket.get(key, 0) + 1
 
+    # name the handles a failed drill needs: the slowest completions
+    # and every SLO-violating request, each with the trace id (when
+    # traced) that pdrnn-metrics trace pulls
+    def _named(o: RequestOutcome, **extra) -> dict:
+        return {
+            "request_id": (o.request_id if o.request_id is not None
+                           else str(o.index)),
+            "trace_id": o.trace_id, **extra,
+        }
+
+    ranked = sorted((o for o in done if o.latency_ms is not None),
+                    key=lambda o: -o.latency_ms)
+    slowest = [_named(o, latency_ms=o.latency_ms)
+               for o in ranked[:SLOWEST_NAMED]]
+    violations = []
+    for o in done:
+        if o.latency_ms is not None and o.latency_ms > cfg.slo_p95_ms:
+            violations.append(
+                _named(o, reason="latency", latency_ms=o.latency_ms))
+        elif cfg.slo_ttft_p95_ms is not None and o.ttft_ms is not None \
+                and o.ttft_ms > cfg.slo_ttft_p95_ms:
+            violations.append(
+                _named(o, reason="ttft", ttft_ms=o.ttft_ms))
+
     p95 = _percentile(lat, 0.95)
     ttft_p95 = _percentile(ttft, 0.95)
     slo = {
@@ -279,6 +334,8 @@ def build_report(cfg: LoadConfig, outcomes: list[RequestOutcome],
             "p95": _percentile(queue, 0.95),
         },
         "slo": slo,
+        "slowest": slowest,
+        "slo_violations": violations,
         "by_priority": by_priority,
         "timeline": timeline,
         "degraded_seconds": degraded_seconds,
@@ -316,6 +373,30 @@ def format_report(report: dict) -> str:
     if "ttft_p95_ok" in slo:
         verdict = "PASS" if slo["ttft_p95_ok"] else "FAIL"
         lines.append(f"SLO ttft p95 <= {slo['ttft_p95_ms']:g}ms: {verdict}")
+
+    def _handle(entry: dict) -> str:
+        trace = entry.get("trace_id")
+        return (f"request {entry['request_id']}"
+                + (f"  trace {trace}" if trace else ""))
+
+    slowest = report.get("slowest") or []
+    if slowest:
+        lines.append("slowest (pull with pdrnn-metrics trace "
+                     "--request ID):")
+        for entry in slowest:
+            lines.append(
+                f"  {entry['latency_ms']:8.1f}ms  {_handle(entry)}")
+    violations = report.get("slo_violations") or []
+    if violations:
+        lines.append(f"SLO violations ({len(violations)}):")
+        for entry in violations[:VIOLATIONS_NAMED]:
+            value = entry.get("latency_ms", entry.get("ttft_ms"))
+            lines.append(
+                f"  {value:8.1f}ms  {entry['reason']:<7s} "
+                f"{_handle(entry)}")
+        if len(violations) > VIOLATIONS_NAMED:
+            lines.append(
+                f"  ... and {len(violations) - VIOLATIONS_NAMED} more")
     window = report["degradation_window_s"]
     if window:
         lines.append(

@@ -22,6 +22,20 @@ sheds low priority first past its admission budget and bounds each
 request's dispatch + retries by its deadline.  A bare ``pdrnn-serve``
 ignores both - single-replica requests keep their exact old behavior.
 
+``trace`` is the OPTIONAL distributed-tracing context
+(``obs/tracectx.py``)::
+
+    {"op": "generate", "trace": {"id": "9f2c...", "span": "51ab...",
+     "parent": "03de...", "qos": "high"}, ...}
+
+``id`` names the whole request's trace, ``span`` the sender's span,
+``parent`` its cause; remaining keys are QoS baggage.  Every hop that
+forwards a traced request re-mints ``span`` (router dispatch attempts
+each get their own), and receivers that don't trace simply ignore the
+field.  Untraced requests carry NO ``trace`` key at all - the wire
+bytes of an untraced request are pinned byte-identical to the
+pre-tracing protocol.
+
 Server -> client::
 
     {"id": "r1", "event": "token", "index": 0, "token": 42}   # stream
@@ -39,16 +53,21 @@ server multiplexes slots across connections).
 
 from __future__ import annotations
 
+import itertools
 import json
+import os
 import socket
 import time
 
 # The serve wire contract - the PD401 registry (lint/lifecycle.py):
 # every op below must name a `handles` dispatch site, every `request`
-# site must pair with a `reply` site.
+# site must pair with a `reply` site, and optional wire fields are
+# declared with `field` so the registry stays the single source of
+# truth for what rides the protocol.
 # protocol: serve op generate
 # protocol: serve op ping
 # protocol: serve op stats
+# protocol: serve field trace
 
 
 def encode_line(obj: dict) -> bytes:
@@ -72,6 +91,41 @@ def tokens_to_text(tokens: list[int]) -> str:
     """Best-effort text rendering of byte tokens (lossless for ids
     < 256 via latin-1; serving never round-trips through this)."""
     return bytes(t & 0xFF for t in tokens).decode("latin-1")
+
+
+def build_generate_request(prompt=None, *, text: str | None = None,
+                           request_id: str = "0",
+                           max_new_tokens: int = 16,
+                           temperature: float = 0.0,
+                           seed: int | None = None, stream: bool = False,
+                           priority: str | None = None,
+                           deadline_ms: float | None = None,
+                           trace=None) -> dict:
+    """The exact ``generate`` request object a client puts on the wire.
+
+    Factored out of :meth:`ServingClient.generate` so tests can pin the
+    untraced wire bytes: with ``trace=None`` the returned dict carries
+    no ``trace`` key and is byte-identical to the pre-tracing protocol.
+    ``trace`` is a :class:`~..obs.tracectx.TraceContext` (duck-typed:
+    anything with ``to_wire()``)."""
+    req: dict = {
+        "op": "generate", "id": request_id,
+        "max_new_tokens": int(max_new_tokens),
+        "temperature": float(temperature), "stream": bool(stream),
+    }
+    if text is not None:
+        req["text"] = text
+    else:
+        req["prompt"] = [int(t) for t in (prompt or [])]
+    if seed is not None:
+        req["seed"] = int(seed)
+    if priority is not None:
+        req["priority"] = str(priority)
+    if deadline_ms is not None:
+        req["deadline_ms"] = float(deadline_ms)
+    if trace is not None:
+        req["trace"] = trace.to_wire()  # protocol: serve field trace
+    return req
 
 
 class ProtocolError(RuntimeError):
@@ -102,6 +156,11 @@ class ServingClient:
         except Exception:
             self.sock.close()
             raise
+        # per-client unique request-id minting: a random prefix keeps
+        # ids from CONCURRENT clients of one server distinct, the
+        # counter keeps a single client's requests distinct
+        self._id_prefix = os.urandom(3).hex()
+        self._id_seq = itertools.count()
 
     def close(self):
         try:
@@ -147,34 +206,37 @@ class ServingClient:
     def generate(self, prompt=None, *, text: str | None = None,
                  max_new_tokens: int = 16, temperature: float = 0.0,
                  seed: int | None = None, stream: bool = False,
-                 request_id: str = "0", on_token=None,
+                 request_id: str | None = None, on_token=None,
                  priority: str | None = None,
                  deadline_ms: float | None = None,
-                 deadline_s: float | None = None) -> dict:
+                 deadline_s: float | None = None,
+                 trace=None) -> dict:
         """Run one generation; returns the final ``done``/``error``
         payload.  With ``stream=True``, ``on_token(index, token)`` fires
         per streamed token before the final payload arrives.
 
+        ``request_id`` defaults to a freshly minted per-client unique id
+        (prefix + counter) - the old ``"0"`` default made every request
+        from a default-argument caller the SAME request in stats and
+        sidecars.  Pass an explicit id to correlate with external
+        bookkeeping.
+
         ``priority``/``deadline_ms`` ride in the request (router QoS
-        fields; plain servers ignore them).  ``deadline_s`` is CLIENT-
-        side: a wall bound across every read of this request - without
-        it a stream emitting a token every few hundred ms resets the
+        fields; plain servers ignore them).  ``trace`` attaches a
+        :class:`~..obs.tracectx.TraceContext` as the ``trace`` wire
+        field; ``None`` (the default) leaves the request byte-identical
+        to the untraced protocol.  ``deadline_s`` is CLIENT-side: a
+        wall bound across every read of this request - without it a
+        stream emitting a token every few hundred ms resets the
         per-read timeout forever and a wedged server pins the caller."""
-        req: dict = {
-            "op": "generate", "id": request_id,
-            "max_new_tokens": int(max_new_tokens),
-            "temperature": float(temperature), "stream": bool(stream),
-        }
-        if text is not None:
-            req["text"] = text
-        else:
-            req["prompt"] = [int(t) for t in (prompt or [])]
-        if seed is not None:
-            req["seed"] = int(seed)
-        if priority is not None:
-            req["priority"] = str(priority)
-        if deadline_ms is not None:
-            req["deadline_ms"] = float(deadline_ms)
+        if request_id is None:
+            request_id = f"{self._id_prefix}-{next(self._id_seq)}"
+        req = build_generate_request(
+            prompt, text=text, request_id=request_id,
+            max_new_tokens=max_new_tokens, temperature=temperature,
+            seed=seed, stream=stream, priority=priority,
+            deadline_ms=deadline_ms, trace=trace,
+        )
         self._send(req)  # protocol: serve request generate
         expiry = (
             None if deadline_s is None

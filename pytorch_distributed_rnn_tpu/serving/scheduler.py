@@ -45,6 +45,10 @@ class ServeRequest:
     # engine-facing callbacks (server wires the connection here)
     on_token: Callable | None = None
     on_done: Callable | None = None
+    # distributed-tracing context (obs/tracectx.TraceContext) - set by
+    # the server only when the request arrived traced AND the engine
+    # records; None everywhere else (the zero-overhead-off contract)
+    trace: object | None = None
     # lifecycle
     status: str = "queued"  # queued | active | done | shed | error
     error: str | None = None
@@ -54,6 +58,7 @@ class ServeRequest:
     seq: int | None = None  # admission order, engine-assigned
     arrival_tm: float | None = None
     service_tm: float | None = None  # joined a slot
+    prefill_done_tm: float | None = None
     first_token_tm: float | None = None
     done_tm: float | None = None
 

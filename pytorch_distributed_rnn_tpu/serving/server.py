@@ -39,6 +39,7 @@ import socket
 import threading
 import time
 
+from pytorch_distributed_rnn_tpu.obs.tracectx import TraceContext
 from pytorch_distributed_rnn_tpu.resilience.faults import FAULT_FLAP_ENV
 from pytorch_distributed_rnn_tpu.serving.protocol import (
     encode_line,
@@ -349,9 +350,18 @@ class ServingServer:
                 payload["text"] = tokens_to_text(request.tokens)
             send(payload)
 
+        # distributed tracing: adopt the sender's context only when this
+        # replica actually records spans - otherwise no TraceContext is
+        # ever constructed on the untraced/unrecorded path (the
+        # zero-overhead-off pin); malformed contexts parse to None and
+        # never fail the request
+        trace = None
+        if "trace" in msg and self.engine.recorder.enabled:
+            # protocol: serve field trace
+            trace = TraceContext.from_wire(msg.get("trace"))
         request = ServeRequest(
             prompt=prompt, max_new_tokens=max_new, temperature=temperature,
-            seed=seed, id=request_id, stream=stream,
+            seed=seed, id=request_id, stream=stream, trace=trace,
             on_token=on_token, on_done=on_done,
         )
         if not self.engine.submit(request):

@@ -603,3 +603,165 @@ class TestLoadgenDeadline:
         assert all(p["priority"] == "normal" for p in plan_a)
         lows = sum(p["priority"] == "low" for p in plan_b)
         assert 0 < lows < 20
+
+
+# ---------------------------------------------------------------------------
+# distributed tracing: the router as the fleet's trace edge
+
+
+def _wait_dispatch_threads(timeout_s=3.0):
+    """Hedge losers emit their attempt span from their own dispatch
+    thread after the winner already returned - wait those threads out
+    before closing the recorder."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if not any(t.name.startswith("pdrnn-router-dispatch-")
+                   for t in threading.enumerate()):
+            return
+        time.sleep(0.01)
+
+
+def _trace_spans(path):
+    from pytorch_distributed_rnn_tpu.obs.summary import load_events
+
+    return [e for e in load_events(path)
+            if e.get("kind") == "span" and e.get("cat") == "trace"]
+
+
+class TestRouterTracing:
+    def make_traced_core(self, tmp_path, n=2, trace_sample=1.0,
+                         pool_kwargs=None, **kwargs):
+        from pytorch_distributed_rnn_tpu.obs import MetricsRecorder
+
+        servers, pool = make_pool(n, **(pool_kwargs or {}))
+        recorder = MetricsRecorder(
+            tmp_path / "router.jsonl", sample_every=1,
+            meta={"role": "router"},
+        )
+        core = RouterCore(pool, recorder=recorder,
+                          trace_sample=trace_sample,
+                          retry_base_delay_s=0.001, **kwargs)
+        return servers, core, recorder
+
+    def test_sampled_request_emits_route_and_attempt_spans(
+            self, tmp_path):
+        servers, core, recorder = self.make_traced_core(tmp_path)
+        sent, send = collect()
+        final = core.handle_generate(
+            {"op": "generate", "id": "r1", "priority": "high"}, send)
+        recorder.close()
+        assert final["event"] == "done"
+        spans = _trace_spans(recorder.path)
+        route = next(s for s in spans if s["name"] == "route")
+        assert route["request"] == "r1" and route["qos"] == "high"
+        assert route["outcome"] == "done" and route["attempts"] == 1
+        assert route.get("parent") is None  # router-minted root
+        assert final["trace_id"] == route["trace"]
+        attempt = next(s for s in spans if s["name"] == "attempt")
+        assert attempt["trace"] == route["trace"]
+        assert attempt["parent"] == route["span"]
+        assert attempt["outcome"] == "done"
+        # the dispatched message carried the ATTEMPT's context, one
+        # causal hop below the route span
+        wire = servers[final["served_by"] - 1].requests[0]["trace"]
+        assert wire["id"] == route["trace"]
+        assert wire["span"] == attempt["span"]
+
+    def test_retry_attempts_are_distinct_sibling_spans(self, tmp_path):
+        servers, core, recorder = self.make_traced_core(
+            tmp_path, retries=2, pool_kwargs={"eject_after": 1})
+        servers[0].fail_generates = 1
+        sent, send = collect()
+        final = core.handle_generate(
+            {"op": "generate", "id": "r2", "seed": 11}, send)
+        recorder.close()
+        assert final["event"] == "done" and final["attempts"] == 2
+        spans = _trace_spans(recorder.path)
+        route = next(s for s in spans if s["name"] == "route")
+        attempts = [s for s in spans if s["name"] == "attempt"]
+        assert len(attempts) == 2
+        assert len({s["span"] for s in attempts}) == 2
+        assert all(s["parent"] == route["span"] for s in attempts)
+        assert [s["attempt"] for s in attempts] == [1, 2]
+        assert [s["outcome"] for s in attempts] == [
+            "transport_error", "done"]
+        # the sidecar alone re-assembles into a validator-clean tree
+        from pytorch_distributed_rnn_tpu.obs.trace import (
+            assemble_traces,
+            validate_trace_tree,
+        )
+
+        tree = assemble_traces([recorder.path])[0]
+        assert tree.root.name == "route"
+        assert [c.name for c in tree.root.children] == [
+            "attempt", "attempt"]
+        validate_trace_tree(tree)
+
+    def test_incoming_wire_trace_is_continued_as_a_child(self, tmp_path):
+        servers, core, recorder = self.make_traced_core(
+            tmp_path, trace_sample=0.0)
+        sent, send = collect()
+        final = core.handle_generate(
+            {"op": "generate", "id": "r3",
+             # protocol: serve field trace
+             "trace": {"id": "cafecafecafecafe", "span": "beef0001",
+                       "qos": "high"}}, send)
+        recorder.close()
+        assert final["trace_id"] == "cafecafecafecafe"
+        route = next(s for s in _trace_spans(recorder.path)
+                     if s["name"] == "route")
+        assert route["trace"] == "cafecafecafecafe"
+        assert route["parent"] == "beef0001"  # the client's edge span
+
+    def test_hedge_legs_carry_per_leg_contexts(self, tmp_path):
+        servers, core, recorder = self.make_traced_core(
+            tmp_path, retries=0, hedge_after_ms=40)
+        servers[0].delay_s = 0.5  # primary silent past the hedge fuse
+        sent, send = collect()
+        final = core.handle_generate(
+            {"op": "generate", "id": "r4", "seed": 9}, send)
+        _wait_dispatch_threads()
+        recorder.close()
+        assert final["event"] == "done" and final["served_by"] == 2
+        spans = _trace_spans(recorder.path)
+        route = next(s for s in spans if s["name"] == "route")
+        attempts = [s for s in spans if s["name"] == "attempt"]
+        assert len(attempts) == 2
+        assert len({s["span"] for s in attempts}) == 2
+        assert all(s["parent"] == route["span"] for s in attempts)
+        by_replica = {s["replica"]: s for s in attempts}
+        assert by_replica[2]["outcome"] == "done"
+        assert by_replica[2].get("hedge") is True
+        assert by_replica[1]["outcome"] == "cancelled"
+
+    def test_tracing_off_allocates_no_context_and_keeps_wire_identical(
+            self, tmp_path):
+        from pytorch_distributed_rnn_tpu.obs.tracectx import TraceContext
+
+        # recorder on but sampling off, and no incoming context: the
+        # request must construct NO TraceContext and forward the exact
+        # message it received (plus the idempotency seed)
+        servers, core, recorder = self.make_traced_core(
+            tmp_path, trace_sample=0.0)
+        before = TraceContext.minted
+        sent, send = collect()
+        final = core.handle_generate(
+            {"op": "generate", "id": "r5"}, send)
+        recorder.close()
+        assert final["event"] == "done"
+        assert "trace_id" not in final
+        assert TraceContext.minted == before
+        assert "trace" not in servers[final["served_by"] - 1].requests[0]
+        assert _trace_spans(recorder.path) == []
+
+    def test_null_recorder_never_samples(self):
+        from pytorch_distributed_rnn_tpu.obs.tracectx import TraceContext
+
+        servers, pool = make_pool(1)
+        core = RouterCore(pool, trace_sample=1.0)  # NULL_RECORDER
+        before = TraceContext.minted
+        sent, send = collect()
+        final = core.handle_generate({"op": "generate", "id": "r6"}, send)
+        assert final["event"] == "done"
+        assert "trace_id" not in final
+        assert TraceContext.minted == before

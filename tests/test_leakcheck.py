@@ -384,6 +384,24 @@ class TestFixedLeakSites:
         assert leakcheck.check_drained("serve.shutdown") == []
         assert leakcheck.stats()["adopted"] >= 1
 
+    def test_live_plane_listener_is_adopted_not_leaked(self):
+        # the /metrics listener outlives the drain boundary by design
+        # (CLI mains close the plane AFTER shutdown so the final digest
+        # stays scrape-able); a traced `--live` fleet drill must not
+        # report it at router.shutdown
+        from pytorch_distributed_rnn_tpu.obs.aggregator import (
+            Aggregator,
+            AggregatorServer,
+        )
+
+        leakcheck.install()
+        server = AggregatorServer(Aggregator())
+        try:
+            assert leakcheck.check_drained("router.shutdown") == []
+            assert leakcheck.stats()["adopted"] >= 1
+        finally:
+            server.close()
+
 
 # -- drills -------------------------------------------------------------------
 

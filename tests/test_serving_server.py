@@ -321,3 +321,117 @@ def test_slo_drill_under_stall_fault(trained_checkpoint, tmp_path):
     text = metrics.read_text()
     assert '"kind": "fault"' in text
     assert summarize_file(metrics)["requests"] == report["done"]
+
+
+# ---------------------------------------------------------------------------
+# request-id minting (the old default made every default-arg request
+# the SAME request "0") + distributed tracing through the engine
+
+
+def test_default_request_ids_are_unique_within_and_across_clients(
+        trained_checkpoint):
+    _, params = trained_checkpoint
+    with make_server(params) as server:
+        with ServingClient(server.host, server.port) as a, \
+                ServingClient(server.host, server.port) as b:
+            replies = [
+                a.generate(prompt=[1], max_new_tokens=2),
+                a.generate(prompt=[1], max_new_tokens=2),
+                b.generate(prompt=[1], max_new_tokens=2),
+            ]
+    assert all(r["event"] == "done" for r in replies)
+    ids = [r["id"] for r in replies]
+    assert len(set(ids)) == 3, f"request ids collided: {ids}"
+    assert "0" not in ids  # the old colliding default
+    # explicit ids still pass through verbatim
+    with make_server(params) as server:
+        with ServingClient(server.host, server.port) as client:
+            reply = client.generate(prompt=[1], max_new_tokens=2,
+                                    request_id="mine")
+    assert reply["id"] == "mine"
+
+
+def test_traced_request_assembles_into_engine_lifecycle_tree(
+        trained_checkpoint, tmp_path):
+    """A client-minted context rides the wire, the engine emits
+    queue_wait/prefill/decode spans under it, and the sidecar ALONE
+    re-assembles into a validator-clean tree rooted at the client's
+    (unrecorded) edge span."""
+    from pytorch_distributed_rnn_tpu.obs.trace import (
+        assemble_traces,
+        validate_trace_tree,
+    )
+    from pytorch_distributed_rnn_tpu.obs.tracectx import TraceContext
+
+    _, params = trained_checkpoint
+    metrics = tmp_path / "traced.jsonl"
+    ctx = TraceContext.mint(qos="high")
+    with make_server(params, metrics_path=metrics) as server:
+        with ServingClient(server.host, server.port) as client:
+            reply = client.generate(prompt=[1, 2, 3], max_new_tokens=4,
+                                    request_id="tr1", trace=ctx,
+                                    stream=True)
+    assert reply["event"] == "done"
+    trees = assemble_traces([metrics], request=ctx.trace_id)
+    assert len(trees) == 1
+    tree = trees[0]
+    validate_trace_tree(tree)
+    assert tree.request == "tr1"
+    # the engine phases are all siblings under the client's edge span,
+    # which no sidecar recorded - the assembler synthesizes it
+    assert tree.root.name == "request"
+    assert tree.root.span_id == ctx.span_id
+    names = {n.name for n in tree.root.walk()}
+    assert {"queue_wait", "prefill", "decode", "stream_emit"} <= names
+    fractions = tree.critical_path()
+    assert sum(fractions.values()) == 1.0
+
+
+def test_tracing_off_is_pinned_zero_overhead(trained_checkpoint,
+                                             tmp_path):
+    """The zero-overhead contract, pinned three ways: an untraced
+    request constructs no TraceContext anywhere in the process, its
+    wire request is byte-identical to the pre-tracing protocol, and a
+    TRACED request leaves the engine's step jaxpr cache untouched (the
+    context never reaches jit)."""
+    from pytorch_distributed_rnn_tpu.serving.protocol import (
+        build_generate_request,
+        encode_line,
+    )
+    from pytorch_distributed_rnn_tpu.obs.tracectx import TraceContext
+
+    # wire pin: trace=None adds NO key - the exact pre-tracing bytes
+    req = build_generate_request([1, 2], request_id="w",
+                                 max_new_tokens=2)
+    assert set(req) == {"op", "id", "max_new_tokens", "temperature",
+                        "stream", "prompt"}
+    untraced_bytes = encode_line(req)
+    traced = build_generate_request([1, 2], request_id="w",
+                                    max_new_tokens=2,
+                                    trace=TraceContext.mint())
+    assert set(traced) - set(req) == {"trace"}
+
+    _, params = trained_checkpoint
+    metrics = tmp_path / "zero.jsonl"
+    with make_server(params, metrics_path=metrics) as server:
+        engine = server.engine
+        caches = lambda: (engine._prefill._cache_size(),
+                          engine._join._cache_size(),
+                          engine._step._cache_size())
+        warm = caches()
+        before = TraceContext.minted
+        with ServingClient(server.host, server.port) as client:
+            reply = client.generate(prompt=[5, 6], max_new_tokens=3)
+            assert reply["event"] == "done"
+            # no context allocated server-side for an untraced request
+            assert TraceContext.minted == before
+            assert caches() == warm
+            # a traced request reuses the SAME compiled programs
+            reply = client.generate(prompt=[5, 6], max_new_tokens=3,
+                                    trace=TraceContext.mint())
+            assert reply["event"] == "done"
+            assert caches() == warm
+    # the untraced request's bytes were pinned above; double-check the
+    # constant stayed stable across the server round trip
+    assert encode_line(build_generate_request(
+        [1, 2], request_id="w", max_new_tokens=2)) == untraced_bytes

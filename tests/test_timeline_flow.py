@@ -1,0 +1,202 @@
+"""Chrome-trace flow events (obs/timeline.py): the async b/e export
+for request-trace spans, the s/f flow arrows stitching a request's hop
+across process rows, and the validator's matched-pair rules - positive
+and negative, on hand-built traces and on a synthetic router+replica
+sidecar family exported end to end."""
+
+import json
+
+import pytest
+
+from pytorch_distributed_rnn_tpu.obs.spans import SUBSYSTEM_TIDS
+from pytorch_distributed_rnn_tpu.obs.timeline import (
+    build_chrome_trace,
+    load_run,
+    validate_chrome_trace,
+)
+
+TRACE_TID = SUBSYSTEM_TIDS["trace"]
+
+
+def trace_lane(*events):
+    """A minimal valid trace whose pids 0 and 1 both own the request-
+    trace lane, plus the given events on it."""
+    meta = []
+    for pid in (0, 1):
+        meta.append({"ph": "M", "name": "process_name", "pid": pid,
+                     "tid": 0, "args": {"name": f"rank {pid}"}})
+        meta.append({"ph": "M", "name": "thread_name", "pid": pid,
+                     "tid": TRACE_TID, "args": {"name": "trace"}})
+    return {"traceEvents": meta + list(events)}
+
+
+def async_pair(pid, trace_id, name, ts, dur):
+    common = {"pid": pid, "tid": TRACE_TID, "name": name, "cat": "trace",
+              "id": trace_id}
+    return [
+        {"ph": "b", "ts": ts, "args": {}, **common},
+        {"ph": "e", "ts": ts + dur, "args": {}, **common},
+    ]
+
+
+def flow_pair(trace_id, src, dst):
+    common = {"name": trace_id, "cat": "trace",
+              "id": f"{trace_id}/{dst[0]}"}
+    return [
+        {"ph": "s", "pid": src[0], "tid": TRACE_TID, "ts": src[1],
+         **common},
+        {"ph": "f", "bp": "e", "pid": dst[0], "tid": TRACE_TID,
+         "ts": dst[1], **common},
+    ]
+
+
+class TestValidatorFlowRules:
+    def test_matched_async_pairs_and_flow_pass(self):
+        trace = trace_lane(
+            *async_pair(0, "t1", "route", 0, 100),
+            *async_pair(0, "t1", "attempt", 10, 50),
+            *async_pair(1, "t1", "decode", 30, 40),
+            *flow_pair("t1", (0, 0), (1, 30)),
+        )
+        validate_chrome_trace(trace)
+
+    def test_overlapping_same_id_spans_are_legal_async(self):
+        # two concurrent attempts of one trace partially overlap - the
+        # very shape that motivates b/e instead of complete events
+        trace = trace_lane(
+            *async_pair(0, "t1", "attempt", 0, 60),
+            *async_pair(0, "t1", "attempt", 40, 60),
+        )
+        validate_chrome_trace(trace)
+
+    def test_b_missing_id_rejected(self):
+        bad = async_pair(0, "t1", "route", 0, 10)
+        del bad[0]["id"]
+        with pytest.raises(ValueError, match="missing 'id'"):
+            validate_chrome_trace(trace_lane(*bad))
+
+    def test_e_without_b_rejected(self):
+        lone_e = async_pair(0, "t1", "route", 0, 10)[1]
+        with pytest.raises(ValueError, match="e without an open b"):
+            validate_chrome_trace(trace_lane(lone_e))
+
+    def test_unclosed_b_rejected(self):
+        lone_b = async_pair(0, "t1", "route", 0, 10)[0]
+        with pytest.raises(ValueError, match="unbalanced async"):
+            validate_chrome_trace(trace_lane(lone_b))
+
+    def test_e_name_never_begun_rejected(self):
+        b, e = async_pair(0, "t1", "route", 0, 10)
+        e["name"] = "decode"  # an e for a name this id never began
+        b2, e2 = async_pair(0, "t1", "decode", 0, 5)
+        with pytest.raises(ValueError, match="never begun"):
+            validate_chrome_trace(trace_lane(b, b2, e, e, e2))
+
+    def test_dangling_s_rejected(self):
+        s = flow_pair("t1", (0, 0), (1, 5))[0]
+        with pytest.raises(ValueError, match="dangling"):
+            validate_chrome_trace(trace_lane(
+                *async_pair(0, "t1", "route", 0, 10), s))
+
+    def test_f_without_s_rejected(self):
+        f = flow_pair("t1", (0, 0), (1, 5))[1]
+        with pytest.raises(ValueError, match="f without s"):
+            validate_chrome_trace(trace_lane(
+                *async_pair(0, "t1", "route", 0, 10), f))
+
+    def test_finish_before_start_rejected(self):
+        s, f = flow_pair("t1", (0, 50), (1, 5))
+        with pytest.raises(ValueError, match="precedes"):
+            validate_chrome_trace(trace_lane(
+                *async_pair(0, "t1", "route", 0, 100), s, f))
+
+    def test_flow_name_mismatch_rejected(self):
+        s, f = flow_pair("t1", (0, 0), (1, 5))
+        f["name"] = "OTHER"
+        with pytest.raises(ValueError, match="start name"):
+            validate_chrome_trace(trace_lane(
+                *async_pair(0, "t1", "route", 0, 10), s, f))
+
+    def test_duplicate_flow_start_rejected(self):
+        s, f = flow_pair("t1", (0, 0), (1, 5))
+        with pytest.raises(ValueError, match="duplicate flow"):
+            validate_chrome_trace(trace_lane(
+                *async_pair(0, "t1", "route", 0, 10), s, s, f))
+
+
+def write_traced_sidecar(path, rank, role, spans, t_base=1000.0):
+    """Schema-2 sidecar whose spans are request-trace spans; span
+    tuples are ``(name, trace, span, parent, t_off_s, dur_s)``."""
+    lines = [{"kind": "meta", "t": t_base, "tm": 0.0, "rank": rank,
+              "schema": 2, "sample_every": 1, "role": role}]
+    for name, trace, span, parent, t_off, dur_s in spans:
+        event = {"kind": "span", "name": name, "cat": "trace",
+                 "rank": rank, "t": t_base + t_off, "tm": t_off,
+                 "dur_s": dur_s, "trace": trace, "span": span}
+        if parent is not None:
+            event["parent"] = parent
+        lines.append(event)
+    path.write_text("".join(json.dumps(e) + "\n" for e in lines))
+    return path
+
+
+class TestSidecarExport:
+    def test_router_replica_family_exports_flows_and_self_validates(
+            self, tmp_path):
+        base = tmp_path / "fleet.jsonl"
+        write_traced_sidecar(base, 0, "router", [
+            ("route", "t1", "r0", None, 0.0, 1.0),
+            ("attempt", "t1", "a1", "r0", 0.05, 0.9),
+        ])
+        write_traced_sidecar(tmp_path / "fleet-r1.jsonl", 1, "serve", [
+            ("queue_wait", "t1", "q1", "a1", 0.06, 0.1),
+            ("decode", "t1", "d1", "a1", 0.16, 0.7),
+        ])
+        trace = build_chrome_trace(load_run(base))
+        validate_chrome_trace(trace)
+        events = trace["traceEvents"]
+        # every trace span rode out as an async pair keyed by trace id
+        begins = [e for e in events if e.get("ph") == "b"]
+        ends = [e for e in events if e.get("ph") == "e"]
+        assert len(begins) == len(ends) == 4
+        assert {e["id"] for e in begins} == {"t1"}
+        assert all(e["tid"] == TRACE_TID for e in begins)
+        assert {e["name"] for e in begins} == {
+            "route", "attempt", "queue_wait", "decode"}
+        # exactly one flow arrow: router pid 0 -> replica pid 1
+        starts = [e for e in events if e.get("ph") == "s"]
+        finishes = [e for e in events if e.get("ph") == "f"]
+        assert len(starts) == len(finishes) == 1
+        assert starts[0]["pid"] == 0 and finishes[0]["pid"] == 1
+        assert starts[0]["id"] == finishes[0]["id"] == "t1/1"
+        assert starts[0]["name"] == "t1"
+        assert finishes[0]["bp"] == "e"
+        assert finishes[0]["ts"] >= starts[0]["ts"]
+
+    def test_single_process_trace_draws_no_arrow(self, tmp_path):
+        base = tmp_path / "solo.jsonl"
+        write_traced_sidecar(base, 0, "serve", [
+            ("queue_wait", "t2", "q1", None, 0.0, 0.1),
+            ("decode", "t2", "d1", "q1", 0.1, 0.5),
+        ])
+        trace = build_chrome_trace(load_run(base))
+        validate_chrome_trace(trace)
+        phases = {e.get("ph") for e in trace["traceEvents"]}
+        assert "b" in phases and "s" not in phases and "f" not in phases
+
+    def test_untraced_spans_still_export_as_complete_events(
+            self, tmp_path):
+        # a cat="trace" event WITHOUT a trace id is not a request span
+        base = tmp_path / "plain.jsonl"
+        lines = [
+            {"kind": "meta", "t": 1000.0, "tm": 0.0, "rank": 0,
+             "schema": 2, "sample_every": 1},
+            {"kind": "span", "name": "prefill", "cat": "serving",
+             "rank": 0, "t": 1000.5, "tm": 0.5, "dur_s": 0.2},
+        ]
+        base.write_text("".join(json.dumps(e) + "\n" for e in lines))
+        trace = build_chrome_trace(load_run(base))
+        validate_chrome_trace(trace)
+        assert any(e.get("ph") == "X" and e.get("name") == "prefill"
+                   for e in trace["traceEvents"])
+        assert not any(e.get("ph") == "b" for e in trace["traceEvents"])

@@ -1,0 +1,247 @@
+"""Trace assembly (`obs/trace.py`): multi-sidecar span events re-joined
+into causal trees, critical-path attribution summing to exactly 1, the
+orphan-root rules, the malformed matrix, and the ``pdrnn-metrics
+trace`` CLI contract (0 clean / 2 malformed).  Sidecars are hand-built
+JSONL in the recorder's schema-2 shape - no jax, no sockets."""
+
+import json
+
+import pytest
+
+from pytorch_distributed_rnn_tpu.obs.cli import main as metrics_main
+from pytorch_distributed_rnn_tpu.obs.trace import (
+    MalformedTraceError,
+    assemble_traces,
+    collect_trace_spans,
+    format_trace_tree,
+    validate_trace_tree,
+)
+
+T0 = 1_700_000_000.0
+
+
+def write_sidecar(path, rank, role, spans):
+    """One schema-2 sidecar: meta line + the given span events.  Span
+    tuples are ``(name, trace, span, parent, t_off_s, dur_s, attrs)``."""
+    lines = [{
+        "kind": "meta", "t": T0, "tm": 100.0, "rank": rank, "schema": 2,
+        "sample_every": 1, "meta": {"role": role}, "role": role,
+    }]
+    for name, trace, span, parent, t_off, dur_s, attrs in spans:
+        event = {
+            "kind": "span", "name": name, "cat": "trace", "rank": rank,
+            "t": T0 + t_off, "tm": 100.0 + t_off, "dur_s": dur_s,
+            "trace": trace, "span": span, **attrs,
+        }
+        if parent is not None:
+            event["parent"] = parent
+        lines.append(event)
+    path.write_text("".join(json.dumps(e) + "\n" for e in lines))
+    return path
+
+
+def fleet_sidecars(tmp_path, trace="t1"):
+    """The canonical cross-process shape: a router's route span with
+    two dispatch attempts (a retry), the second attempt's replica
+    recording queue_wait + decode as its children."""
+    router = write_sidecar(tmp_path / "router.jsonl", 0, "router", [
+        ("route", trace, "r0", "edge", 0.0, 1.0, {"request": "42",
+                                                  "qos": "high"}),
+        ("attempt", trace, "a1", "r0", 0.0, 0.3,
+         {"replica": 1, "attempt": 1, "outcome": "error"}),
+        ("attempt", trace, "a2", "r0", 0.35, 0.6,
+         {"replica": 2, "attempt": 2, "outcome": "done"}),
+    ])
+    replica = write_sidecar(tmp_path / "replica.jsonl", 2, "serve", [
+        ("queue_wait", trace, "q1", "a2", 0.36, 0.1, {"request": "42"}),
+        ("decode", trace, "d1", "a2", 0.46, 0.45,
+         {"request": "42", "tokens": 8, "status": "done"}),
+    ])
+    return router, replica
+
+
+class TestAssembly:
+    def test_cross_process_tree_links_router_and_replica(self, tmp_path):
+        router, replica = fleet_sidecars(tmp_path)
+        trees = assemble_traces([router, replica])
+        assert len(trees) == 1
+        tree = trees[0]
+        assert tree.trace_id == "t1"
+        assert tree.request == "42"
+        # the route span roots the tree (its parent - the load
+        # generator's edge span - was recorded nowhere)
+        assert tree.root.name == "route"
+        assert [c.name for c in tree.root.children] == [
+            "attempt", "attempt"]
+        retry = tree.root.children[1]
+        assert retry.attrs["attempt"] == 2
+        assert {c.name for c in retry.children} == {
+            "queue_wait", "decode"}
+        # both processes contributed
+        assert len(tree.processes) == 2
+        validate_trace_tree(tree)
+
+    def test_critical_path_fractions_sum_to_exactly_one(self, tmp_path):
+        trees = assemble_traces(list(fleet_sidecars(tmp_path)))
+        fractions = trees[0].critical_path()
+        assert sum(fractions.values()) == 1.0
+        # every emitted span name with self time shows up
+        assert set(fractions) == {
+            "route", "attempt", "queue_wait", "decode"}
+        assert all(f > 0 for f in fractions.values())
+
+    def test_rank_family_expansion_pulls_replica_siblings(self, tmp_path):
+        """Passing only the rank-0 stem finds the -r<k> replicas (the
+        CI fleet job's shared --metrics family)."""
+        base = tmp_path / "fleet.jsonl"
+        write_sidecar(base, 0, "router", [
+            ("route", "t1", "r0", None, 0.0, 1.0, {"request": "7"}),
+        ])
+        write_sidecar(tmp_path / "fleet-r1.jsonl", 1, "serve", [
+            ("queue_wait", "t1", "q1", "r0", 0.1, 0.2, {}),
+        ])
+        trees = assemble_traces([base])
+        assert len(trees[0].processes) == 2
+
+    def test_sibling_orphans_synthesize_the_unrecorded_edge(
+            self, tmp_path):
+        """The direct-server shape: every engine phase parents to the
+        client's root span, which no sidecar recorded - one synthetic
+        root holds them instead of a malformed-fragments error."""
+        replica = write_sidecar(tmp_path / "solo.jsonl", 0, "serve", [
+            ("queue_wait", "t9", "q1", "edge", 0.0, 0.1,
+             {"request": "5"}),
+            ("decode", "t9", "d1", "edge", 0.1, 0.5, {"request": "5"}),
+        ])
+        tree = assemble_traces([replica])[0]
+        assert tree.root.name == "request"
+        assert tree.root.attrs.get("synthesized") is True
+        assert [c.name for c in tree.root.children] == [
+            "queue_wait", "decode"]
+        validate_trace_tree(tree)
+
+    def test_slowest_ordering_and_request_filter(self, tmp_path):
+        side = write_sidecar(tmp_path / "m.jsonl", 0, "router", [
+            ("route", "aa11", "s1", None, 0.0, 0.2, {"request": "1"}),
+            ("route", "bb22", "s2", None, 0.0, 0.9, {"request": "2"}),
+        ])
+        trees = assemble_traces([side])
+        assert [t.trace_id for t in trees] == ["bb22", "aa11"]
+        # by request id
+        assert [t.trace_id for t in assemble_traces(
+            [side], request="1")] == ["aa11"]
+        # by trace-id prefix
+        assert [t.trace_id for t in assemble_traces(
+            [side], request="bb")] == ["bb22"]
+        assert assemble_traces([side], request="zz") == []
+
+    def test_format_names_processes_and_critical_path(self, tmp_path):
+        tree = assemble_traces(list(fleet_sidecars(tmp_path)))[0]
+        text = format_trace_tree(tree)
+        assert "trace t1" in text and "request=42" in text
+        assert "route" in text and "queue_wait" in text
+        assert "router:r0" in text and "serve:r2" in text
+        assert "critical path:" in text
+        assert "attempt=2" in text
+
+
+class TestMalformed:
+    def test_duplicate_span_id(self, tmp_path):
+        side = write_sidecar(tmp_path / "dup.jsonl", 0, "router", [
+            ("route", "t1", "s1", None, 0.0, 1.0, {}),
+            ("attempt", "t1", "s1", None, 0.0, 0.5, {}),
+        ])
+        with pytest.raises(MalformedTraceError, match="duplicate span"):
+            assemble_traces([side])
+
+    def test_disconnected_fragments(self, tmp_path):
+        side = write_sidecar(tmp_path / "frag.jsonl", 0, "router", [
+            ("route", "t1", "s1", "p1", 0.0, 1.0, {}),
+            ("route", "t1", "s2", "p2", 0.0, 1.0, {}),
+        ])
+        with pytest.raises(MalformedTraceError,
+                           match="disconnected roots"):
+            assemble_traces([side])
+
+    def test_cycle_has_no_root(self, tmp_path):
+        side = write_sidecar(tmp_path / "cycle.jsonl", 0, "router", [
+            ("a", "t1", "s1", "s2", 0.0, 1.0, {}),
+            ("b", "t1", "s2", "s1", 0.0, 1.0, {}),
+        ])
+        with pytest.raises(MalformedTraceError, match="no root"):
+            assemble_traces([side])
+
+    def test_containment_violation_past_skew(self, tmp_path):
+        side = write_sidecar(tmp_path / "leak.jsonl", 0, "router", [
+            ("route", "t1", "s1", None, 0.0, 0.1, {}),
+            # the child ends 5s past its 0.1s parent - far over skew
+            ("attempt", "t1", "s2", "s1", 0.0, 5.0, {}),
+        ])
+        with pytest.raises(MalformedTraceError, match="outside its"):
+            assemble_traces([side])
+
+    def test_trace_without_span_field(self, tmp_path):
+        path = tmp_path / "nospan.jsonl"
+        meta = {"kind": "meta", "t": T0, "tm": 1.0, "rank": 0,
+                "schema": 2, "sample_every": 1, "role": "router"}
+        bad = {"kind": "span", "name": "route", "cat": "trace",
+               "t": T0, "tm": 1.0, "dur_s": 0.1, "trace": "t1"}
+        path.write_text(json.dumps(meta) + "\n" + json.dumps(bad) + "\n")
+        with pytest.raises(MalformedTraceError, match="without"):
+            collect_trace_spans([path])
+
+    def test_build_rejects_foreign_trace_id(self):
+        # validate_trace_tree's cross-check: a node smuggled in from
+        # another trace id fails even when the links resolve
+        from pytorch_distributed_rnn_tpu.obs.trace import (
+            TraceNode,
+            TraceTree,
+        )
+
+        root = TraceNode(
+            {"name": "route", "trace": "t1", "span": "s1", "t": T0,
+             "dur_s": 1.0},
+            rank=0, role="router", source="x")
+        alien = TraceNode(
+            {"name": "decode", "trace": "OTHER", "span": "s2",
+             "parent": "s1", "t": T0, "dur_s": 0.5},
+            rank=0, role="serve", source="x")
+        root.children.append(alien)
+        with pytest.raises(MalformedTraceError, match="belongs"):
+            validate_trace_tree(TraceTree("t1", root))
+
+
+class TestCli:
+    def test_trace_subcommand_prints_trees(self, tmp_path, capsys):
+        router, replica = fleet_sidecars(tmp_path)
+        assert metrics_main(
+            ["trace", str(router), str(replica), "--slowest", "1"]) == 0
+        out = capsys.readouterr().out
+        assert "trace t1" in out and "critical path:" in out
+
+    def test_trace_subcommand_request_filter_and_json(
+            self, tmp_path, capsys):
+        router, replica = fleet_sidecars(tmp_path)
+        assert metrics_main(
+            ["trace", str(router), str(replica),
+             "--request", "42", "--json"]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert len(payload) == 1
+        assert payload[0]["request"] == "42"
+        assert sum(payload[0]["critical_path"].values()) == 1.0
+
+    def test_trace_subcommand_no_traces_is_clean(self, tmp_path, capsys):
+        side = write_sidecar(tmp_path / "empty.jsonl", 0, "serve", [])
+        assert metrics_main(["trace", str(side)]) == 0
+        assert "no request trace" in capsys.readouterr().out
+
+    def test_trace_subcommand_malformed_is_exit_2(self, tmp_path):
+        side = write_sidecar(tmp_path / "dup.jsonl", 0, "router", [
+            ("route", "t1", "s1", None, 0.0, 1.0, {}),
+            ("attempt", "t1", "s1", None, 0.0, 0.5, {}),
+        ])
+        assert metrics_main(["trace", str(side)]) == 2
+
+    def test_unreadable_file_is_exit_2(self, tmp_path):
+        assert metrics_main(
+            ["trace", str(tmp_path / "missing.jsonl")]) == 2

@@ -1,0 +1,105 @@
+"""TraceContext unit contract: minting, forking, wire round trips,
+hostile wire input, deterministic head sampling, and the minted-counter
+hook the zero-overhead pins read.  Pure python - no jax, no sockets."""
+
+import json
+
+from pytorch_distributed_rnn_tpu.obs.tracectx import (
+    TraceContext,
+    should_sample,
+)
+
+
+class TestMintAndChild:
+    def test_mint_is_a_root_with_distinct_ids(self):
+        a = TraceContext.mint()
+        b = TraceContext.mint()
+        assert a.trace_id != b.trace_id
+        assert a.parent_id is None
+        assert len(a.trace_id) == 16 and len(a.span_id) == 8
+
+    def test_mint_drops_none_baggage(self):
+        ctx = TraceContext.mint(qos="high", deadline=None)
+        assert ctx.baggage == {"qos": "high"}
+
+    def test_child_keeps_trace_forks_span_inherits_baggage(self):
+        root = TraceContext.mint(qos="low")
+        child = root.child()
+        assert child.trace_id == root.trace_id
+        assert child.span_id != root.span_id
+        assert child.parent_id == root.span_id
+        assert child.baggage == {"qos": "low"}
+        # grandchild chains causality one more hop
+        grand = child.child()
+        assert grand.parent_id == child.span_id
+        assert grand.trace_id == root.trace_id
+
+    def test_minted_counter_moves_once_per_construction(self):
+        before = TraceContext.minted
+        ctx = TraceContext.mint()
+        ctx.child()
+        assert TraceContext.minted == before + 2
+
+
+class TestWire:
+    def test_round_trip_preserves_identity_and_baggage(self):
+        root = TraceContext.mint(qos="high")
+        child = root.child()
+        wire = json.loads(json.dumps(child.to_wire()))  # a real hop
+        back = TraceContext.from_wire(wire)
+        assert back is not None
+        assert back.trace_id == child.trace_id
+        assert back.span_id == child.span_id
+        assert back.parent_id == child.parent_id
+        assert back.baggage == {"qos": "high"}
+
+    def test_root_wire_has_no_parent_key(self):
+        wire = TraceContext.mint().to_wire()
+        assert "parent" not in wire
+        assert set(wire) == {"id", "span"}
+
+    def test_malformed_wire_is_none_never_a_raise(self):
+        for hostile in (
+            None,
+            "abc",
+            17,
+            [],
+            {},
+            {"id": "t"},  # no span
+            {"span": "s"},  # no trace id
+            {"id": "", "span": "s"},  # empty trace id
+            {"id": "t", "span": ""},  # empty span id
+            {"id": 7, "span": "s"},  # non-string ids
+            {"id": "t", "span": "s", "parent": 9},  # non-string parent
+        ):
+            assert TraceContext.from_wire(hostile) is None, hostile
+
+    def test_non_json_scalar_baggage_is_filtered(self):
+        back = TraceContext.from_wire({
+            "id": "t", "span": "s", "qos": "high",
+            "evil": {"nested": 1}, "list": [1, 2],
+        })
+        assert back is not None
+        assert back.baggage == {"qos": "high"}
+
+
+class TestShouldSample:
+    def test_rate_bounds(self):
+        assert not any(should_sample(i, 0.0) for i in range(1, 50))
+        assert all(should_sample(i, 1.0) for i in range(1, 50))
+        assert not any(should_sample(i, -1.0) for i in range(1, 50))
+        assert all(should_sample(i, 2.0) for i in range(1, 50))
+
+    def test_fractional_rate_is_evenly_spaced_and_exact(self):
+        picks = [i for i in range(1, 101) if should_sample(i, 0.25)]
+        assert len(picks) == 25
+        # evenly spread: one pick per window of 4
+        gaps = [b - a for a, b in zip(picks, picks[1:])]
+        assert set(gaps) == {4}
+
+    def test_deterministic_no_rng(self):
+        a = [should_sample(i, 0.1) for i in range(1, 200)]
+        b = [should_sample(i, 0.1) for i in range(1, 200)]
+        assert a == b
+        # of the first n seqs, floor(n * rate) are sampled
+        assert sum(a) == 19
