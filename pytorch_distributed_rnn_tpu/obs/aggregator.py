@@ -21,7 +21,14 @@ a tiny stdlib HTTP server:
 - ``GET /events`` - recent alerts (watchdog + fleet), newest last.
 - ``GET /fleet`` - the raw digest table (what ``pdrnn-metrics watch``
   renders).
-- ``POST /push`` - digest ingestion.
+- ``GET /series?name=...&window=...`` - downsampled history from the
+  bound time-series store (``obs/store.py``; 404 when none is bound).
+  Optional ``agg`` (gauge ``min|mean|max|last``, counter
+  ``rate|increase``, histogram ``p50|p95|p99|count``) and any other
+  query key as a label filter.  Without ``name``: the series catalog.
+- ``POST /push`` - digest ingestion.  When a store is bound, every
+  ingested digest also feeds it (on this handler thread / the anchor's
+  writer thread - the store never runs a thread of its own).
 
 Prometheus metric names (documented next to the sidecar event schema in
 ``obs/recorder.py``; labels ``rank``/``role`` on all per-source series):
@@ -64,7 +71,28 @@ pdrnn_router_errors_total                       counter      router
 pdrnn_router_request_rate_per_s                 gauge        window
 pdrnn_router_latency_seconds{quantile=...}      gauge        window
 pdrnn_request_latency_seconds{le=...}           histogram    histogram
+pdrnn_slot_utilization{source=...}              gauge        store
+pdrnn_queue_growth_per_s{source=...}            gauge        store
+pdrnn_goodput_headroom{source=...}              gauge        store
+pdrnn_replicas_live                             gauge        store
+pdrnn_recommended_replicas                      gauge        store
+pdrnn_slo_burn_rate{qos=...,window=...}         gauge        store
 =============================================== ============ ==========
+
+The ``store``-sourced series (capacity + SLO burn; present only when a
+time-series store is bound, i.e. on the live-plane anchor) are derived
+history, not digest pass-throughs: ``pdrnn_slot_utilization`` is
+``active / num_slots`` per serving source; ``pdrnn_queue_growth_per_s``
+is the gap-safe queue-depth slope (never computed across a paused
+digest stream); ``pdrnn_goodput_headroom`` estimates spare tokens/s
+from the peak observed rate times the free slot fraction;
+``pdrnn_replicas_live`` / ``pdrnn_recommended_replicas`` are the fleet
+liveness count and the advisory scale target (demand over per-replica
+capacity at the target utilization); ``pdrnn_slo_burn_rate`` is the
+error-budget burn per ``--slo`` objective and burn window (label
+``window`` in seconds, e.g. ``"300"``/``"3600"``).  The same numbers
+are queryable with history via ``GET /series`` and rendered by
+``pdrnn-metrics top``.
 
 ``pdrnn_request_latency_seconds`` is the request-latency histogram
 (``obs/live.LatencyHistogram``): the serving engine and the router each
@@ -229,7 +257,8 @@ class Aggregator:
     def __init__(self, *, stale_after_s: float = _DEFAULT_STALE_AFTER_S,
                  stall_after_s: float = 10.0,
                  straggler_frac: float = _STRAGGLER_FRAC,
-                 recorder=None, events_maxlen: int = _EVENTS_MAXLEN):
+                 recorder=None, events_maxlen: int = _EVENTS_MAXLEN,
+                 store=None):
         self.stale_after_s = float(stale_after_s)
         self.stall_after_s = float(stall_after_s)
         self.straggler_frac = float(straggler_frac)
@@ -237,6 +266,10 @@ class Aggregator:
         # are recorded as ``alert`` events into ITS sidecar, marked
         # fleet=True so the local exporter does not echo them back
         self.recorder = recorder
+        # optional time-series store (obs/store.py): fed from ingest on
+        # the pushing thread, queried by /series and /metrics; None
+        # keeps the aggregator history-free (the pre-store behavior)
+        self.store = store
         self._lock = threadcheck.lock(threading.Lock(), "aggregator.fleet")  # guards: _peers, _events, _seen_alert_seq, _peer_pids, _straggling, _fleet_seq
         self._peers: dict[str, dict] = {}  # id -> {digest, received_tm}
         self._events: deque[dict] = deque(maxlen=int(events_maxlen))
@@ -266,6 +299,14 @@ class Aggregator:
             self._peers[source] = {"digest": digest, "received_tm": now}
             for alert in digest.get("alerts") or []:
                 self._note_alert_locked(alert, source)
+        # feed the store OUTSIDE the fleet lock (lock order: the two are
+        # never held together) with the aggregator's OWN receive stamp -
+        # digest-carried tm is another process's perf_counter epoch
+        if self.store is not None:
+            try:
+                self.store.ingest(digest, now)
+            except Exception:  # pragma: no cover - history must not
+                log.exception("store: ingest failed")  # kill ingestion
         self._check_stragglers(now)
 
     def note_alert(self, alert: dict, source: str = "fleet") -> None:
@@ -552,7 +593,22 @@ class Aggregator:
                     {**labels, "quantile": q}, router.get(key))
             add("pdrnn_request_latency_seconds", labels,
                 router.get("latency_hist"), "histogram")
+        if self.store is not None:
+            # capacity + burn gauges (store-derived; see the registry
+            # table above) join the exposition under the same render
+            samples.extend(self.store.prometheus_samples(now))
         return render_prometheus(samples)
+
+    def series(self, name: str | None = None,
+               labels: dict | None = None, *, window: float = 60.0,
+               agg: str | None = None) -> dict | list | None:
+        """``GET /series`` body: the store's downsampled history for
+        ``name`` (catalog when None); None when no store is bound."""
+        if self.store is None:
+            return None
+        if not name:
+            return self.store.series_names()
+        return self.store.query(name, labels, window=window, agg=agg)
 
 
 class AggregatorServer:
@@ -611,6 +667,27 @@ def _make_handler(aggregator: Aggregator):
             body = json.dumps(payload, default=str).encode()
             self._reply(code, body, "application/json")
 
+        def _series(self):
+            if aggregator.store is None:
+                self._reply_json(
+                    {"error": "no time-series store bound "
+                              "(not the live-plane anchor?)"}, 404)
+                return
+            from urllib.parse import parse_qsl, urlsplit
+
+            params = dict(parse_qsl(urlsplit(self.path).query))
+            name = params.pop("name", None)
+            try:
+                window = float(params.pop("window", 60.0))
+                agg = params.pop("agg", None) or None
+                # every remaining query key is a label filter
+                body = aggregator.series(
+                    name, params or None, window=window, agg=agg)
+            except ValueError as exc:
+                self._reply_json({"error": str(exc)}, 400)
+                return
+            self._reply_json(body)
+
         def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
             path = self.path.split("?", 1)[0].rstrip("/") or "/"
             try:
@@ -625,6 +702,8 @@ def _make_handler(aggregator: Aggregator):
                     self._reply_json(aggregator.events())
                 elif path == "/fleet":
                     self._reply_json(aggregator.fleet())
+                elif path == "/series":
+                    self._series()
                 else:
                     self._reply_json({"error": f"unknown path {path}"}, 404)
             except BrokenPipeError:  # scraper went away mid-reply

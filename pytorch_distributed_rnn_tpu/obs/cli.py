@@ -22,6 +22,7 @@ Examples::
   pdrnn-metrics attribute metrics.jsonl    # phase fractions + blame
   pdrnn-metrics health metrics.jsonl --stale-after 30
   pdrnn-metrics watch 127.0.0.1:9100       # live fleet table (aggregator)
+  pdrnn-metrics top 127.0.0.1:9100         # + sparklines, burn, capacity
   pdrnn-metrics ledger metrics.jsonl --history ledger_history.jsonl
   pdrnn-metrics regress ledger_history.jsonl --threshold 0.2
 """
@@ -243,6 +244,29 @@ def main(argv=None) -> int:
                    "table (implies --once)")
 
     p = sub.add_parser(
+        "top",
+        help="live fleet view over an aggregator that hosts the "
+        "time-series store (the --live anchor): one row per source "
+        "with load gauges and 60s sparklines, the store's capacity "
+        "signals (live vs recommended replicas), and the active SLO "
+        "error-budget burn alerts (a slo_burn with no later "
+        "slo_burn_cleared for that qos)",
+    )
+    p.add_argument("target", help="aggregator address (HOST:PORT or "
+                   "http://HOST:PORT)")
+    p.add_argument("--interval", type=float, default=2.0, metavar="S",
+                   help="poll cadence in seconds (default 2)")
+    p.add_argument("--window", type=float, default=60.0, metavar="S",
+                   help="sparkline window in seconds (default 60)")
+    p.add_argument("--once", action="store_true",
+                   help="print one snapshot and exit (0 healthy, 1 if "
+                   "any source is stalled/dead or a burn alert is "
+                   "active)")
+    p.add_argument("--json", action="store_true",
+                   help="print the snapshot as JSON instead of the "
+                   "table (implies --once)")
+
+    p = sub.add_parser(
         "ledger",
         help="efficiency ledger: classify the run's wall-clock into "
         "phase fractions (summing to 1), goodput, MFU/HFU vs the "
@@ -323,6 +347,8 @@ def _dispatch(args) -> int:
         return _health(args)
     if args.cmd == "watch":
         return _watch(args)
+    if args.cmd == "top":
+        return _top(args)
     if args.cmd == "ledger":
         return _ledger(args)
     if args.cmd == "regress":
@@ -550,6 +576,205 @@ def _watch(args) -> int:
                 f"{event.get('alert', '?')} "
                 f"[{event.get('severity', '?')}] seq={event.get('seq')}"
             )
+        if args.once:
+            return 1 if flagged else 0
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:  # pragma: no cover - interactive
+            return 0
+
+
+_SPARK_GLYPHS = "▁▂▃▄▅▆▇█"
+
+
+def _spark(values, width: int = 24) -> str:
+    """Resample ``values`` into ``width`` buckets and render a unicode
+    sparkline scaled to the window's own max (flat-zero stays flat)."""
+    values = [v for v in values if v is not None]
+    if not values:
+        return "-"
+    if len(values) > width:
+        # bucket-mean resample so a 60s window fits the column
+        step = len(values) / width
+        values = [
+            sum(chunk) / len(chunk)
+            for chunk in (
+                values[int(i * step):max(int(i * step) + 1,
+                                         int((i + 1) * step))]
+                for i in range(width)
+            )
+        ]
+    top = max(values)
+    if top <= 0:
+        return _SPARK_GLYPHS[0] * len(values)
+    return "".join(
+        _SPARK_GLYPHS[min(len(_SPARK_GLYPHS) - 1,
+                          int(max(0.0, v) / top * (len(_SPARK_GLYPHS) - 1)))]
+        for v in values
+    )
+
+
+def _series_values(points, kind: str) -> list:
+    """Plottable value per point: gauges use value/mean, counters use
+    the per-bucket rate (raw cumulative points are differenced)."""
+    if kind == "counter":
+        vals, prev = [], None
+        for p in points:
+            if "rate" in p:
+                vals.append(p["rate"])
+                continue
+            v = p.get("value")
+            if prev is not None and v is not None:
+                vals.append(max(0.0, v - prev))
+            prev = v
+        return vals
+    return [
+        p.get("mean", p.get("value"))
+        for p in points
+        if p.get("mean", p.get("value")) is not None
+    ]
+
+
+def _top_series(base: str, name: str, window: float, agg=None):
+    """GET /series, or None when the anchor hosts no store (404 /
+    pre-store aggregator)."""
+    from urllib.parse import urlencode
+
+    query = {"name": name, "window": f"{window:g}"}
+    if agg:
+        query["agg"] = agg
+    try:
+        payload = _watch_fetch(base, "/series?" + urlencode(query))
+    except MalformedMetricsError:
+        return None
+    if not isinstance(payload, dict) or "series" not in payload:
+        return None
+    return payload
+
+
+def _active_burns(events) -> list[dict]:
+    """The slo_burn alerts with no later slo_burn_cleared for the same
+    (source, qos) - the fleet's currently-burning error budgets."""
+    active: dict = {}
+    for event in events:
+        kind = event.get("alert")
+        key = (event.get("source"), event.get("qos"))
+        if kind == "slo_burn":
+            active[key] = event
+        elif kind == "slo_burn_cleared":
+            active.pop(key, None)
+    return list(active.values())
+
+
+def _top_row(source_id: str, digest: dict, queue_spark: str,
+             rate_spark: str) -> str:
+    serving = digest.get("serving") or {}
+    router = digest.get("router") or {}
+    depth = digest.get("queue_depth") or {}
+
+    def num(value, fmt="{:.1f}"):
+        return fmt.format(value) if value is not None else "-"
+
+    active = serving.get("active")
+    if active is None:
+        active = router.get("inflight")
+    rate = serving.get("req_per_s_60s")
+    if rate is None:
+        rate = router.get("req_per_s_60s")
+    return (
+        f"{source_id:>14} {str(digest.get('status', '?')):>9} "
+        f"{num(active, '{:.0f}'):>6} "
+        f"{num(depth.get('last'), '{:.0f}'):>6} "
+        f"{num(rate):>7} "
+        f"{queue_spark:<24} {rate_spark:<24}"
+    )
+
+
+def _top(args) -> int:
+    base = args.target
+    if "://" not in base:
+        base = f"http://{base}"
+    base = base.rstrip("/")
+    window = args.window
+    header = (
+        f"{'source':>14} {'status':>9} {'active':>6} {'queue':>6} "
+        f"{'req/s':>7} {'queue ' + format(window, 'g') + 's':<24} "
+        f"{'req/s ' + format(window, 'g') + 's':<24}"
+    )
+    while True:
+        fleet = _watch_fetch(base, "/fleet")
+        events = _watch_fetch(base, "/events")
+        sources = fleet.get("sources") or {}
+        burns = _active_burns(events)
+        flagged = any(
+            d.get("status") in ("stalled", "dead")
+            for d in sources.values()
+        ) or bool(burns)
+
+        sparks: dict = {}  # name -> {source -> values}
+        fetched: dict = {}
+        for name in ("pdrnn_queue_depth",
+                     "pdrnn_serving_request_rate_per_s",
+                     "pdrnn_router_request_rate_per_s"):
+            resp = _top_series(base, name, window)
+            fetched[name] = resp
+            per_source: dict = {}
+            for s in (resp or {}).get("series") or []:
+                source = (s.get("labels") or {}).get("source")
+                if source is not None:
+                    per_source[source] = _series_values(
+                        s["points"], s.get("kind", "gauge"))
+            sparks[name] = per_source
+        capacity = {}
+        for name in ("pdrnn_replicas_live", "pdrnn_recommended_replicas"):
+            resp = _top_series(base, name, window, agg="last")
+            series = (resp or {}).get("series") or []
+            capacity[name] = series[0].get("value") if series else None
+
+        if args.json:
+            print(json.dumps({
+                "fleet": fleet, "events": events,
+                "capacity": capacity, "active_burns": burns,
+                "series": fetched,
+            }, indent=1))
+            return 1 if flagged else 0
+        live = capacity.get("pdrnn_replicas_live")
+        want = capacity.get("pdrnn_recommended_replicas")
+        cap_txt = ""
+        if live is not None or want is not None:
+            cap_txt = (
+                f"  replicas live "
+                f"{'-' if live is None else format(live, '.0f')}"
+                f" / recommended "
+                f"{'-' if want is None else format(want, '.0f')}"
+            )
+        print(f"== {base} @ {time.strftime('%H:%M:%S')} "
+              f"({len(sources)} source(s)){cap_txt}")
+        print(header)
+        rate_by_source = dict(
+            sparks["pdrnn_serving_request_rate_per_s"])
+        rate_by_source.update(sparks["pdrnn_router_request_rate_per_s"])
+        for source_id in sorted(sources):
+            line = _top_row(
+                source_id, sources[source_id],
+                _spark(sparks["pdrnn_queue_depth"].get(source_id, [])),
+                _spark(rate_by_source.get(source_id, [])),
+            )
+            if sources[source_id].get("status") in ("stalled", "dead"):
+                line = line.upper()
+            print(line)
+        for burn in burns:
+            fast = burn.get("burn_rate_fast")
+            slow = burn.get("burn_rate_slow")
+            print(
+                f"  BURN {burn.get('source', '?')} "
+                f"qos={burn.get('qos', '?')}: fast "
+                f"{'-' if fast is None else format(fast, '.1f')}x / slow "
+                f"{'-' if slow is None else format(slow, '.1f')}x budget "
+                f"({burn.get('objective', '?')})"
+            )
+        if not burns:
+            print("  no active burn alert")
         if args.once:
             return 1 if flagged else 0
         try:

@@ -173,12 +173,27 @@ class RollingWindow:
         }
 
 
-# Prometheus' conventional latency buckets; the +Inf bucket is implicit
-# (it equals ``count``).  The aggregator renders these as the
-# ``pdrnn_request_latency_seconds`` histogram series.
+# THE request-latency histogram spec, shared by every layer that
+# observes or interprets request latency: the serving engine and the
+# fleet router construct their histograms via
+# ``request_latency_histogram()`` below, and the time-series store
+# (``obs/store.py``) interpolates window quantiles and SLO burn
+# fractions over the SAME edges - cross-layer burn-rate math compares
+# like with like by construction.  Prometheus' conventional buckets;
+# the +Inf bucket is implicit (it equals ``count``).  The aggregator
+# renders these as the series named by ``REQUEST_LATENCY_SERIES``.
 LATENCY_BUCKETS_S = (
     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
+
+REQUEST_LATENCY_SERIES = "pdrnn_request_latency_seconds"
+
+
+def request_latency_histogram() -> "LatencyHistogram":
+    """The one constructor for the request-latency histogram behind
+    ``REQUEST_LATENCY_SERIES`` - engine and router both build theirs
+    here, so the bucket edges can never drift apart."""
+    return LatencyHistogram(LATENCY_BUCKETS_S)
 
 
 class LatencyHistogram:
@@ -687,11 +702,12 @@ class LivePlane:
     no threads)."""
 
     def __init__(self, exporter, aggregator=None, server=None,
-                 watchdog=None):
+                 watchdog=None, store=None):
         self.exporter = exporter
         self.aggregator = aggregator
         self.server = server
         self.watchdog = watchdog
+        self.store = store
 
     @classmethod
     def resolve(cls, args, recorder, *, rank: int = 0,
@@ -703,18 +719,46 @@ class LivePlane:
         host, port = parse_live_spec(spec)
         if serve_here is None:
             serve_here = rank == 0
-        aggregator = server = None
+        # --slo objectives parse once here (the one construction path):
+        # they arm the per-QoS watchdog SLO detector on EVERY live
+        # process, and the anchor's store burns budgets against them
+        from pytorch_distributed_rnn_tpu.obs.store import parse_slo_args
+
+        slo = parse_slo_args(getattr(args, "slo", None))
+        aggregator = server = store = None
         if serve_here:
             from pytorch_distributed_rnn_tpu.obs.aggregator import (
                 Aggregator,
                 AggregatorServer,
             )
+            from pytorch_distributed_rnn_tpu.obs.store import (
+                DEFAULT_BURN_WINDOWS_S,
+                TimeSeriesStore,
+                store_path_for,
+            )
             from pytorch_distributed_rnn_tpu.obs.watchdog import (
                 resolve_stall_after,
             )
 
+            # the anchor owns the history: the store rides the
+            # aggregator's ingest path (push handler threads / this
+            # process's writer-thread pushes - no thread of its own),
+            # snapshotting next to the sidecar for cold reads
+            windows = getattr(args, "slo_windows", None)
+            if windows:
+                fast_s, _, slow_s = str(windows).partition(",")
+                windows = (float(fast_s), float(slow_s))
+            store = TimeSeriesStore(
+                slo=slo,
+                burn_windows_s=windows or DEFAULT_BURN_WINDOWS_S,
+                snapshot_path=(
+                    store_path_for(recorder.path)
+                    if getattr(recorder, "path", None) else None
+                ),
+            )
             aggregator = Aggregator(
-                stall_after_s=resolve_stall_after(), recorder=recorder
+                stall_after_s=resolve_stall_after(), recorder=recorder,
+                store=store,
             )
             server = AggregatorServer(aggregator, host=host, port=port)
             port_file = (
@@ -738,7 +782,7 @@ class LivePlane:
         )
 
         watchdog = AnomalyWatchdog.resolve(
-            recorder, exporter, faults=faults
+            recorder, exporter, faults=faults, slo=slo, store=store
         )
         if watchdog is not None:
             watchdog.start()
@@ -747,7 +791,7 @@ class LivePlane:
             + (f"serving http://{server.host}:{server.port}" if server
                else f"pushing to {sink}")
         )
-        return cls(exporter, aggregator, server, watchdog)
+        return cls(exporter, aggregator, server, watchdog, store)
 
     def close(self) -> None:
         """Stop the watchdog and the HTTP server; idempotent.  Call
@@ -757,3 +801,10 @@ class LivePlane:
             self.watchdog.close()
         if self.server is not None:
             self.server.close()
+        if self.store is not None:
+            # final snapshot regardless of the periodic throttle: a run
+            # shorter than the cadence still leaves its history on disk
+            try:
+                self.store.write_snapshot()
+            except OSError as exc:  # pragma: no cover - disk trouble
+                log.warning(f"store snapshot on close failed: {exc}")

@@ -90,10 +90,17 @@ replay              stage, link, count, from_seq, to_seq - a surviving
                     restarted neighbor during the watermark handshake
                     (runtime/stage.py)
 alert               alert (stall | stall_cleared | nan_streak |
-                    loss_spike | slo_breach | slo_recovered | straggler
-                    | worker_respawn | worker_lost | pool_collapse),
+                    loss_spike | slo_breach | slo_recovered | slo_burn
+                    | slo_burn_cleared | straggler | worker_respawn |
+                    worker_lost | pool_collapse),
                     severity (warning|info), seq (per-emitter monotone)
-                    + detector fields; chaos_fired carries the fault
+                    + detector fields; slo_breach/slo_recovered carry
+                    the breaching ``qos`` class (absent = the
+                    deprecated class-blind env threshold) and
+                    slo_burn/slo_burn_cleared carry qos,
+                    burn_rate_fast/_slow, objective and windows_s (the
+                    store's multi-window error-budget burn,
+                    obs/store.py); chaos_fired carries the fault
                     schedule's fired counters when chaos is active and
                     fleet=True marks aggregator-born findings
                     (obs/watchdog.py + obs/aggregator.py; the live

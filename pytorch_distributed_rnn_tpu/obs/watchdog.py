@@ -14,8 +14,18 @@ exporter's rolling windows and the recorder's progress note for
 - **NaN streaks** - ``nan_streak`` consecutive non-finite losses;
 - **loss spikes** - the newest loss above ``loss_spike_factor`` x the
   rolling window median;
-- **serving SLO breaches** - the engine's windowed p95 latency above
-  ``PDRNN_WATCHDOG_SLO_P95_MS``;
+- **serving SLO breaches** - windowed p95 latency above a per-QoS
+  ``--slo`` objective (``qos=high:p95_ms=250:availability=99.9``; the
+  router's per-class p95 when available, the block p95 otherwise).
+  The legacy global ``PDRNN_WATCHDOG_SLO_P95_MS`` env is DEPRECATED -
+  still honored as a default objective with a loud warning when no
+  ``--slo`` is configured;
+- **SLO budget burn** - when the anchor's time-series store
+  (``obs/store.py``) is bound, multi-window error-budget burn rates per
+  objective: episodic ``slo_burn`` alerts fire when BOTH the fast and
+  slow windows burn strictly above 1.0 and ``slo_burn_cleared`` marks
+  the fast window's recovery - the Google SRE fast-catch/slow-confirm
+  pattern riding the same structured-alert path;
 - **goodput collapse** - the exporter's windowed goodput estimate
   (``goodput_60s``: fraction of the last minute inside step compute,
   the live half of ``obs/ledger.py``) falls below the
@@ -58,7 +68,10 @@ log = logging.getLogger(__name__)
 
 WATCHDOG_ENV = "PDRNN_WATCHDOG"  # "0" disables the watchdog outright
 WATCHDOG_STALL_ENV = "PDRNN_WATCHDOG_STALL"  # seconds (default 10)
-WATCHDOG_SLO_ENV = "PDRNN_WATCHDOG_SLO_P95_MS"  # serving SLO (ms)
+# DEPRECATED: the global serving SLO (ms).  Use per-QoS --slo
+# objectives instead; the env is still honored as a default objective
+# (with a loud warning) when no --slo is configured.
+WATCHDOG_SLO_ENV = "PDRNN_WATCHDOG_SLO_P95_MS"
 WATCHDOG_GOODPUT_ENV = "PDRNN_WATCHDOG_GOODPUT"  # goodput floor (0..1)
 
 _DEFAULT_STALL_AFTER_S = 10.0
@@ -149,8 +162,8 @@ class AnomalyWatchdog:
                  check_every_s: float | None = None,
                  nan_streak: int = _DEFAULT_NAN_STREAK,
                  loss_spike_factor: float = _DEFAULT_SPIKE_FACTOR,
-                 slo_p95_s: float | None = None,
-                 goodput_floor: float | None = None,
+                 slo=(), slo_p95_s: float | None = None,
+                 store=None, goodput_floor: float | None = None,
                  dump_dir_hint=None):
         self.recorder = recorder
         self.exporter = exporter
@@ -162,7 +175,13 @@ class AnomalyWatchdog:
         )
         self.nan_streak = int(nan_streak)
         self.loss_spike_factor = float(loss_spike_factor)
+        # per-QoS --slo objectives; the deprecated global slo_p95_s
+        # (env) stays a single class-blind default when no --slo is set
+        self.slo = tuple(slo)
         self.slo_p95_s = slo_p95_s
+        # the anchor's time-series store (None elsewhere): arms the
+        # budget-burn detector
+        self.store = store
         self.goodput_floor = goodput_floor
         self.stacks_path = stacks_path_for(
             dump_dir_hint or recorder.path or "pdrnn-metrics.jsonl"
@@ -174,23 +193,41 @@ class AnomalyWatchdog:
         self._in_stall = False
         self._in_nan = False
         self._in_spike = False
-        self._in_slo = False
+        self._in_slo: dict[str, bool] = {}  # per-QoS breach episodes
+        self._in_burn: dict[str, bool] = {}  # per-QoS burn episodes
         self._in_goodput = False
 
     @classmethod
-    def resolve(cls, recorder, exporter, *, faults=None,
-                env=None) -> "AnomalyWatchdog | None":
+    def resolve(cls, recorder, exporter, *, faults=None, slo=(),
+                store=None, env=None) -> "AnomalyWatchdog | None":
         """Env-tuned construction (``PDRNN_WATCHDOG=0`` disables;
-        ``PDRNN_WATCHDOG_STALL`` seconds; ``PDRNN_WATCHDOG_SLO_P95_MS``
-        arms the serving SLO detector; ``PDRNN_WATCHDOG_GOODPUT`` arms
-        the goodput-collapse detector with a 0..1 floor)."""
+        ``PDRNN_WATCHDOG_STALL`` seconds; ``PDRNN_WATCHDOG_GOODPUT``
+        arms the goodput-collapse detector with a 0..1 floor).  ``slo``
+        objectives (the ``--slo`` flag) arm the per-QoS SLO detector
+        and - with a ``store`` bound - the budget-burn detector; the
+        DEPRECATED ``PDRNN_WATCHDOG_SLO_P95_MS`` env still arms a
+        global default when no objectives are configured."""
         env = env or os.environ
         if env.get(WATCHDOG_ENV, "1") in ("0", "off", "false"):
             return None
         slo_ms = env.get(WATCHDOG_SLO_ENV)
+        if slo_ms and not slo:
+            log.warning(
+                f"{WATCHDOG_SLO_ENV} is DEPRECATED: the global p95 "
+                "threshold cannot distinguish QoS classes - use "
+                "--slo 'qos=<class>:p95_ms=<ms>[:availability=<pct>]' "
+                "(repeatable, one per class); honoring the env as a "
+                "default objective for every class this run"
+            )
+        elif slo_ms and slo:
+            log.warning(
+                f"{WATCHDOG_SLO_ENV} ignored: --slo objectives are "
+                "configured and take precedence"
+            )
+            slo_ms = None
         goodput = env.get(WATCHDOG_GOODPUT_ENV)
         return cls(
-            recorder, exporter, faults=faults,
+            recorder, exporter, faults=faults, slo=slo, store=store,
             stall_after_s=resolve_stall_after(env),
             slo_p95_s=float(slo_ms) / 1e3 if slo_ms else None,
             goodput_floor=float(goodput) if goodput else None,
@@ -226,6 +263,7 @@ class AnomalyWatchdog:
         self._check_stall(now)
         self._check_loss()
         self._check_slo()
+        self._check_burn(now)
         self._check_goodput(now)
 
     def _check_stall(self, now: float) -> None:
@@ -281,22 +319,70 @@ class AnomalyWatchdog:
                 self._in_spike = False
 
     def _check_slo(self) -> None:
-        if self.slo_p95_s is None:
+        # per-QoS --slo objectives; the deprecated global env threshold
+        # degrades to one class-blind check (qos None) when no --slo
+        checks = [
+            (obj.qos, obj.p95_ms / 1e3) for obj in self.slo
+            if obj.p95_ms is not None
+        ]
+        if not checks and self.slo_p95_s is not None:
+            checks = [(None, float(self.slo_p95_s))]
+        if not checks:
             return
-        serving = self.exporter.source_snapshot().get("serving") or {}
-        p95 = serving.get("latency_s_p95")
-        if p95 is None:
+        snapshot = self.exporter.source_snapshot()
+        serving = snapshot.get("serving") or {}
+        router = snapshot.get("router") or {}
+        block = router or serving
+        if not block:
             return
-        if p95 > self.slo_p95_s:
-            if not self._in_slo:
-                self._in_slo = True
-                self._alert("slo_breach", latency_s_p95=p95,
-                            slo_p95_s=self.slo_p95_s,
-                            queue_depth=serving.get("queue_depth"))
-        elif self._in_slo:
-            self._in_slo = False
-            self._alert("slo_recovered", severity="info",
-                        latency_s_p95=p95, slo_p95_s=self.slo_p95_s)
+        by_qos = router.get("latency_s_p95_by_qos") or {}
+        for qos, threshold_s in checks:
+            # the router carries per-class p95; the engine's block p95
+            # is class-blind, so an objective without one checks the
+            # block (the honest approximation until the engine splits
+            # latency by QoS)
+            p95 = by_qos.get(qos, block.get("latency_s_p95"))
+            if p95 is None:
+                continue
+            key = qos or "*"
+            latched = self._in_slo.get(key, False)
+            if p95 > threshold_s:
+                if not latched:
+                    self._in_slo[key] = True
+                    self._alert("slo_breach", qos=qos,
+                                latency_s_p95=p95,
+                                slo_p95_s=threshold_s,
+                                queue_depth=serving.get("queue_depth"))
+            elif latched:
+                self._in_slo[key] = False
+                self._alert("slo_recovered", severity="info",
+                            qos=qos, latency_s_p95=p95,
+                            slo_p95_s=threshold_s)
+
+    def _check_burn(self, now: float) -> None:
+        """Episodic error-budget burn alerts off the anchor's store:
+        fire when BOTH windows burn strictly above 1.0 (fast catches,
+        slow confirms), clear when the fast window recovers."""
+        if self.store is None or not self.slo:
+            return
+        for qos, burn in self.store.burn_snapshot(now).items():
+            latched = self._in_burn.get(qos, False)
+            if burn["fire"] and not latched:
+                self._in_burn[qos] = True
+                self._alert(
+                    "slo_burn", qos=qos,
+                    burn_rate_fast=burn["fast"],
+                    burn_rate_slow=burn["slow"],
+                    objective=burn.get("objective"),
+                    windows_s=list(self.store.burn_windows_s),
+                )
+            elif latched and burn["fast"] <= 1.0:
+                self._in_burn[qos] = False
+                self._alert(
+                    "slo_burn_cleared", severity="info", qos=qos,
+                    burn_rate_fast=burn["fast"],
+                    burn_rate_slow=burn["slow"],
+                )
 
     def _check_goodput(self, now: float) -> None:
         if self.goodput_floor is None or self.exporter.finished:

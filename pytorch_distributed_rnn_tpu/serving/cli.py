@@ -147,15 +147,30 @@ def build_serve_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "--live", default=None, metavar="[HOST:]PORT",
         help="live observability plane (obs/live.py; needs --metrics): "
-        "serve GET /metrics (Prometheus), /health, /events and /fleet "
-        "on this address, with the anomaly watchdog (stall / NaN / SLO "
-        "breach alerts + stack dumps) armed; also read from the "
-        "PDRNN_LIVE env.  SLO threshold via PDRNN_WATCHDOG_SLO_P95_MS",
+        "serve GET /metrics (Prometheus), /health, /events, /fleet and "
+        "/series on this address, with the time-series store and the "
+        "anomaly watchdog (stall / NaN / SLO breach + budget-burn "
+        "alerts, stack dumps) armed; also read from the PDRNN_LIVE "
+        "env.  SLO objectives via --slo (the global "
+        "PDRNN_WATCHDOG_SLO_P95_MS env is deprecated)",
     )
     parser.add_argument(
         "--live-port-file", default=None, type=Path, metavar="PATH",
         help="write 'host port' of the live endpoint here once bound "
         "(how scripts find a --live 0 ephemeral port)",
+    )
+    parser.add_argument(
+        "--slo", action="append", default=None, metavar="SPEC",
+        help="per-QoS SLO objective (repeatable, one per class): "
+        "'qos=high:p95_ms=250:availability=99.9'.  Arms the watchdog's "
+        "per-class SLO detector, and - on the live-plane anchor - the "
+        "store's multi-window error-budget burn alerts (slo_burn / "
+        "slo_burn_cleared on /events)",
+    )
+    parser.add_argument(
+        "--slo-windows", default=None, metavar="FAST,SLOW",
+        help="burn-rate window pair in seconds (default 300,3600 - the "
+        "Google SRE fast/slow pair); drills shrink it to fit a burst",
     )
     parser.add_argument("--log", default="INFO")
     return parser
@@ -505,6 +520,17 @@ def loadgen_main(argv=None) -> int:
                 f"window "
                 f"{'closed' if fleet['window_closed'] else 'OPEN'}"
             )
+            if "live" in fleet:
+                live = fleet["live"]
+                rec = live["recommended_replicas"]
+                print(
+                    f"fleet live: slo_burn "
+                    f"{'fired' if live['burn_fired'] else 'quiet'}"
+                    f"{'+cleared' if live['burn_cleared'] else ''}, "
+                    f"recommended_replicas {rec['min']}->{rec['peak']} "
+                    f"({rec['samples']} samples), series scrape "
+                    f"{'ok' if live['series_scrape_ok'] else 'MISSING'}"
+                )
         # the drill's gate: degradation bounded + nothing lost or
         # duplicated + the kill actually respawned + clean teardown
         # (a killed stream may legitimately error, so `errors == 0`

@@ -53,8 +53,8 @@ from jax import lax
 
 from pytorch_distributed_rnn_tpu.obs.live import (
     RATE_HORIZON_S,
-    LatencyHistogram,
     RollingWindow,
+    request_latency_histogram,
 )
 from pytorch_distributed_rnn_tpu.obs.recorder import NULL_RECORDER
 from pytorch_distributed_rnn_tpu.obs.summary import percentile
@@ -205,8 +205,10 @@ class ServingEngine:
         self._sheds = RollingWindow(RATE_HORIZON_S)
         # request-latency histogram behind the aggregator's
         # pdrnn_request_latency_seconds series; traced completions stamp
-        # their bucket's exemplar with their trace_id
-        self._latency_hist = LatencyHistogram()
+        # their bucket's exemplar with their trace_id.  Constructed via
+        # the SHARED spec (obs/live.request_latency_histogram) so the
+        # router's buckets and the store's quantile sketches line up.
+        self._latency_hist = request_latency_histogram()
 
     # -- construction helpers ------------------------------------------------
 
@@ -577,6 +579,9 @@ class ServingEngine:
                 "ttft_s_p50", "ttft_s_p95",
             )
         }
+        # slot count rides the digest so the store can derive slot
+        # utilization and size the fleet (recommended_replicas)
+        block["num_slots"] = self.batcher.num_slots
         hist = self._latency_hist.snapshot()
         if hist is not None:
             block["latency_hist"] = hist

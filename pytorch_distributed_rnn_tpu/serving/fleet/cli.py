@@ -119,10 +119,25 @@ def build_router_parser() -> argparse.ArgumentParser:
         "--live", default=None, metavar="[HOST:]PORT",
         help="live observability plane (needs --metrics): the router "
         "ANCHORS the fleet aggregator here - replicas started with "
-        "the same --live spec push their digests to it",
+        "the same --live spec push their digests to it; the anchor "
+        "also hosts the time-series store behind GET /series and the "
+        "capacity gauges on /metrics",
     )
     parser.add_argument("--live-port-file", default=None, type=Path,
                         metavar="PATH")
+    parser.add_argument(
+        "--slo", action="append", default=None, metavar="SPEC",
+        help="per-QoS SLO objective (repeatable, one per class): "
+        "'qos=high:p95_ms=250:availability=99.9'.  Arms per-class SLO "
+        "breach alerts and the store's multi-window error-budget burn "
+        "alerts (slo_burn fires / slo_burn_cleared on /events)",
+    )
+    parser.add_argument(
+        "--slo-windows", default=None, metavar="FAST,SLOW",
+        help="burn-rate window pair in seconds (default 300,3600 - "
+        "the Google SRE fast/slow pair); drills shrink it to fit a "
+        "burst",
+    )
     parser.add_argument("--log", default="INFO")
     return parser
 

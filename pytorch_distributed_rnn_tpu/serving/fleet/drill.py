@@ -19,6 +19,16 @@ replica mid-burst, fleet down.  Acceptance is graceful degradation:
   router's own ledger agrees - no duplicated and no lost completions;
 - the supervisor respawned the kill (``respawns >= 1``) and every
   process exits clean on teardown.
+
+When the router is started with a live plane (``--live`` +
+``--live-port-file`` in ``router_args``), the drill also runs a
+:class:`_LiveProbe` against the anchor for the whole burst plus a
+short grace window: it scrapes ``/events`` and ``/series`` and attaches
+the observability verdict under ``report["fleet"]["live"]`` - did the
+SLO error-budget ``slo_burn`` alert fire AND clear, and did the store's
+``pdrnn_recommended_replicas`` capacity signal rise while the killed
+replica was down.  CI asserts on that JSON instead of racing the burst
+with shell polling.
 """
 
 from __future__ import annotations
@@ -101,6 +111,119 @@ def _await_file(path: Path, what: str, timeout_s: float,
         if time.monotonic() > deadline:
             raise FleetSpawnError(f"{what} not ready after {timeout_s}s")
         time.sleep(0.05)
+
+
+def _router_live_port_file(router_args) -> Path | None:
+    """The ``--live-port-file`` value inside ``router_args``, if any -
+    how the drill learns where the router anchored its live plane."""
+    args = list(router_args or [])
+    for i, arg in enumerate(args):
+        if arg == "--live-port-file" and i + 1 < len(args):
+            return Path(args[i + 1])
+        if arg.startswith("--live-port-file="):
+            return Path(arg.split("=", 1)[1])
+    return None
+
+
+class _LiveProbe:
+    """Polls the router's live anchor (``/events`` + ``/series``) on a
+    background thread while the burst runs.  All state is written by
+    the probe thread only and read after :meth:`finish` joins it, so no
+    lock is needed."""
+
+    def __init__(self, host: str, port: int):
+        self.base = f"http://{host}:{port}"
+        self.polls = 0
+        self.errors = 0
+        self.burn_fired = False
+        self.burn_cleared = False
+        self.recommended: list[float] = []
+        self.live_replicas: list[float] = []
+        self.series_scrape: dict | None = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="pdrnn-fleet-live-probe", daemon=True,
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def _fetch(self, path: str):
+        import json
+        import urllib.request
+
+        with urllib.request.urlopen(self.base + path,
+                                    timeout=5.0) as resp:
+            return json.loads(resp.read())
+
+    def _poll_once(self) -> None:
+        try:
+            events = self._fetch("/events")
+            # replay the whole (bounded) event log each poll: cleared
+            # only counts when it follows a fire for the same key
+            burning: set = set()
+            for event in events:
+                kind = event.get("alert")
+                key = (event.get("source"), event.get("qos"))
+                if kind == "slo_burn":
+                    self.burn_fired = True
+                    burning.add(key)
+                elif kind == "slo_burn_cleared" and key in burning:
+                    burning.discard(key)
+                    self.burn_cleared = True
+            for name, sink in (
+                ("pdrnn_recommended_replicas", self.recommended),
+                ("pdrnn_replicas_live", self.live_replicas),
+            ):
+                resp = self._fetch(
+                    f"/series?name={name}&window=120&agg=last")
+                series = resp.get("series") or []
+                value = series[0].get("value") if series else None
+                if value is not None:
+                    sink.append(float(value))
+            if self.series_scrape is None:
+                scrape = self._fetch(
+                    "/series?name=pdrnn_router_request_rate_per_s"
+                    "&window=60")
+                if scrape.get("series"):
+                    self.series_scrape = scrape
+            self.polls += 1
+        except (OSError, ValueError):
+            self.errors += 1
+
+    def _run(self) -> None:
+        while not self._stop.wait(timeout=0.5):
+            self._poll_once()
+
+    def finish(self, grace_s: float = 15.0) -> None:
+        """Keep polling past the burst until a fired burn alert has
+        cleared (or the grace expires), then stop the thread."""
+        deadline = time.monotonic() + grace_s
+        while (time.monotonic() < deadline
+               and not (self.burn_fired and self.burn_cleared)):
+            time.sleep(0.3)
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+    def verdict(self) -> dict:
+        rec = self.recommended
+        return {
+            "polls": self.polls,
+            "errors": self.errors,
+            "burn_fired": self.burn_fired,
+            "burn_cleared": self.burn_cleared,
+            "recommended_replicas": {
+                "min": min(rec) if rec else None,
+                "peak": max(rec) if rec else None,
+                "last": rec[-1] if rec else None,
+                "samples": len(rec),
+            },
+            "recommended_rose": bool(rec and max(rec) > min(rec)),
+            "replicas_live_min": (
+                min(self.live_replicas) if self.live_replicas else None
+            ),
+            "series_scrape_ok": self.series_scrape is not None,
+        }
 
 
 class FleetHandle:
@@ -252,6 +375,15 @@ def run_fleet_drill(replica_args: list[str], cfg: LoadConfig, *,
         replica_args, n, router_args=router_args,
         ready_timeout_s=ready_timeout_s,
     ) as fleet:
+        probe = None
+        live_port_file = _router_live_port_file(router_args)
+        if live_port_file is not None:
+            host, port = _await_file(
+                live_port_file, "router live plane", ready_timeout_s,
+                dead=fleet.router_proc.poll,
+            )
+            probe = _LiveProbe(host, int(port))
+            probe.start()
         cfg = LoadConfig(**{**cfg.__dict__, "host": fleet.host,
                             "port": fleet.port})
         killed = {"pid": None}
@@ -269,6 +401,10 @@ def run_fleet_drill(replica_args: list[str], cfg: LoadConfig, *,
         finally:
             if timer is not None:
                 timer.cancel()
+        if probe is not None:
+            # grace: the clear needs the fast burn window to slide
+            # clean of the burst before the watchdog can emit it
+            probe.finish()
         router_stats = fleet.router_stats()
         supervision = fleet.supervisor.verdict()
     router_stats.pop("event", None)
@@ -302,5 +438,7 @@ def run_fleet_drill(replica_args: list[str], cfg: LoadConfig, *,
         "supervision": supervision,
         "router_exit": fleet.router_proc.returncode,
     }
+    if probe is not None:
+        report["fleet"]["live"] = probe.verdict()
     report["trace_handles"] = trace_handles(report)
     return report

@@ -64,8 +64,8 @@ import threading
 import time
 
 from pytorch_distributed_rnn_tpu.obs.live import (
-    LatencyHistogram,
     RollingWindow,
+    request_latency_histogram,
 )
 from pytorch_distributed_rnn_tpu.obs.recorder import NULL_RECORDER
 from pytorch_distributed_rnn_tpu.obs.tracectx import (
@@ -155,8 +155,15 @@ class RouterCore:
         self._latency_s = RollingWindow()
         # request-latency histogram behind the aggregator's
         # pdrnn_request_latency_seconds series; traced completions stamp
-        # their bucket's exemplar with their trace_id
-        self._latency_hist = LatencyHistogram()
+        # their bucket's exemplar with their trace_id.  Constructed via
+        # the SHARED spec (obs/live.request_latency_histogram) so the
+        # engine's buckets and the store's quantile sketches line up.
+        self._latency_hist = request_latency_histogram()
+        # per-QoS latency windows behind latency_s_p95_by_qos: the
+        # store and watchdog scope --slo objectives per class with them
+        self._latency_by_qos = {
+            q: RollingWindow() for q in QOS_CLASSES
+        }
 
     # -- admission -----------------------------------------------------------
 
@@ -271,6 +278,7 @@ class RouterCore:
         if ok:
             self._completions.observe(1.0)
             self._latency_s.observe(elapsed)
+            self._latency_by_qos[qos].observe(elapsed)
             self._latency_hist.observe(
                 elapsed, trace_id=None if route_ctx is None
                 else route_ctx.trace_id,
@@ -613,10 +621,19 @@ class RouterCore:
             "errors": stats["errors"], "shed": stats["shed"],
             "drain_rejected": stats["drain_rejected"],
             "replicas": stats["pool"]["states"],
+            "max_inflight": self.max_inflight,
             "req_per_s_60s": stats["req_per_s_60s"],
             "latency_s_p50": stats["latency_s_p50"],
             "latency_s_p95": stats["latency_s_p95"],
         }
+        by_qos = {
+            qos: window.stats()["p95"]
+            for qos, window in self._latency_by_qos.items()
+            if window.values()
+        }
+        if by_qos:
+            # per-class p95 (the --slo scoping input: watchdog + store)
+            block["latency_s_p95_by_qos"] = by_qos
         hist = self._latency_hist.snapshot()
         if hist is not None:
             block["latency_hist"] = hist

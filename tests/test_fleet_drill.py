@@ -214,3 +214,25 @@ def test_kill_mid_burst_fleet_drill(trained_checkpoint):
     assert fleet["router_exit"] == 0
     router = fleet["router"]
     assert router["submitted"] == router["done"] + router["errors"]
+
+
+def test_router_live_port_file_parsing():
+    from pathlib import Path
+
+    from pytorch_distributed_rnn_tpu.serving.fleet.drill import (
+        _router_live_port_file,
+    )
+
+    # both CLI spellings resolve to the same path
+    assert _router_live_port_file(
+        ["--retries", "2", "--live-port-file", "/tmp/p"]
+    ) == Path("/tmp/p")
+    assert _router_live_port_file(
+        ["--live-port-file=/tmp/p", "--retries", "2"]
+    ) == Path("/tmp/p")
+    # absent flag, empty list, None: the drill simply skips the probe
+    assert _router_live_port_file(["--retries", "2"]) is None
+    assert _router_live_port_file([]) is None
+    assert _router_live_port_file(None) is None
+    # a trailing bare flag with no value is not a crash either
+    assert _router_live_port_file(["--live-port-file"]) is None

@@ -1076,3 +1076,63 @@ class TestLiveSpawnWorld:
                   if e["kind"] == "alert" and e["alert"] == "stall"]
         assert alerts and alerts[0]["chaos_fired"] == {"stall": 1}
         assert (tmp_path / "m-r2-stacks.txt").exists()
+
+
+class TestLivePlaneStore:
+    """The anchor owns the time-series history; everyone else stays
+    store-free (the pre-store zero-overhead shape)."""
+
+    def test_anchor_builds_store_with_slo(self, tmp_path):
+        rec = _recorder(tmp_path)
+        args = _Args()
+        args.live = "127.0.0.1:0"
+        args.slo = ["qos=high:p95_ms=250:availability=99.9"]
+        args.slo_windows = "4,16"
+        plane = LivePlane.resolve(args, rec, rank=0, role="serve")
+        try:
+            assert plane.store is not None
+            assert plane.aggregator.store is plane.store
+            assert plane.store.burn_windows_s == (4.0, 16.0)
+            assert [o.qos for o in plane.store.slo] == ["high"]
+            # snapshots land next to the sidecar, store-suffixed
+            assert plane.store.snapshot_path.name.endswith(
+                "-store.jsonl")
+            assert plane.store.snapshot_path.parent == tmp_path
+            # the watchdog's burn detector is armed off the same store
+            assert plane.watchdog is not None
+            assert plane.watchdog.store is plane.store
+        finally:
+            rec.close()
+            plane.close()
+        # close() flushed a final snapshot even though the plane lived
+        # far less than the periodic cadence
+        assert plane.store.snapshot_path.exists()
+
+    def test_pusher_rank_has_no_store(self, tmp_path):
+        rec = _recorder(tmp_path)
+        args = _Args()
+        args.live = "127.0.0.1:19"  # explicit port: no wait, no file
+        args.slo = ["qos=high:p95_ms=250"]
+        plane = LivePlane.resolve(args, rec, rank=1, role="serve")
+        try:
+            assert plane.store is None
+            assert plane.server is None
+            # the --slo objectives still arm the per-QoS watchdog SLO
+            # on the pushing rank (breach detection is local)
+            if plane.watchdog is not None:
+                assert [o.qos for o in plane.watchdog.slo] == ["high"]
+                assert plane.watchdog.store is None
+        finally:
+            rec.close()
+            plane.close()
+
+    def test_bad_slo_fails_loudly(self, tmp_path):
+        rec = _recorder(tmp_path)
+        args = _Args()
+        args.live = "127.0.0.1:0"
+        args.slo = ["qos=bogus:p95_ms=250"]
+        try:
+            with pytest.raises(ValueError, match="qos"):
+                LivePlane.resolve(args, rec, rank=0)
+        finally:
+            rec.close()

@@ -1,0 +1,626 @@
+"""Time-series telemetry store (obs/store.py): SLO grammar, ladder
+downsampling vs brute-force recomputation, counter monotonicity across
+a source restart, multi-window error-budget burn boundaries, gap-safe
+derivatives over a paused-then-resumed pusher, capacity signals, the
+``GET /series`` endpoint, crash-tolerant snapshots, and the store-fed
+``slo_burn`` watchdog alerts.
+"""
+
+import json
+import logging
+import random
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pytorch_distributed_rnn_tpu.obs.aggregator import (
+    Aggregator,
+    AggregatorServer,
+)
+from pytorch_distributed_rnn_tpu.obs.live import (
+    LiveExporter,
+    request_latency_histogram,
+)
+from pytorch_distributed_rnn_tpu.obs.recorder import MetricsRecorder
+from pytorch_distributed_rnn_tpu.obs.store import (
+    TimeSeriesStore,
+    load_snapshot,
+    parse_slo,
+    parse_slo_args,
+    store_path_for,
+)
+from pytorch_distributed_rnn_tpu.obs.watchdog import AnomalyWatchdog
+
+
+def _serve_digest(source="serve-1", *, requests=0, shed=0, failed=0,
+                  tokens=0, active=0, slots=4, queue=0, req_rate=None,
+                  tok_rate=None, hist=None, **over):
+    body = {
+        "id": source, "role": "serve", "rank": 1, "seq": 1, "pid": 11,
+        "t": time.time(), "tm": time.perf_counter(),
+        "serving": {
+            "requests": requests, "requests_shed": shed,
+            "requests_failed": failed, "tokens_out": tokens,
+            "active": active, "num_slots": slots, "queue_depth": queue,
+            "req_per_s_60s": req_rate, "tokens_per_s_60s": tok_rate,
+        },
+    }
+    if hist is not None:
+        body["serving"]["latency_hist"] = hist
+    body.update(over)
+    return body
+
+
+def _router_digest(source="router-0", *, routed=0, errors=0, rerouted=0,
+                   shed=None, inflight=0, replicas=None, hist=None,
+                   **over):
+    body = {
+        "id": source, "role": "router", "rank": 0, "seq": 1, "pid": 7,
+        "t": time.time(), "tm": time.perf_counter(),
+        "router": {
+            "routed": routed, "errors": errors, "rerouted": rerouted,
+            "retries": 0, "shed": shed or {}, "inflight": inflight,
+            "max_inflight": 64,
+            "replicas": replicas or {"healthy": 3},
+        },
+    }
+    if hist is not None:
+        body["router"]["latency_hist"] = hist
+    body.update(over)
+    return body
+
+
+# -- SLO objective grammar ----------------------------------------------------
+
+
+class TestParseSlo:
+    def test_full_spec(self):
+        obj = parse_slo("qos=high:p95_ms=250:availability=99.9")
+        assert obj.qos == "high"
+        assert obj.p95_ms == pytest.approx(250.0)
+        assert obj.availability == pytest.approx(99.9)
+        assert obj.availability_budget_frac == pytest.approx(0.001)
+        assert "qos=high" in obj.describe()
+
+    def test_single_target_ok(self):
+        assert parse_slo("qos=low:p95_ms=2000").availability is None
+        assert parse_slo("qos=low:availability=99").p95_ms is None
+
+    @pytest.mark.parametrize("spec", [
+        "p95_ms=250",                       # qos required
+        "qos=bogus:p95_ms=250",             # not a QoS class
+        "qos=high",                         # no target at all
+        "qos=high:p95_ms=0",                # p95 must be positive
+        "qos=high:availability=101",        # availability in (0, 100)
+        "qos=high:availability=0",
+        "qos=high:p95_ms=250:frobnicate=1",  # unknown key
+        "qos=high:p95ms250",                # not key=value
+    ])
+    def test_rejects(self, spec):
+        with pytest.raises(ValueError):
+            parse_slo(spec)
+
+    def test_args_list_and_duplicates(self):
+        objs = parse_slo_args(
+            ["qos=high:p95_ms=250", "qos=low:p95_ms=2000"])
+        assert [o.qos for o in objs] == ["high", "low"]
+        assert parse_slo_args(None) == ()
+        assert parse_slo_args("qos=high:p95_ms=1")[0].qos == "high"
+        with pytest.raises(ValueError):
+            parse_slo_args(["qos=high:p95_ms=1", "qos=high:p95_ms=2"])
+
+
+# -- ladder downsampling (property: tiers == brute force) ---------------------
+
+
+class TestLadder:
+    def test_gauge_tiers_match_brute_force(self):
+        rng = random.Random(20260807)
+        store = TimeSeriesStore()
+        truth = []  # (tm, value)
+        tm = 1000.0
+        for _ in range(300):
+            tm += rng.uniform(0.2, 1.5)
+            value = rng.uniform(-5.0, 25.0)
+            truth.append((tm, value))
+            store.ingest(_serve_digest(queue=value), now=tm)
+        now = tm + 0.1
+        # window past the raw horizon -> the 10s tier answers
+        resp = store.query("pdrnn_queue_depth",
+                           {"source": "serve-1"},
+                           window=500.0, now=now)
+        (series,) = resp["series"]
+        assert series["resolution_s"] == 10.0
+        # brute force the same buckets from the ground truth
+        expected: dict[int, list[float]] = {}
+        for ptm, v in truth:
+            expected.setdefault(int(ptm // 10.0), []).append(v)
+        got = {int(p["tm"] // 10.0): p for p in series["points"]}
+        assert sorted(got) == sorted(expected)
+        for idx, values in expected.items():
+            point = got[idx]
+            assert point["count"] == len(values)
+            assert point["min"] == pytest.approx(min(values))
+            assert point["max"] == pytest.approx(max(values))
+            assert point["mean"] == pytest.approx(
+                sum(values) / len(values))
+            assert point["last"] == pytest.approx(values[-1])
+        # and the window aggregate equals brute force over every value
+        for agg, expect in (
+            ("min", min(v for _, v in truth)),
+            ("max", max(v for _, v in truth)),
+            ("mean", sum(v for _, v in truth) / len(truth)),
+            ("last", truth[-1][1]),
+        ):
+            resp = store.query("pdrnn_queue_depth", None, window=500.0,
+                               agg=agg, now=now)
+            assert resp["series"][0]["value"] == pytest.approx(expect)
+
+    def test_counter_tiers_match_brute_force_with_restart(self):
+        """Counter buckets accumulate clamped deltas, so a respawned
+        source whose cumulative counter resets to zero never produces a
+        negative increase - monotonicity survives the restart."""
+        rng = random.Random(7)
+        store = TimeSeriesStore()
+        truth = []  # (tm, cumulative)
+        tm, cum = 2000.0, 0
+        for i in range(200):
+            tm += rng.uniform(0.3, 1.2)
+            if i == 120:
+                cum = 0  # the respawn: a fresh process restarts at 0
+            else:
+                cum += rng.randrange(0, 8)
+            truth.append((tm, cum))
+            store.ingest(_serve_digest(requests=cum), now=tm)
+        now = tm + 0.1
+        resp = store.query("pdrnn_serving_requests_total", None,
+                           window=500.0, now=now)
+        (series,) = resp["series"]
+        assert series["resolution_s"] == 10.0
+        expected: dict[int, float] = {}
+        prev = None
+        for ptm, v in truth:
+            if prev is not None:
+                idx = int(ptm // 10.0)
+                expected[idx] = expected.get(idx, 0.0) \
+                    + max(0.0, v - prev)
+            prev = v
+        got = {int(p["tm"] // 10.0): p for p in series["points"]}
+        for idx, point in got.items():
+            assert point["increase"] >= 0.0  # monotone per bucket
+            assert point["increase"] == pytest.approx(
+                expected.get(idx, 0.0))
+        total = store.query("pdrnn_serving_requests_total", None,
+                            window=500.0, agg="increase",
+                            now=now)["series"][0]["value"]
+        assert total == pytest.approx(sum(expected.values()))
+
+    def test_hist_window_delta_and_quantile(self):
+        """The stored sketch is the cumulative histogram; a window's
+        view is last-in-window minus last-before-window."""
+        store = TimeSeriesStore()
+        hist = request_latency_histogram()
+        tm = 3000.0
+        for latency in (0.05,) * 50:
+            hist.observe(latency)
+        store.ingest(_serve_digest(hist=hist.snapshot()), now=tm)
+        for latency in (0.4,) * 100:  # the recent, slower regime
+            hist.observe(latency)
+        store.ingest(_serve_digest(hist=hist.snapshot()), now=tm + 40.0)
+        now = tm + 41.0
+        # short window: only the second snapshot's delta (100 slow obs)
+        recent = store.query("pdrnn_request_latency_seconds", None,
+                             window=10.0, agg="count",
+                             now=now)["series"][0]["value"]
+        assert recent == 100
+        p95 = store.query("pdrnn_request_latency_seconds", None,
+                          window=10.0, agg="p95",
+                          now=now)["series"][0]["value"]
+        assert 0.25 <= p95 <= 0.5
+        # full window: both regimes
+        full = store.query("pdrnn_request_latency_seconds", None,
+                           window=60.0, agg="count",
+                           now=now)["series"][0]["value"]
+        assert full == 150
+
+
+# -- burn-rate boundaries -----------------------------------------------------
+
+
+class TestBurnBoundaries:
+    def _store(self, availability, windows=(5.0, 60.0)):
+        return TimeSeriesStore(
+            slo=parse_slo_args([f"qos=high:availability={availability}"]),
+            burn_windows_s=windows,
+        )
+
+    def test_exactly_at_budget_does_not_fire(self):
+        store = self._store(99.0)  # budget = 1%
+        store.ingest(_router_digest(routed=0, errors=0), now=100.0)
+        # 990 good + 10 bad = exactly the 1% budget in both windows
+        store.ingest(_router_digest(routed=990, errors=10), now=101.0)
+        snap = store.burn_snapshot(now=102.0)["high"]
+        assert snap["fast"] == pytest.approx(1.0)
+        assert snap["slow"] == pytest.approx(1.0)
+        assert snap["fire"] is False  # strictly-above fires, at does not
+        # one more disruption tips it over
+        store.ingest(_router_digest(routed=990, errors=11), now=102.5)
+        snap = store.burn_snapshot(now=103.0)["high"]
+        assert snap["fast"] > 1.0 and snap["slow"] > 1.0
+        assert snap["fire"] is True
+
+    def test_fast_window_fires_before_slow(self):
+        """A fresh error burst saturates the 5s window while the 60s
+        window still dilutes it below budget - no fire until the slow
+        window confirms."""
+        store = self._store(99.0)
+        store.ingest(_router_digest(routed=0, errors=0), now=200.0)
+        store.ingest(_router_digest(routed=10000, errors=0), now=201.0)
+        # 55s later: 50 errors inside the fast window
+        store.ingest(_router_digest(routed=10100, errors=50), now=256.0)
+        snap = store.burn_snapshot(now=257.0)["high"]
+        assert snap["fast"] > 1.0          # onset caught immediately
+        assert snap["slow"] < 1.0          # one blip, diluted
+        assert snap["fire"] is False
+        # the burst persists: the slow window crosses too -> fire
+        store.ingest(_router_digest(routed=10200, errors=175), now=259.0)
+        snap = store.burn_snapshot(now=260.0)["high"]
+        assert snap["fast"] > 1.0 and snap["slow"] > 1.0
+        assert snap["fire"] is True
+
+    def test_reroutes_burn_availability(self):
+        store = self._store(99.9)
+        store.ingest(_router_digest(routed=0), now=300.0)
+        store.ingest(_router_digest(routed=100, rerouted=2), now=301.0)
+        snap = store.burn_snapshot(now=302.0)["high"]
+        assert snap["fire"] is True  # 2/102 >> 0.1% budget
+
+    def test_zero_traffic_burns_nothing(self):
+        store = self._store(99.0)
+        assert store.burn_snapshot(now=400.0)["high"]["fire"] is False
+        rates = store.burn_rates(now=400.0)
+        assert all(r["burn_rate"] == 0.0 for r in rates)
+
+    def test_latency_burn(self):
+        store = TimeSeriesStore(
+            slo=parse_slo_args(["qos=high:p95_ms=100"]),
+            burn_windows_s=(5.0, 60.0),
+        )
+        hist = request_latency_histogram()
+        for latency in [0.01] * 80 + [0.5] * 20:  # 20% above threshold
+            hist.observe(latency)
+        store.ingest(_router_digest(routed=100, hist=hist.snapshot()),
+                     now=501.0)
+        snap = store.burn_snapshot(now=502.0)["high"]
+        # 20% above vs the 5% latency budget: burn ~4 on both windows
+        assert snap["fast"] > 1.0 and snap["slow"] > 1.0
+        assert snap["fire"] is True
+
+
+# -- gap-safe derivatives + monotone ingest stamps (satellite) ----------------
+
+
+class TestPausedPusher:
+    def test_rate_never_divides_over_a_gap(self):
+        """A paused-then-resumed pusher: the slope must come from the
+        post-gap segment only, and a stale series answers None rather
+        than a slope across the silence."""
+        store = TimeSeriesStore()
+        for i in range(6):  # slope 2/s for 5s
+            store.ingest(_serve_digest(queue=2.0 * i), now=1000.0 + i)
+        assert store.rate_of("pdrnn_queue_depth", None,
+                             now=1005.5) == pytest.approx(2.0)
+        # pause: 30s of silence -> stale, no slope across the gap
+        assert store.rate_of("pdrnn_queue_depth", None,
+                             now=1035.0) is None
+        # resume at a different slope: only post-gap points answer
+        for i in range(4):
+            store.ingest(_serve_digest(queue=3.0 * i), now=1040.0 + i)
+        assert store.rate_of("pdrnn_queue_depth", None,
+                             now=1043.5) == pytest.approx(3.0)
+
+    def test_last_ingest_stamp_is_monotone(self):
+        store = TimeSeriesStore()
+        store.ingest(_serve_digest(), now=100.0)
+        # an out-of-order ingest (e.g. a slow handler thread losing the
+        # race) must not move the staleness stamp backwards
+        store.ingest(_serve_digest(), now=90.0)
+        assert store.last_ingest_age_s("serve-1",
+                                       now=101.0) == pytest.approx(1.0)
+        assert store.last_ingest_age_s("nope", now=101.0) is None
+
+    def test_paused_source_goes_stale_in_capacity(self):
+        store = TimeSeriesStore(stale_after_s=5.0)
+        store.ingest(_serve_digest("serve-1", active=2, queue=1),
+                     now=100.0)
+        store.ingest(_serve_digest("serve-2", active=2, queue=1),
+                     now=100.0)
+        cap = store.capacity(now=101.0)
+        assert cap["replicas_live"] == 2
+        # serve-2 pauses; its staleness must not poison the fleet view
+        store.ingest(_serve_digest("serve-1", active=2, queue=1),
+                     now=110.0)
+        cap = store.capacity(now=111.0)
+        assert cap["replicas_live"] == 1
+        assert cap["replicas_known"] == 2
+
+
+# -- capacity signals ---------------------------------------------------------
+
+
+class TestCapacity:
+    def test_engine_view_recommends_more_on_queue_growth(self):
+        store = TimeSeriesStore()
+        # steady: 2 slots busy of 4, empty queue -> 1 replica suffices
+        for i in range(6):
+            store.ingest(_serve_digest(active=2, queue=0, tok_rate=50.0),
+                         now=100.0 + i)
+        flat = store.capacity(now=106.0)
+        assert flat["recommended_replicas"] == 1
+        sig = flat["sources"]["serve-1"]
+        assert sig["slot_utilization"] == pytest.approx(0.5)
+        assert sig["goodput_headroom_tokens_per_s"] == pytest.approx(25.0)
+        # the queue starts growing fast: the ask must rise (the batch
+        # sits past the gap horizon so the old flat regime cannot blend
+        # into the slope)
+        for i in range(6):
+            store.ingest(
+                _serve_digest(active=4, queue=10 * i, tok_rate=50.0),
+                now=120.0 + i)
+        hot = store.capacity(now=126.0)
+        assert hot["sources"]["serve-1"]["queue_growth_per_s"] == \
+            pytest.approx(10.0)
+        assert hot["recommended_replicas"] > flat["recommended_replicas"]
+
+    def test_router_view_rises_while_replica_dead(self):
+        store = TimeSeriesStore()
+        # healthy baseline: 3 replicas carrying inflight 9
+        for i in range(6):
+            store.ingest(
+                _router_digest(inflight=9, routed=10 * i,
+                               replicas={"healthy": 3}),
+                now=200.0 + i)
+        base = store.capacity(now=206.0)
+        assert base["replicas_live"] == 3
+        assert base["recommended_replicas"] == 3
+        # one replica dies: its load piles onto the survivors
+        for i in range(4):
+            store.ingest(
+                _router_digest(inflight=18, routed=100 + 10 * i,
+                               replicas={"healthy": 2, "open": 1}),
+                now=210.0 + i)
+        dead = store.capacity(now=214.0)
+        assert dead["replicas_live"] == 2
+        assert dead["recommended_replicas"] > 3
+
+    def test_router_view_rises_even_with_tiny_inflight(self):
+        """The drill regime: requests are so fast that inflight never
+        visibly spikes during the kill - the live-fraction derate must
+        still raise the ask while traffic flows through a short pool."""
+        store = TimeSeriesStore()
+        for i in range(6):
+            store.ingest(
+                _router_digest(inflight=0, routed=50 * i,
+                               replicas={"healthy": 3}),
+                now=300.0 + i)
+        assert store.capacity(now=306.0)["recommended_replicas"] == 3
+        for i in range(4):
+            store.ingest(
+                _router_digest(inflight=0, routed=300 + 50 * i,
+                               replicas={"healthy": 2, "open": 1}),
+                now=306.5 + i)
+        dead = store.capacity(now=310.0)
+        assert dead["replicas_live"] == 2
+        assert dead["recommended_replicas"] == 5  # ceil(3 / (2/3))
+        # the pool heals: the ask falls back to the configured size
+        store.ingest(_router_digest(inflight=0, routed=600,
+                                    replicas={"healthy": 3}),
+                     now=311.0)
+        assert store.capacity(now=311.5)["recommended_replicas"] == 3
+
+
+# -- /series endpoint ---------------------------------------------------------
+
+
+class TestSeriesEndpoint:
+    def _fleet(self, store=None):
+        agg = Aggregator(store=store)
+        return agg, AggregatorServer(agg)
+
+    def _get(self, server, path):
+        url = f"http://{server.host}:{server.port}{path}"
+        with urllib.request.urlopen(url, timeout=5.0) as resp:
+            return json.loads(resp.read())
+
+    def test_catalog_query_labels_and_agg(self):
+        store = TimeSeriesStore()
+        agg, server = self._fleet(store)
+        try:
+            for i in range(5):
+                agg.ingest(_serve_digest(requests=10 * i, queue=i))
+            catalog = self._get(server, "/series")  # no name: the list
+            names = {s["name"] for s in catalog}
+            assert "pdrnn_queue_depth" in names
+            resp = self._get(
+                server, "/series?name=pdrnn_queue_depth&window=60")
+            (series,) = resp["series"]
+            assert len(series["points"]) == 5
+            assert series["labels"]["source"] == "serve-1"
+            resp = self._get(
+                server,
+                "/series?name=pdrnn_serving_requests_total&window=60"
+                "&agg=increase")
+            assert resp["series"][0]["value"] == pytest.approx(40.0)
+            # a label filter that matches nothing
+            resp = self._get(
+                server,
+                "/series?name=pdrnn_queue_depth&window=60&source=nope")
+            assert resp["series"] == []
+        finally:
+            server.close()
+
+    def test_bad_agg_400_and_no_store_404(self):
+        store = TimeSeriesStore()
+        agg, server = self._fleet(store)
+        try:
+            agg.ingest(_serve_digest(queue=1))
+            with pytest.raises(urllib.error.HTTPError) as err:
+                self._get(server,
+                          "/series?name=pdrnn_queue_depth&agg=bogus")
+            assert err.value.code == 400
+        finally:
+            server.close()
+        _, bare = self._fleet(store=None)  # history-free aggregator
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                self._get(bare, "/series?name=pdrnn_queue_depth")
+            assert err.value.code == 404
+        finally:
+            bare.close()
+
+
+# -- snapshots ----------------------------------------------------------------
+
+
+class TestSnapshots:
+    def test_path_convention(self, tmp_path):
+        sidecar = tmp_path / "router-metrics.jsonl"
+        assert store_path_for(sidecar) == \
+            tmp_path / "router-metrics-store.jsonl"
+
+    def test_roundtrip_and_throttle(self, tmp_path):
+        path = tmp_path / "store.jsonl"
+        store = TimeSeriesStore(
+            slo=parse_slo_args(["qos=high:p95_ms=250:availability=99.9"]),
+            snapshot_path=path, snapshot_every_s=30.0,
+        )
+        # the first ingest snapshots immediately (there is nothing to
+        # throttle against yet), then the cadence throttles
+        store.ingest(_serve_digest(requests=10, queue=3), now=100.0)
+        first = path.read_bytes()
+        store.ingest(_serve_digest(requests=20, queue=4), now=101.0)
+        assert path.read_bytes() == first  # throttled: not 30s in yet
+        assert store.maybe_snapshot(now=120.0) is None
+        assert store.maybe_snapshot(now=140.0) == path
+        assert path.read_bytes() != first
+        snap = load_snapshot(path)
+        assert snap["meta"]["slo"] == [
+            "qos=high:p95_ms=250:availability=99.9"]
+        assert snap["meta"]["burn_windows_s"] == [300.0, 3600.0]
+        names = {s["name"] for s in snap["series"]}
+        assert "pdrnn_queue_depth" in names
+        assert "pdrnn_serving_requests_total" in names
+        # no torn temp file left behind
+        assert list(tmp_path.iterdir()) == [path]
+
+    def test_torn_line_skipped(self, tmp_path):
+        path = tmp_path / "store.jsonl"
+        store = TimeSeriesStore(snapshot_path=path)
+        store.ingest(_serve_digest(queue=1), now=100.0)
+        store.write_snapshot()
+        with open(path, "a") as f:
+            f.write('{"kind": "series", "name": "torn')  # truncation
+        snap = load_snapshot(path)
+        assert snap["meta"]["schema"] == 1
+        assert all(s["name"] != "torn" for s in snap["series"])
+
+
+# -- watchdog: store-fed burn alerts + per-QoS SLO scoping --------------------
+
+
+class TestWatchdogBurn:
+    def _plane(self, tmp_path, store, slo):
+        rec = MetricsRecorder(tmp_path / "m.jsonl",
+                              heartbeat_every_s=0.05)
+        exporter = LiveExporter(rec, None)
+        rec.attach_live(exporter)
+        wd = AnomalyWatchdog(rec, exporter, slo=slo, store=store,
+                             check_every_s=0.05)
+        return rec, wd
+
+    def test_burn_fires_once_then_clears(self, tmp_path):
+        slo = parse_slo_args(["qos=high:availability=99.0"])
+        store = TimeSeriesStore(slo=slo, burn_windows_s=(4.0, 16.0))
+        rec, wd = self._plane(tmp_path, store, slo)
+        store.ingest(_router_digest(routed=0), now=time.perf_counter())
+        store.ingest(_router_digest(routed=100, errors=50),
+                     now=time.perf_counter())
+        wd.check()
+        wd.check()  # episodic: the same burn alerts once
+        # recovery: the windows slide clean of the burst
+        future = time.perf_counter() + 100.0
+        store.ingest(_router_digest(routed=1000, errors=50), now=future)
+        wd.check(now=future + 1.0)
+        rec.close()
+        events = [json.loads(line) for line in
+                  (tmp_path / "m.jsonl").read_text().splitlines()
+                  if line.strip()]
+        burns = [e for e in events if e.get("alert") == "slo_burn"]
+        cleared = [e for e in events
+                   if e.get("alert") == "slo_burn_cleared"]
+        assert len(burns) == 1  # episodic, not once per check
+        assert len(cleared) == 1
+        assert burns[0]["qos"] == "high"
+        assert burns[0]["burn_rate_fast"] > 1.0
+
+    def test_per_qos_slo_breach(self, tmp_path):
+        """--slo scopes the latency breach per QoS class: only the
+        class whose p95 is over its own threshold alerts."""
+        slo = parse_slo_args(
+            ["qos=high:p95_ms=100", "qos=low:p95_ms=5000"])
+        rec = MetricsRecorder(tmp_path / "m.jsonl",
+                              heartbeat_every_s=0.05)
+        exporter = LiveExporter(rec, None)
+        rec.attach_live(exporter)
+        router = {"latency_s_p95_by_qos": {"high": 0.5, "low": 0.5}}
+        exporter.add_source(lambda: {"router": dict(router)})
+        wd = AnomalyWatchdog(rec, exporter, slo=slo, check_every_s=0.05)
+        wd.check()
+        router["latency_s_p95_by_qos"] = {"high": 0.01, "low": 0.5}
+        wd.check()
+        rec.close()
+        events = [json.loads(line) for line in
+                  (tmp_path / "m.jsonl").read_text().splitlines()]
+        breaches = [e for e in events
+                    if e.get("alert") == "slo_breach"]
+        assert [b["qos"] for b in breaches] == ["high"]
+        recovered = [e for e in events
+                     if e.get("alert") == "slo_recovered"]
+        assert [r["qos"] for r in recovered] == ["high"]
+
+    def test_env_slo_deprecated_but_honored(self, tmp_path, caplog):
+        rec = MetricsRecorder(tmp_path / "m.jsonl",
+                              heartbeat_every_s=0.05)
+        exporter = LiveExporter(rec, None)
+        rec.attach_live(exporter)
+        with caplog.at_level(logging.WARNING):
+            wd = AnomalyWatchdog.resolve(
+                rec, exporter, env={"PDRNN_WATCHDOG_SLO_P95_MS": "750"})
+        assert wd.slo_p95_s == pytest.approx(0.75)
+        assert any("DEPRECATED" in r.message for r in caplog.records)
+        # --slo wins when both are given
+        with caplog.at_level(logging.WARNING):
+            wd = AnomalyWatchdog.resolve(
+                rec, exporter,
+                slo=parse_slo_args(["qos=high:p95_ms=100"]),
+                env={"PDRNN_WATCHDOG_SLO_P95_MS": "750"})
+        assert wd.slo_p95_s is None
+        assert [o.qos for o in wd.slo] == ["high"]
+        rec.close()
+
+
+# -- zero-overhead when off ---------------------------------------------------
+
+
+class TestStoreOff:
+    def test_aggregator_default_has_no_store(self):
+        agg = Aggregator()
+        assert agg.store is None
+        assert agg.series("pdrnn_queue_depth") is None
+
+    def test_ingest_without_store_allocates_no_series(self):
+        agg = Aggregator()
+        agg.ingest(_serve_digest())
+        assert agg.store is None  # nothing grew a history behind /push
